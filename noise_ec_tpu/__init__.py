@@ -1,0 +1,35 @@
+"""noise_ec_tpu — a TPU-native erasure-coding framework.
+
+A brand-new framework with the capabilities of the reference
+``da-moon/noise-erasurecode-plugin`` (a Go P2P node that Reed-Solomon-shards
+every signed message and broadcasts the shards; see ``/root/reference/main.go``),
+re-designed TPU-first:
+
+- The GF(2^8)/GF(2^16) Reed-Solomon hot loops — Cauchy generator-matrix
+  multiply for ``Encode()`` (reference call site main.go:262) and
+  submatrix-inversion x multiply for ``Reconstruct()`` (main.go:77) — run as
+  bitsliced JAX/Pallas kernels over HBM-resident shard batches.
+- The host-side plugin runtime (wire format per protobuf/shard.proto:21-27,
+  ed25519+blake2b signing per main.go:219-223, shard-reassembly mempool per
+  main.go:52-107, broadcast fan-out per main.go:201-210) is implemented
+  natively in ``noise_ec_tpu.host``.
+- Batched multi-object encode scales over a ``jax.sharding.Mesh`` with parity
+  all-gathered over ICI (``noise_ec_tpu.parallel``).
+- A C-ABI native shim (``shim/``) exposes the codec under a
+  klauspost ``reedsolomon.Encoder``-style C interface for non-Python hosts.
+
+Package layout (SURVEY.md §7.1):
+
+- ``gf``       — finite-field arithmetic + bit-matrix / bit-plane machinery
+- ``matrix``   — generator-matrix construction + GF linear algebra
+- ``golden``   — slow, obviously-correct NumPy reference codec (ground truth)
+- ``ops``      — JAX + Pallas kernels (the TPU compute path)
+- ``codec``    — public Encoder APIs (klauspost-style and infectious-style)
+- ``parallel`` — mesh/shard_map batching, ICI collectives, streaming
+- ``host``     — wire format, identity/signing, mempool, transport, CLI
+- ``utils``    — logging, primes, misc
+"""
+
+__version__ = "0.1.0"
+
+from noise_ec_tpu.gf.field import GF, GF256, GF65536  # noqa: F401

@@ -1,0 +1,17 @@
+"""Finite-field arithmetic for the erasure codec.
+
+``field``     — GF(2^8) / GF(2^16) log/exp-table arithmetic (NumPy, host side).
+``bitmatrix`` — the bitsliced view: every GF(2^m) constant is an m x m matrix
+                over GF(2), so a generator-matrix multiply becomes a pure
+                AND/XOR binary matmul — the formulation the TPU kernels use.
+"""
+
+from noise_ec_tpu.gf.field import GF, GF256, GF65536  # noqa: F401
+from noise_ec_tpu.gf.bitmatrix import (  # noqa: F401
+    constant_bitmatrix,
+    expand_generator_bits,
+    expand_generator_masks,
+    gf2_matmul_planes,
+    pack_bitplanes,
+    unpack_bitplanes,
+)

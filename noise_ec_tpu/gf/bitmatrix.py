@@ -1,0 +1,124 @@
+"""The bitsliced view of GF(2^m): constants as GF(2) bit-matrices.
+
+Multiplication by a constant c in GF(2^m) is linear over GF(2): writing a field
+element x as the bit-vector (x_0 .. x_{m-1}), there is an m x m binary matrix
+M_c with bits(c * x) = M_c @ bits(x) (mod 2). Expanding every entry of an
+r x k generator matrix G into its M_c block turns the whole RS encode
+(parity = G_parity @ data over GF(2^m), reference hot loop main.go:262)
+into ONE binary matrix multiply:
+
+    parity_planes (m*r, W) = B (m*r, m*k) @ data_planes (m*k, W)   over GF(2)
+
+where data_planes is the *bitplane* layout: plane (j*m + i) holds bit i of
+every symbol of shard j, packed 32 symbol-positions per uint32 word. On the
+TPU this binary matmul is pure AND/XOR on 32-bit lanes — no gathers, no
+byte-granular multiplies — which is why the Pallas kernels use this layout
+(SURVEY.md §7.4 "bitsliced formulation").
+
+This module is the NumPy host-side reference for that machinery; the JAX /
+Pallas equivalents in ``noise_ec_tpu.ops`` are tested bit-exactly against it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from noise_ec_tpu.gf.field import GF
+
+WORD_BITS = 32
+
+
+def constant_bitmatrix(gf: GF, c: int) -> np.ndarray:
+    """The m x m GF(2) matrix M_c with bits(c*x) = M_c @ bits(x).
+
+    Column j of M_c is the bit-vector of c * 2^j.
+    """
+    m = gf.degree
+    cols = gf.mul(c, (1 << np.arange(m)).astype(np.int64))  # (m,) values c * 2^j
+    out = np.zeros((m, m), dtype=np.uint8)
+    for j in range(m):
+        v = int(cols[j])
+        for i in range(m):
+            out[i, j] = (v >> i) & 1
+    return out
+
+
+def expand_generator_bits(gf: GF, G: np.ndarray) -> np.ndarray:
+    """Expand an (r, k) GF generator matrix to its (m*r, m*k) GF(2) form."""
+    G = np.asarray(G)
+    r, k = G.shape
+    m = gf.degree
+    out = np.zeros((m * r, m * k), dtype=np.uint8)
+    for i in range(r):
+        for j in range(k):
+            out[i * m : (i + 1) * m, j * m : (j + 1) * m] = constant_bitmatrix(
+                gf, int(G[i, j])
+            )
+    return out
+
+
+def expand_generator_masks(gf: GF, G: np.ndarray) -> np.ndarray:
+    """Like :func:`expand_generator_bits` but as uint32 select-masks.
+
+    0xFFFFFFFF where the bit is set, 0 elsewhere — the operand shape the
+    AND/XOR kernels consume directly.
+    """
+    bits = expand_generator_bits(gf, G)
+    return (bits.astype(np.uint32) * np.uint32(0xFFFFFFFF)).astype(np.uint32)
+
+
+# ---------------------------------------------------------------------------
+# Bitplane packing
+
+
+def packed_words(num_symbols: int) -> int:
+    return -(-num_symbols // WORD_BITS)
+
+
+def pack_bitplanes(shards: np.ndarray, gf: GF) -> np.ndarray:
+    """(k, S) symbols -> (k*m, W) packed uint32 bitplanes.
+
+    Bit t of word w of plane (j*m + i) is bit i of symbol shards[j, 32w + t].
+    Symbol counts not divisible by 32 are zero-padded (unpack slices off).
+    """
+    shards = np.atleast_2d(np.asarray(shards, dtype=gf.dtype))
+    k, S = shards.shape
+    m = gf.degree
+    W = packed_words(S)
+    if W * WORD_BITS != S:
+        pad = np.zeros((k, W * WORD_BITS - S), dtype=gf.dtype)
+        shards = np.concatenate([shards, pad], axis=1)
+    # (k, m, W*32) bit tensor
+    bits = (shards[:, None, :].astype(np.uint32) >> np.arange(m, dtype=np.uint32)[None, :, None]) & 1
+    bits = bits.reshape(k * m, W, WORD_BITS)
+    shifted = bits << np.arange(WORD_BITS, dtype=np.uint32)[None, None, :]
+    return np.bitwise_or.reduce(shifted, axis=-1).astype(np.uint32)
+
+
+def unpack_bitplanes(planes: np.ndarray, num_shards: int, num_symbols: int, gf: GF) -> np.ndarray:
+    """(k*m, W) packed uint32 bitplanes -> (k, S) symbols. Inverse of pack."""
+    planes = np.asarray(planes, dtype=np.uint32)
+    m = gf.degree
+    km, W = planes.shape
+    if km != num_shards * m:
+        raise ValueError(f"plane count {km} != {num_shards} shards x {m} bits")
+    bits = (planes[:, :, None] >> np.arange(WORD_BITS, dtype=np.uint32)[None, None, :]) & 1
+    bits = bits.reshape(num_shards, m, W * WORD_BITS)[:, :, :num_symbols]
+    shifted = bits.astype(np.uint32) << np.arange(m, dtype=np.uint32)[None, :, None]
+    return np.bitwise_or.reduce(shifted, axis=1).astype(gf.dtype)
+
+
+def gf2_matmul_planes(bits: np.ndarray, planes: np.ndarray) -> np.ndarray:
+    """Binary matmul: (R, C) 0/1 matrix x (C, W) packed planes -> (R, W).
+
+    NumPy reference for the TPU kernel: out[r] = XOR over {c : bits[r,c]=1}
+    of planes[c].
+    """
+    bits = np.asarray(bits, dtype=np.uint8)
+    planes = np.asarray(planes, dtype=np.uint32)
+    R, C = bits.shape
+    out = np.zeros((R, planes.shape[1]), dtype=np.uint32)
+    for c in range(C):
+        rows = bits[:, c] != 0
+        out[rows] ^= planes[c]
+    return out

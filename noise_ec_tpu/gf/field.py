@@ -1,0 +1,146 @@
+"""GF(2^m) arithmetic over log/exp tables (host-side reference path).
+
+The reference delegates all field arithmetic to ``vivint/infectious``
+(call sites /root/reference/main.go:57-61, 73-77, 248-266). This module is the
+framework's own ground-truth implementation: vectorized NumPy arithmetic used
+by the golden codec, the generator-matrix builders, and for cross-checking the
+bitsliced TPU kernels bit-exactly.
+
+Field choices:
+
+- GF(2^8) with the primitive polynomial x^8+x^4+x^3+x^2+1 (0x11D) — the
+  polynomial used by klauspost/reedsolomon (the BASELINE.json comparison bar)
+  and by most storage RS codes.
+- GF(2^16) with x^16+x^12+x^3+x+1 (0x1100B) for the wide-field variant
+  (BASELINE.json config 4).
+
+Both have alpha = 2 as a primitive element (asserted at table-build time).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# Primitive polynomials, sans the leading term (the reduction masks include it).
+POLY_GF256 = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1
+POLY_GF65536 = 0x1100B  # x^16 + x^12 + x^3 + x + 1
+
+
+class GF:
+    """A binary extension field GF(2^degree) with log/exp tables.
+
+    All element-wise operations accept NumPy arrays (any shape) or Python ints
+    and broadcast like NumPy ufuncs. Elements are represented as unsigned
+    integers in [0, order).
+    """
+
+    def __init__(self, degree: int, poly: int):
+        if degree not in (8, 16):
+            raise ValueError(f"unsupported field degree {degree}")
+        self.degree = degree
+        self.poly = poly
+        self.order = 1 << degree
+        self.dtype = np.uint8 if degree == 8 else np.uint16
+        self._build_tables()
+
+    def _build_tables(self) -> None:
+        order = self.order
+        exp = np.zeros(2 * (order - 1), dtype=np.int32)
+        log = np.zeros(order, dtype=np.int32)
+        x = 1
+        for i in range(order - 1):
+            exp[i] = x
+            log[x] = i
+            x <<= 1
+            if x & order:
+                x ^= self.poly
+        if x != 1:
+            raise AssertionError(f"2 is not primitive for poly {self.poly:#x}")
+        # Double-length exp table lets mul index log[a]+log[b] without a mod.
+        exp[order - 1 :] = exp[: order - 1]
+        self.exp = exp
+        self.log = log
+
+    # -- scalar/element-wise ops ------------------------------------------
+
+    def mul(self, a, b):
+        """Element-wise GF product, broadcasting."""
+        a = np.asarray(a, dtype=np.int32)
+        b = np.asarray(b, dtype=np.int32)
+        out = self.exp[self.log[a] + self.log[b]]
+        out = np.where((a == 0) | (b == 0), 0, out)
+        return out.astype(self.dtype)
+
+    def div(self, a, b):
+        a = np.asarray(a, dtype=np.int32)
+        b = np.asarray(b, dtype=np.int32)
+        if np.any(b == 0):
+            raise ZeroDivisionError("division by zero in GF")
+        out = self.exp[self.log[a] - self.log[b] + (self.order - 1)]
+        out = np.where(a == 0, 0, out)
+        return out.astype(self.dtype)
+
+    def inv(self, a):
+        a = np.asarray(a, dtype=np.int32)
+        if np.any(a == 0):
+            raise ZeroDivisionError("inverse of zero in GF")
+        return self.exp[(self.order - 1) - self.log[a]].astype(self.dtype)
+
+    def pow(self, a, e: int):
+        """a ** e with 0**0 == 1 (Vandermonde convention)."""
+        a = np.asarray(a, dtype=np.int32)
+        e = int(e)
+        if e == 0:
+            return np.ones_like(a).astype(self.dtype)
+        out = self.exp[(self.log[a].astype(np.int64) * e) % (self.order - 1)]
+        out = np.where(a == 0, 0, out)
+        return out.astype(self.dtype)
+
+    def add(self, a, b):
+        """Addition == subtraction == XOR in characteristic 2."""
+        return (np.asarray(a, dtype=self.dtype) ^ np.asarray(b, dtype=self.dtype)).astype(
+            self.dtype
+        )
+
+    # -- linear algebra helpers -------------------------------------------
+
+    def matmul(self, A, B):
+        """GF matrix product. A: (r, k), B: (k, c) -> (r, c).
+
+        Vectorized: products via log/exp, accumulation via XOR-reduce.
+        """
+        A = np.asarray(A, dtype=np.int32)
+        B = np.asarray(B, dtype=np.int32)
+        prod = self.mul(A[:, :, None], B[None, :, :])  # (r, k, c)
+        return np.bitwise_xor.reduce(prod.astype(np.int64), axis=1).astype(self.dtype)
+
+    def matvec_stripes(self, A, D):
+        """A @ D where D holds one stripe per row. A: (r, k), D: (k, S) -> (r, S).
+
+        This IS the encode hot loop shape (reference main.go:262): parity
+        stripes = generator-parity-rows x data stripes. Row-blocked to bound
+        the (r, k, S) intermediate.
+        """
+        A = np.asarray(A, dtype=np.int32)
+        D = np.asarray(D, dtype=np.int32)
+        r, k = A.shape
+        out = np.empty((r, D.shape[1]), dtype=self.dtype)
+        for i in range(r):
+            prod = self.mul(A[i][:, None], D)  # (k, S)
+            out[i] = np.bitwise_xor.reduce(prod.astype(np.int64), axis=0).astype(self.dtype)
+        return out
+
+
+@functools.lru_cache(maxsize=None)
+def _field(degree: int, poly: int) -> GF:
+    return GF(degree, poly)
+
+
+def GF256() -> GF:
+    return _field(8, POLY_GF256)
+
+
+def GF65536() -> GF:
+    return _field(16, POLY_GF65536)

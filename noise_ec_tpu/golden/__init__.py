@@ -1,0 +1,8 @@
+"""Slow, obviously-correct NumPy reference codec — the ground-truth anchor.
+
+The reference trusts ``vivint/infectious`` entirely (SURVEY.md §4 "codec
+ground truth"); this framework generates its own: every faster path (jitted
+JAX, Pallas kernels, the C++ shim) is tested bit-exactly against this codec.
+"""
+
+from noise_ec_tpu.golden.codec import GoldenCodec  # noqa: F401

@@ -1,0 +1,229 @@
+"""Pure-NumPy Reed-Solomon codec over GF(2^8)/GF(2^16) — ground truth.
+
+Capabilities mirrored from the reference's codec contract (SURVEY.md §2.3 D1,
+call sites /root/reference/main.go:57-61, 73-77, 248-266):
+
+- ``NewFEC(required, total)``-style construction with validation,
+- systematic encode (shares 0..k-1 are the data split),
+- decode from any >= k shares, with *error detection and correction* when
+  extra shares are present (infectious performs Berlekamp-Welch; here the
+  golden codec uses exhaustive consistent-subset search, which has the same
+  unique-decoding guarantee floor((m - k)/2) for m received shares and is
+  obviously correct — the property the ground truth is for),
+- erasure reconstruction of any missing shard rows.
+
+Everything is small-scale NumPy; the fast paths live in ``noise_ec_tpu.ops``.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional, Sequence
+
+import numpy as np
+
+from noise_ec_tpu.gf.field import GF, GF256, GF65536
+from noise_ec_tpu.matrix.generators import generator_matrix
+from noise_ec_tpu.matrix.linalg import gf_inv, reconstruction_matrix
+
+
+class NotEnoughShardsError(ValueError):
+    pass
+
+
+class TooManyErrorsError(ValueError):
+    pass
+
+
+_FIELDS = {"gf256": GF256, "gf65536": GF65536}
+
+
+class GoldenCodec:
+    """Reference RS(k, n) codec.
+
+    Parameters
+    ----------
+    k: minimum shards needed to reconstruct (``minimumNeededShards``,
+       reference main.go:35 default 4).
+    n: total shards (``totalShards``, main.go:34 default 6).
+    field: "gf256" (default) or "gf65536".
+    matrix: "cauchy" (default), "vandermonde", or "par1".
+    """
+
+    def __init__(self, k: int, n: int, field: str = "gf256", matrix: str = "cauchy"):
+        if field not in _FIELDS:
+            raise ValueError(f"unknown field {field!r}")
+        self.gf: GF = _FIELDS[field]()
+        self.k = int(k)
+        self.n = int(n)
+        self.field = field
+        self.matrix_kind = matrix
+        self.G = generator_matrix(self.gf, self.k, self.n, matrix)
+        self.systematic = bool(
+            np.array_equal(self.G[: self.k], np.eye(self.k, dtype=self.gf.dtype))
+        )
+
+    # -- array-level API ---------------------------------------------------
+
+    def encode(self, data_shards: np.ndarray) -> np.ndarray:
+        """(k, S) data -> (n-k, S) parity rows (systematic constructions)."""
+        data_shards = self._check_data(data_shards)
+        if not self.systematic:
+            raise ValueError("encode() requires a systematic matrix; use encode_all()")
+        return self.gf.matvec_stripes(self.G[self.k :], data_shards)
+
+    def encode_all(self, data_shards: np.ndarray) -> np.ndarray:
+        """(k, S) data -> full (n, S) codeword (works for any construction)."""
+        data_shards = self._check_data(data_shards)
+        return self.gf.matvec_stripes(self.G, data_shards)
+
+    def verify(self, shards: np.ndarray) -> bool:
+        """True iff the (n, S) codeword is consistent with its data rows."""
+        shards = np.asarray(shards, dtype=self.gf.dtype)
+        if shards.shape[0] != self.n:
+            raise ValueError(f"verify needs all {self.n} rows, got {shards.shape[0]}")
+        if not self.systematic:
+            dec = self.decode_shares(list(enumerate(shards)), error_correction=False)
+            return bool(np.array_equal(self.encode_all(dec), shards))
+        expect = self.encode(shards[: self.k])
+        return bool(np.array_equal(expect, shards[self.k :]))
+
+    def reconstruct(
+        self, shards: Sequence[Optional[np.ndarray]], data_only: bool = False
+    ) -> list[np.ndarray]:
+        """Fill in missing rows (None entries) from any k present rows.
+
+        Mirrors klauspost ``Reconstruct``/``ReconstructData`` (the BASELINE
+        metric's second config). Erasure-only: present rows are trusted.
+        """
+        shards = list(shards)
+        if len(shards) != self.n:
+            raise ValueError(f"expected {self.n} entries, got {len(shards)}")
+        present = [i for i, s in enumerate(shards) if s is not None]
+        if len(present) < self.k:
+            raise NotEnoughShardsError(
+                f"have {len(present)} shards, need {self.k}"
+            )
+        limit = self.k if data_only else self.n
+        missing = [i for i in range(limit) if shards[i] is None]
+        if not missing:
+            return shards
+        # Prefer the first k present rows, but fall back to other k-subsets:
+        # non-MDS constructions (par1) can have singular submatrices for
+        # recoverable patterns.
+        R = None
+        for basis in itertools.combinations(present, self.k):
+            try:
+                R = reconstruction_matrix(self.gf, self.G, list(basis), missing)
+                break
+            except np.linalg.LinAlgError:
+                continue
+        if R is None:
+            raise TooManyErrorsError(
+                "no invertible k-subset of present shards (non-MDS matrix?)"
+            )
+        stack = np.stack([np.asarray(shards[i], dtype=self.gf.dtype) for i in basis])
+        filled = self.gf.matvec_stripes(R, stack)
+        for row, i in enumerate(missing):
+            shards[i] = filled[row]
+        return shards
+
+    def decode_shares(
+        self,
+        shares: Sequence[tuple[int, np.ndarray]],
+        error_correction: bool = True,
+        max_subsets: int = 20000,
+    ) -> np.ndarray:
+        """(number, stripe) pairs -> (k, S) original data rows.
+
+        With more than k shares and ``error_correction=True``, performs
+        consistent-subset search: finds a decoding that agrees with at least
+        m - floor((m - k)/2) of the m distinct received shares — the same
+        unique-decoding radius as Berlekamp-Welch (which infectious's
+        ``Decode`` implements; SURVEY.md §2.3 D1). Raises TooManyErrorsError
+        if no such decoding exists within ``max_subsets`` candidate subsets.
+        """
+        dedup: dict[int, np.ndarray] = {}
+        for num, data in shares:
+            num = int(num)
+            if not 0 <= num < self.n:
+                raise ValueError(f"share number {num} out of range [0, {self.n})")
+            arr = np.asarray(data, dtype=self.gf.dtype)
+            if num in dedup:
+                if not np.array_equal(dedup[num], arr):
+                    raise ValueError(f"conflicting copies of share {num}")
+                continue
+            dedup[num] = arr
+        if len(dedup) < self.k:
+            raise NotEnoughShardsError(f"have {len(dedup)} shares, need {self.k}")
+        nums = sorted(dedup)
+        stripes = {i: dedup[i] for i in nums}
+        m = len(nums)
+
+        def try_basis(basis: tuple[int, ...]) -> tuple[Optional[np.ndarray], int]:
+            # data = inv(G[basis]) @ survivors. (Not reconstruction_matrix:
+            # for non-systematic G the data is a pre-image, not codeword rows.)
+            try:
+                inv = gf_inv(self.gf, self.G[list(basis)])
+            except np.linalg.LinAlgError:
+                return None, -1  # singular basis (non-MDS matrix): skip
+            data = self.gf.matvec_stripes(
+                inv, np.stack([stripes[i] for i in basis])
+            )
+            # Count agreement across all received shares.
+            codeword = self.gf.matvec_stripes(self.G[nums], data)
+            agree = sum(
+                1 for row, i in enumerate(nums) if np.array_equal(codeword[row], stripes[i])
+            )
+            return data, agree
+
+        data, agree = try_basis(tuple(nums[: self.k]))
+        if agree == m:
+            return data
+        if not error_correction:
+            raise TooManyErrorsError(
+                "received shares are inconsistent"
+                if data is not None
+                else "singular share subset (non-MDS matrix)"
+            )
+        # Unique decoding: accept if agreement >= m - floor((m-k)/2).
+        needed = m - (m - self.k) // 2
+        best, best_agree = data, agree
+        for count, basis in enumerate(itertools.combinations(nums, self.k)):
+            if count >= max_subsets:
+                break
+            data, agree = try_basis(basis)
+            if agree > best_agree:
+                best, best_agree = data, agree
+            if agree >= needed:
+                return data
+        if best_agree >= needed:
+            return best
+        raise TooManyErrorsError(
+            f"no decoding agrees with >= {needed}/{m} shares (best {best_agree})"
+        )
+
+    # -- byte-level helpers ------------------------------------------------
+
+    def split(self, data: bytes) -> np.ndarray:
+        """Zero-pad bytes to a (k, S) symbol matrix (klauspost Split)."""
+        buf = np.frombuffer(data, dtype=np.uint8)
+        sym_bytes = self.gf.degree // 8
+        row_bytes = -(-len(buf) // (self.k * sym_bytes)) * sym_bytes
+        padded = np.zeros(self.k * row_bytes, dtype=np.uint8)
+        padded[: len(buf)] = buf
+        rows = padded.reshape(self.k, row_bytes)
+        if sym_bytes == 1:
+            return rows
+        return rows.view("<u2")
+
+    def join(self, data_shards: np.ndarray, out_len: int) -> bytes:
+        """Inverse of split: concatenate data rows, trim padding."""
+        arr = np.asarray(data_shards, dtype=self.gf.dtype)
+        return arr.tobytes()[:out_len]
+
+    def _check_data(self, data_shards: np.ndarray) -> np.ndarray:
+        arr = np.atleast_2d(np.asarray(data_shards, dtype=self.gf.dtype))
+        if arr.shape[0] != self.k:
+            raise ValueError(f"expected {self.k} data rows, got {arr.shape[0]}")
+        return arr

@@ -1,0 +1,16 @@
+"""Generator-matrix construction and GF linear algebra.
+
+The reference gets its generator matrix implicitly from
+``infectious.NewFEC(required, total)`` (/root/reference/main.go:248); this
+package builds ours explicitly — systematic Cauchy by default (every square
+submatrix of a Cauchy matrix is invertible, so any k of n shards reconstruct),
+plus the Vandermonde variants tracked by BASELINE.json config 4.
+"""
+
+from noise_ec_tpu.matrix.generators import (  # noqa: F401
+    cauchy_parity,
+    generator_matrix,
+    vandermonde_par1,
+    vandermonde_systematic,
+)
+from noise_ec_tpu.matrix.linalg import gf_inv, gf_solve, reconstruction_matrix  # noqa: F401

@@ -1,0 +1,98 @@
+"""Reed-Solomon generator-matrix constructions.
+
+All constructions produce an (n, k) *systematic* generator matrix G — the top
+k x k block is the identity, so shards 0..k-1 are the data split, matching the
+reference's observable contract (``infectious`` shares 0..k-1 are the data;
+SURVEY.md §2.3 D1) — except :func:`vandermonde_par1`, which reproduces the
+historically broken PAR1 layout for the BASELINE.json config-4 comparison.
+
+Constructions:
+
+- ``cauchy`` (default): parity block P[i, j] = 1 / (x_i + y_j) with
+  x_i = k + i, y_j = j. Every square submatrix of a Cauchy matrix is
+  nonsingular, so [I; P] is MDS for any k + r <= field order.
+- ``vandermonde``: klauspost-style systematic Vandermonde — build the raw
+  (n, k) Vandermonde V[r, c] = r^c, then right-multiply by inv(V[:k]) so the
+  top block becomes I. MDS for all geometries.
+- ``par1``: the PAR1 archive format's layout — identity on top, parity block
+  P[i, c] = (c+1)^i (a *transposed* Vandermonde). Unlike a plain Vandermonde
+  (whose square submatrices on distinct nodes are always nonsingular),
+  arbitrary row/column subsets of a transposed Vandermonde are *generalized*
+  Vandermonde minors, which can vanish in GF(2^8) — so [I; P] is not MDS for
+  all geometries. Kept (and tested for!) because BASELINE config 4 asks for
+  the Cauchy-vs-PAR1 comparison. Smallest failure we exhibit: k=10, erased
+  data shards {0, 9}, repaired from parity rows {0, 5}.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from noise_ec_tpu.gf.field import GF
+from noise_ec_tpu.matrix.linalg import gf_inv
+
+
+def _check_geometry(gf: GF, k: int, n: int) -> None:
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if n < k:
+        raise ValueError(f"n must be >= k, got n={n} k={k}")
+    if n > gf.order:
+        raise ValueError(f"n={n} exceeds field order {gf.order}")
+
+
+def cauchy_parity(gf: GF, k: int, r: int) -> np.ndarray:
+    """(r, k) Cauchy parity block: P[i, j] = inv(x_i ^ y_j), x_i=k+i, y_j=j."""
+    _check_geometry(gf, k, k + r)
+    x = (k + np.arange(r, dtype=np.int64))[:, None]
+    y = np.arange(k, dtype=np.int64)[None, :]
+    return gf.inv((x ^ y).astype(np.int64))
+
+
+def vandermonde_raw(gf: GF, k: int, n: int) -> np.ndarray:
+    """(n, k) raw Vandermonde: V[r, c] = r^c (0^0 == 1)."""
+    _check_geometry(gf, k, n)
+    out = np.zeros((n, k), dtype=gf.dtype)
+    for c in range(k):
+        out[:, c] = gf.pow(np.arange(n, dtype=np.int64), c)
+    return out
+
+
+def vandermonde_systematic(gf: GF, k: int, n: int) -> np.ndarray:
+    """(n, k) systematic Vandermonde: V @ inv(V[:k]). Top block is I; MDS."""
+    V = vandermonde_raw(gf, k, n)
+    return gf.matmul(V, gf_inv(gf, V[:k]))
+
+
+def vandermonde_par1(gf: GF, k: int, n: int) -> np.ndarray:
+    """PAR1-style generator: identity top, parity P[i, c] = (c+1)^i.
+
+    Historically broken: some erasure patterns hit singular generalized-
+    Vandermonde minors and are unrecoverable. Provided for the BASELINE
+    config-4 comparison; ``tests/test_matrix.py`` demonstrates a failing
+    geometry (k=10, data erasures {0, 9} repaired via parity rows {0, 5}).
+    """
+    _check_geometry(gf, k, n)
+    G = np.zeros((n, k), dtype=gf.dtype)
+    G[:k] = np.eye(k, dtype=gf.dtype)
+    nodes = np.arange(1, k + 1, dtype=np.int64)
+    for i in range(n - k):
+        G[k + i] = gf.pow(nodes, i)
+    return G
+
+
+def generator_matrix(gf: GF, k: int, n: int, kind: str = "cauchy") -> np.ndarray:
+    """(n, k) generator matrix of the requested construction."""
+    _check_geometry(gf, k, n)
+    r = n - k
+    if kind == "cauchy":
+        G = np.zeros((n, k), dtype=gf.dtype)
+        G[:k] = np.eye(k, dtype=gf.dtype)
+        if r:
+            G[k:] = cauchy_parity(gf, k, r)
+        return G
+    if kind == "vandermonde":
+        return vandermonde_systematic(gf, k, n)
+    if kind == "par1":
+        return vandermonde_par1(gf, k, n)
+    raise ValueError(f"unknown generator kind {kind!r}")

@@ -1,0 +1,126 @@
+"""Field-arithmetic ground truth tests: GF tables, bitmatrices, bitplanes."""
+
+import numpy as np
+import pytest
+
+from noise_ec_tpu.gf.field import GF256, GF65536
+from noise_ec_tpu.gf import bitmatrix as bm
+
+
+@pytest.fixture(params=["gf256", "gf65536"])
+def gf(request):
+    return GF256() if request.param == "gf256" else GF65536()
+
+
+def _slow_mul(poly, order, a, b):
+    """Carry-less multiply + reduction, no tables — independent oracle."""
+    res = 0
+    while b:
+        if b & 1:
+            res ^= a
+        b >>= 1
+        a <<= 1
+        if a & order:
+            a ^= poly
+    return res
+
+
+def test_tables_match_slow_mul_gf256():
+    gf = GF256()
+    rng = np.random.default_rng(1)
+    for _ in range(500):
+        a, b = int(rng.integers(256)), int(rng.integers(256))
+        assert int(gf.mul(a, b)) == _slow_mul(gf.poly, gf.order, a, b)
+
+
+def test_tables_match_slow_mul_gf65536():
+    gf = GF65536()
+    rng = np.random.default_rng(2)
+    for _ in range(200):
+        a, b = int(rng.integers(65536)), int(rng.integers(65536))
+        assert int(gf.mul(a, b)) == _slow_mul(gf.poly, gf.order, a, b)
+
+
+def test_field_axioms(gf, rng):
+    a = rng.integers(0, gf.order, size=64)
+    b = rng.integers(0, gf.order, size=64)
+    c = rng.integers(0, gf.order, size=64)
+    # Commutativity / associativity / distributivity.
+    assert np.array_equal(gf.mul(a, b), gf.mul(b, a))
+    assert np.array_equal(gf.mul(gf.mul(a, b), c), gf.mul(a, gf.mul(b, c)))
+    assert np.array_equal(
+        gf.mul(a, b ^ c), gf.mul(a, b) ^ gf.mul(a, c)
+    )
+    # Identity and zero.
+    assert np.array_equal(gf.mul(a, 1), a.astype(gf.dtype))
+    assert np.all(gf.mul(a, 0) == 0)
+
+
+def test_inverse(gf, rng):
+    a = rng.integers(1, gf.order, size=128)
+    assert np.all(gf.mul(a, gf.inv(a)) == 1)
+    assert np.all(gf.div(gf.mul(a, 7), a) == 7)
+
+
+def test_pow(gf):
+    assert int(gf.pow(0, 0)) == 1  # Vandermonde convention
+    assert int(gf.pow(5, 1)) == 5
+    assert int(gf.pow(3, 3)) == int(gf.mul(3, gf.mul(3, 3)))
+
+
+def test_matmul_identity(gf, rng):
+    A = rng.integers(0, gf.order, size=(5, 5))
+    I = np.eye(5, dtype=gf.dtype)
+    assert np.array_equal(gf.matmul(A, I), A.astype(gf.dtype))
+    assert np.array_equal(gf.matmul(I, A), A.astype(gf.dtype))
+
+
+def test_matvec_stripes_matches_matmul(gf, rng):
+    A = rng.integers(0, gf.order, size=(3, 7))
+    D = rng.integers(0, gf.order, size=(7, 40))
+    assert np.array_equal(gf.matvec_stripes(A, D), gf.matmul(A, D))
+
+
+# -- bitmatrix / bitplane machinery ---------------------------------------
+
+
+def test_constant_bitmatrix_is_multiplication(gf, rng):
+    for _ in range(20):
+        c = int(rng.integers(0, gf.order))
+        M = bm.constant_bitmatrix(gf, c)
+        x = int(rng.integers(0, gf.order))
+        xbits = np.array([(x >> i) & 1 for i in range(gf.degree)], dtype=np.uint8)
+        ybits = (M @ xbits) % 2
+        y = sum(int(b) << i for i, b in enumerate(ybits))
+        assert y == int(gf.mul(c, x))
+
+
+def test_pack_unpack_roundtrip(gf, rng):
+    shards = rng.integers(0, gf.order, size=(3, 101)).astype(gf.dtype)
+    planes = bm.pack_bitplanes(shards, gf)
+    assert planes.dtype == np.uint32
+    assert planes.shape == (3 * gf.degree, bm.packed_words(101))
+    back = bm.unpack_bitplanes(planes, 3, 101, gf)
+    assert np.array_equal(back, shards)
+
+
+def test_bitsliced_encode_matches_field_encode(gf, rng):
+    """The load-bearing equivalence: GF matmul == binary matmul on planes."""
+    k, r, S = 4, 3, 96
+    G = rng.integers(0, gf.order, size=(r, k))
+    D = rng.integers(0, gf.order, size=(k, S)).astype(gf.dtype)
+    want = gf.matvec_stripes(G, D)
+
+    B = bm.expand_generator_bits(gf, G)
+    planes = bm.pack_bitplanes(D, gf)
+    out_planes = bm.gf2_matmul_planes(B, planes)
+    got = bm.unpack_bitplanes(out_planes, r, S, gf)
+    assert np.array_equal(got, want)
+
+
+def test_expand_masks(gf):
+    G = np.array([[1, 2], [3, 0]])
+    bits = bm.expand_generator_bits(gf, G)
+    masks = bm.expand_generator_masks(gf, G)
+    assert np.array_equal(masks != 0, bits != 0)
+    assert set(np.unique(masks)) <= {0, 0xFFFFFFFF}

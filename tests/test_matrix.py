@@ -1,0 +1,81 @@
+"""Generator-matrix properties: MDS guarantees, inversion, reconstruction."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from noise_ec_tpu.gf.field import GF256, GF65536
+from noise_ec_tpu.matrix.generators import generator_matrix, vandermonde_par1
+from noise_ec_tpu.matrix.linalg import gf_inv, reconstruction_matrix
+
+
+def test_cauchy_systematic_top_identity():
+    gf = GF256()
+    G = generator_matrix(gf, 4, 6, "cauchy")
+    assert np.array_equal(G[:4], np.eye(4, dtype=np.uint8))
+
+
+@pytest.mark.parametrize("kind", ["cauchy", "vandermonde"])
+@pytest.mark.parametrize("k,n", [(4, 6), (10, 14), (3, 8)])
+def test_mds_every_k_subset_invertible(kind, k, n):
+    """Any k rows of the generator must be invertible (any k shards decode)."""
+    gf = GF256()
+    G = generator_matrix(gf, k, n, kind)
+    for rows in itertools.combinations(range(n), k):
+        gf_inv(gf, G[list(rows)])  # raises if singular
+
+
+def test_mds_gf65536_spot():
+    gf = GF65536()
+    G = generator_matrix(gf, 10, 14, "cauchy")
+    rng = np.random.default_rng(3)
+    for _ in range(30):
+        rows = sorted(rng.choice(14, size=10, replace=False))
+        gf_inv(gf, G[rows])
+
+
+def test_par1_has_singular_submatrix():
+    """Documents the PAR1 flaw: k=10, n=16, lose data {0, 9}, keep parity
+    rows {10, 15} -> singular k-row submatrix (found by exhaustive search;
+    the Cauchy construction passes the same pattern by the MDS test above)."""
+    gf = GF256()
+    V = vandermonde_par1(gf, 10, 16)
+    rows = [1, 2, 3, 4, 5, 6, 7, 8, 10, 15]  # data minus {0,9}, parity {0,5}
+    with pytest.raises(np.linalg.LinAlgError):
+        gf_inv(gf, V[rows])
+    # Sanity: PAR1 is systematic and works for benign patterns.
+    assert np.array_equal(V[:10], np.eye(10, dtype=np.uint8))
+    gf_inv(gf, V[[0, 1, 2, 3, 4, 5, 6, 7, 8, 10]])
+
+
+def test_gf_inv_roundtrip():
+    gf = GF256()
+    rng = np.random.default_rng(4)
+    for _ in range(20):
+        A = rng.integers(0, 256, size=(6, 6))
+        try:
+            Ainv = gf_inv(gf, A)
+        except np.linalg.LinAlgError:
+            continue
+        assert np.array_equal(gf.matmul(A, Ainv), np.eye(6, dtype=np.uint8))
+
+
+def test_reconstruction_matrix_identity_when_present_is_data():
+    gf = GF256()
+    G = generator_matrix(gf, 4, 6, "cauchy")
+    R = reconstruction_matrix(gf, G, [0, 1, 2, 3], [0, 1, 2, 3])
+    assert np.array_equal(R, np.eye(4, dtype=np.uint8))
+
+
+def test_reconstruction_matrix_recovers():
+    gf = GF256()
+    G = generator_matrix(gf, 4, 6, "cauchy")
+    rng = np.random.default_rng(5)
+    D = rng.integers(0, 256, size=(4, 32)).astype(np.uint8)
+    codeword = gf.matvec_stripes(G, D)
+    # Lose shards 1 and 3; recover them from 0, 2, 4, 5.
+    present = [0, 2, 4, 5]
+    R = reconstruction_matrix(gf, G, present, [1, 3])
+    got = gf.matvec_stripes(R, codeword[present])
+    assert np.array_equal(got, codeword[[1, 3]])
