@@ -23,7 +23,9 @@ import functools
 
 import numpy as np
 
-# Primitive polynomials, sans the leading term (the reduction masks include it).
+# Primitive polynomials, full form including the leading x^8 / x^16 term —
+# the reduction step (x ^= poly when the overflow bit is set) relies on the
+# leading bit to clear the overflow.
 POLY_GF256 = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1
 POLY_GF65536 = 0x1100B  # x^16 + x^12 + x^3 + x + 1
 

@@ -83,13 +83,19 @@ class GoldenCodec:
         if shards.shape[0] != self.n:
             raise ValueError(f"verify needs all {self.n} rows, got {shards.shape[0]}")
         if not self.systematic:
-            dec = self.decode_shares(list(enumerate(shards)), error_correction=False)
+            try:
+                dec = self.decode_shares(list(enumerate(shards)), error_correction=False)
+            except TooManyErrorsError:
+                return False
             return bool(np.array_equal(self.encode_all(dec), shards))
         expect = self.encode(shards[: self.k])
         return bool(np.array_equal(expect, shards[self.k :]))
 
     def reconstruct(
-        self, shards: Sequence[Optional[np.ndarray]], data_only: bool = False
+        self,
+        shards: Sequence[Optional[np.ndarray]],
+        data_only: bool = False,
+        max_subsets: int = 20000,
     ) -> list[np.ndarray]:
         """Fill in missing rows (None entries) from any k present rows.
 
@@ -112,7 +118,9 @@ class GoldenCodec:
         # non-MDS constructions (par1) can have singular submatrices for
         # recoverable patterns.
         R = None
-        for basis in itertools.combinations(present, self.k):
+        for count, basis in enumerate(itertools.combinations(present, self.k)):
+            if count >= max_subsets:
+                break
             try:
                 R = reconstruction_matrix(self.gf, self.G, list(basis), missing)
                 break
@@ -177,30 +185,43 @@ class GoldenCodec:
             )
             return data, agree
 
-        data, agree = try_basis(tuple(nums[: self.k]))
-        if agree == m:
-            return data
-        if not error_correction:
-            raise TooManyErrorsError(
-                "received shares are inconsistent"
-                if data is not None
-                else "singular share subset (non-MDS matrix)"
-            )
-        # Unique decoding: accept if agreement >= m - floor((m-k)/2).
+        # Unique-decoding acceptance threshold: agreement with at least
+        # m - floor((m-k)/2) of the m received shares.
         needed = m - (m - self.k) // 2
-        best, best_agree = data, agree
+
+        def judge(data, agree):
+            """Returns data to accept, or None to keep searching."""
+            if agree == m:
+                return data
+            if not error_correction:
+                raise TooManyErrorsError("received shares are inconsistent")
+            return data if agree >= needed else None
+
+        first = tuple(nums[: self.k])
+        data, agree = try_basis(first)
+        if data is not None:
+            accepted = judge(data, agree)
+            if accepted is not None:
+                return accepted
+        # One bounded scan handles both jobs: find an invertible basis when
+        # the first k-subset is singular (non-MDS matrices, e.g. par1), and
+        # search for a decoding within the unique-decoding radius.
         for count, basis in enumerate(itertools.combinations(nums, self.k)):
             if count >= max_subsets:
                 break
-            data, agree = try_basis(basis)
-            if agree > best_agree:
-                best, best_agree = data, agree
-            if agree >= needed:
-                return data
-        if best_agree >= needed:
-            return best
+            if basis == first:  # already evaluated above
+                continue
+            d2, a2 = try_basis(basis)
+            if d2 is None:
+                continue
+            accepted = judge(d2, a2)
+            if accepted is not None:
+                return accepted
+            data = d2  # remember that an invertible basis exists
+        if data is None:
+            raise TooManyErrorsError("no invertible share subset (non-MDS matrix?)")
         raise TooManyErrorsError(
-            f"no decoding agrees with >= {needed}/{m} shares (best {best_agree})"
+            f"no decoding agrees with >= {needed}/{m} shares"
         )
 
     # -- byte-level helpers ------------------------------------------------

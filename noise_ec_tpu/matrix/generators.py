@@ -93,6 +93,11 @@ def generator_matrix(gf: GF, k: int, n: int, kind: str = "cauchy") -> np.ndarray
         return G
     if kind == "vandermonde":
         return vandermonde_systematic(gf, k, n)
+    if kind == "vandermonde_raw":
+        # Non-systematic evaluation code: codeword row r is the data
+        # polynomial evaluated at point r. MDS (distinct nodes), but data is
+        # a pre-image, not rows 0..k-1.
+        return vandermonde_raw(gf, k, n)
     if kind == "par1":
         return vandermonde_par1(gf, k, n)
     raise ValueError(f"unknown generator kind {kind!r}")

@@ -1,0 +1,21 @@
+"""JAX/XLA/Pallas compute kernels — the TPU hot path.
+
+The reference's hot loops are ``infectious``'s GF(2^8) generator-matrix
+multiply (encode, /root/reference/main.go:262) and submatrix-inversion x
+multiply (decode, main.go:77), CPU table/assembly code. Here both become ONE
+device primitive: a binary (GF(2)) matrix multiply over bitsliced shard
+planes — AND/XOR on 32-bit lanes, no gathers, no byte-granular multiplies
+(SURVEY.md §7.4).
+
+Layers:
+
+- ``bitops``   — bitplane pack/unpack on device (jnp)
+- ``gf2mm``    — jitted masked AND/XOR GF(2) matmul (pure XLA; runs anywhere)
+- ``pallas_gf2mm`` — the Pallas TPU kernel version (VMEM-tiled, grid over
+  stripe words); falls back to ``gf2mm`` off-TPU
+- ``dispatch`` — geometry-cached jitted encode/reconstruct entry points
+"""
+
+from noise_ec_tpu.ops.bitops import pack_bitplanes_jax, unpack_bitplanes_jax  # noqa: F401
+from noise_ec_tpu.ops.gf2mm import gf2_matmul_jax  # noqa: F401
+from noise_ec_tpu.ops.dispatch import DeviceCodec  # noqa: F401

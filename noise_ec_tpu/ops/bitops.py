@@ -1,0 +1,49 @@
+"""Device-side bitplane packing: (k, S) symbols <-> (k*m, W) uint32 planes.
+
+Same layout as the NumPy reference ``gf.bitmatrix.pack_bitplanes`` (tested
+bit-exact): bit t of word w of plane (j*m + i) is bit i of symbol
+shards[j, 32w + t]. Symbol axes are padded to multiples of 32 on the way in;
+``unpack`` takes the true symbol count and slices the padding back off.
+
+These are jnp implementations XLA fuses into a handful of elementwise
+kernels; the Pallas SWAR versions (``pallas_gf2mm``) exist for the
+throughput-critical fused paths.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+WORD_BITS = 32
+
+
+def _padded_words(num_symbols: int) -> int:
+    return -(-num_symbols // WORD_BITS)
+
+
+def pack_bitplanes_jax(shards: jnp.ndarray, degree: int) -> jnp.ndarray:
+    """(k, S) uint8/uint16 symbols -> (k*degree, ceil(S/32)) uint32 planes."""
+    k, S = shards.shape
+    W = _padded_words(S)
+    x = shards.astype(jnp.uint32)
+    if W * WORD_BITS != S:
+        x = jnp.pad(x, ((0, 0), (0, W * WORD_BITS - S)))
+    # (k, m, W*32) bits
+    bits = (x[:, None, :] >> jnp.arange(degree, dtype=jnp.uint32)[None, :, None]) & 1
+    bits = bits.reshape(k * degree, W, WORD_BITS)
+    # Bits are disjoint powers of two, so sum == bitwise-or.
+    weights = (jnp.uint32(1) << jnp.arange(WORD_BITS, dtype=jnp.uint32))[None, None, :]
+    return jnp.sum(bits * weights, axis=-1, dtype=jnp.uint32)
+
+
+def unpack_bitplanes_jax(
+    planes: jnp.ndarray, num_shards: int, num_symbols: int, degree: int
+) -> jnp.ndarray:
+    """(k*degree, W) uint32 planes -> (k, S) symbols. Inverse of pack."""
+    km, W = planes.shape
+    assert km == num_shards * degree, (km, num_shards, degree)
+    bits = (planes[:, :, None] >> jnp.arange(WORD_BITS, dtype=jnp.uint32)[None, None, :]) & 1
+    bits = bits.reshape(num_shards, degree, W * WORD_BITS)[:, :, :num_symbols]
+    weights = (jnp.uint32(1) << jnp.arange(degree, dtype=jnp.uint32))[None, :, None]
+    out = jnp.sum(bits * weights, axis=1, dtype=jnp.uint32)
+    return out.astype(jnp.uint8 if degree == 8 else jnp.uint16)

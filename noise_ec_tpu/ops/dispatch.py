@@ -1,0 +1,155 @@
+"""Geometry-cached device codec: the bridge from GF matrices to TPU kernels.
+
+The reference changes RS geometry (k, n) at runtime per message
+(/root/reference/main.go:185-191), so kernels must be re-jitted per geometry
+with bounded caching (SURVEY.md §7.4 "dynamic geometry"). ``DeviceCodec``
+caches one fused (pack -> GF(2) matmul -> unpack) compiled program per
+(matrix, stripe-length, kernel) signature.
+
+Kernel selection:
+
+- "pallas" (default on TPU): the geometry-specialized sparse Pallas kernel —
+  the matrix's bit pattern is baked into the program as XOR chains; runs at
+  the HBM roofline on v5e.
+- "xla": masked AND/XOR fori_loop — portable, used for CPU tests and as the
+  shape-generic fallback.
+- "pallas_interpret": Pallas interpreter mode (CPU debugging).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from noise_ec_tpu.gf.bitmatrix import expand_generator_bits, expand_generator_masks
+from noise_ec_tpu.gf.field import GF, GF256, GF65536
+from noise_ec_tpu.ops.bitops import pack_bitplanes_jax, unpack_bitplanes_jax
+from noise_ec_tpu.ops.gf2mm import gf2_matmul_jax
+from noise_ec_tpu.ops.pallas_gf2mm import (
+    bits_to_rows,
+    gf2_matmul_pallas_sparse_rows,
+    planes_to_tiled,
+    tiled_to_planes,
+)
+
+_FIELDS = {"gf256": GF256, "gf65536": GF65536}
+
+
+def _resolve_kernel(kernel: str) -> str:
+    if kernel == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return kernel
+
+
+@functools.lru_cache(maxsize=256)
+def _fused_xla_fn(degree: int, r: int, k: int, S: int):
+    """Compiled (masks, shards) -> product stripes, shape-generic kernel."""
+
+    def f(masks, shards):
+        planes = pack_bitplanes_jax(shards, degree)
+        out = gf2_matmul_jax(masks, planes)
+        return unpack_bitplanes_jax(out, r, S, degree)
+
+    return jax.jit(f)
+
+
+@functools.lru_cache(maxsize=256)
+def _fused_sparse_fn(
+    degree: int, r: int, S: int, bits_rows: tuple, interpret: bool
+):
+    """Compiled shards -> product stripes with the matrix baked in."""
+
+    def f(shards):
+        planes = pack_bitplanes_jax(shards, degree)
+        W = planes.shape[1]
+        tiled = planes_to_tiled(planes)
+        out = gf2_matmul_pallas_sparse_rows(bits_rows, tiled, interpret=interpret)
+        return unpack_bitplanes_jax(tiled_to_planes(out, W), r, S, degree)
+
+    return jax.jit(f)
+
+
+class DeviceCodec:
+    """Runs GF matrix x stripes products on the default JAX device.
+
+    This one primitive is both reference hot loops: encode is
+    parity_rows @ data (main.go:262), reconstruct is
+    inverted_submatrix_rows @ survivors (main.go:77).
+    """
+
+    def __init__(self, field: str = "gf256", kernel: str = "auto"):
+        if field not in _FIELDS:
+            raise ValueError(f"unknown field {field!r}")
+        self.field = field
+        self.gf: GF = _FIELDS[field]()
+        self.kernel = _resolve_kernel(kernel)
+        if self.kernel not in ("pallas", "pallas_interpret", "xla"):
+            raise ValueError(f"unknown kernel {self.kernel!r}")
+        self._mask_cache: dict[bytes, np.ndarray] = {}
+        self._rows_cache: dict[bytes, tuple] = {}
+
+    def _key(self, M: np.ndarray) -> bytes:
+        return M.tobytes() + M.shape[1].to_bytes(4, "little")
+
+    def masks_for(self, M: np.ndarray) -> np.ndarray:
+        """(r, k) GF matrix -> (m*r, m*k) uint32 select-mask matrix, cached."""
+        M = np.ascontiguousarray(np.asarray(M, dtype=self.gf.dtype))
+        key = self._key(M)
+        hit = self._mask_cache.get(key)
+        if hit is None:
+            hit = expand_generator_masks(self.gf, M)
+            if len(self._mask_cache) > 4096:
+                self._mask_cache.clear()
+            self._mask_cache[key] = hit
+        return hit
+
+    def bits_rows_for(self, M: np.ndarray) -> tuple:
+        """(r, k) GF matrix -> hashable per-row term tuples for the sparse
+        kernel (cached)."""
+        M = np.ascontiguousarray(np.asarray(M, dtype=self.gf.dtype))
+        key = self._key(M)
+        hit = self._rows_cache.get(key)
+        if hit is None:
+            hit = bits_to_rows(expand_generator_bits(self.gf, M))
+            if len(self._rows_cache) > 4096:
+                self._rows_cache.clear()
+            self._rows_cache[key] = hit
+        return hit
+
+    def matmul_stripes(self, M: np.ndarray, D) -> np.ndarray:
+        """(r, k) GF matrix x (k, S) stripes -> (r, S), computed on device."""
+        M = np.asarray(M)
+        D = np.asarray(D, dtype=self.gf.dtype)
+        r, k = M.shape
+        if D.shape[0] != k:
+            raise ValueError(f"matrix cols {k} != stripe rows {D.shape[0]}")
+        S = D.shape[1]
+        m = self.gf.degree
+        if self.kernel == "xla":
+            fn = _fused_xla_fn(m, r, k, S)
+            out = fn(jnp.asarray(self.masks_for(M)), jnp.asarray(D))
+        else:
+            fn = _fused_sparse_fn(
+                m, r, S, self.bits_rows_for(M), self.kernel == "pallas_interpret"
+            )
+            out = fn(jnp.asarray(D))
+        return np.asarray(out)
+
+    def matmul_planes(self, M: np.ndarray, planes: jnp.ndarray) -> jnp.ndarray:
+        """Device-level entry on packed (C, W) planes (HBM-resident path).
+
+        Returns (m*r, W) planes on device; used by benches and the parallel
+        layer to avoid host round-trips.
+        """
+        W = planes.shape[1]
+        if self.kernel == "xla":
+            return gf2_matmul_jax(jnp.asarray(self.masks_for(np.asarray(M))), planes)
+        out = gf2_matmul_pallas_sparse_rows(
+            self.bits_rows_for(np.asarray(M)),
+            planes_to_tiled(planes),
+            interpret=self.kernel == "pallas_interpret",
+        )
+        return tiled_to_planes(out, W)

@@ -1,0 +1,39 @@
+"""GF(2) matrix multiply over packed bitplanes — pure-XLA version.
+
+out[r] = XOR over {c : mask[r, c] set} of planes[c]; masks are uint32
+select-masks (0 / 0xFFFFFFFF) from ``gf.bitmatrix.expand_generator_masks``.
+One accumulate step per input plane: acc ^= mask[:, c] & planes[c] — an
+AND+XOR on full 32-bit VPU lanes. XLA keeps the accumulator on-chip and
+fuses the loop body; the Pallas version adds explicit VMEM tiling.
+
+This single primitive is BOTH hot loops of the reference (encode
+main.go:262, reconstruct main.go:77): only the mask matrix changes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def gf2_matmul_jax(masks: jnp.ndarray, planes: jnp.ndarray) -> jnp.ndarray:
+    """(R, C) uint32 masks x (C, W) uint32 planes -> (R, W) uint32.
+
+    Shapes are static under jit; the loop is a lax.fori_loop so the unrolled
+    program size stays O(1) in C.
+    """
+    R, C = masks.shape
+    Cp, W = planes.shape
+    if C != Cp:
+        raise ValueError(f"masks cols {C} != planes rows {Cp}")
+
+    def body(c, acc):
+        return acc ^ (masks[:, c][:, None] & planes[c][None, :])
+
+    init = jnp.zeros((R, W), dtype=jnp.uint32)
+    return jax.lax.fori_loop(0, C, body, init)
+
+
+def gf2_matmul_batched(masks: jnp.ndarray, planes: jnp.ndarray) -> jnp.ndarray:
+    """Batched object axis: masks (R, C), planes (B, C, W) -> (B, R, W)."""
+    return jax.vmap(lambda p: gf2_matmul_jax(masks, p))(planes)

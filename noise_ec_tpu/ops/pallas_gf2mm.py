@@ -1,0 +1,198 @@
+"""Pallas TPU kernel for the bitsliced GF(2) matmul (the encode/reconstruct
+hot loop — SURVEY.md §3.5, north-star "bitsliced Pallas kernels").
+
+Formulation: out (R, W) = B (R, C) @ planes (C, W) over GF(2), computed as
+an AND/XOR accumulation on uint32 lanes:
+
+    for c in range(C): acc ^= maskT[c, :, None] & planes[c, None, :]
+
+- ``maskT`` is the (C, R) *transposed* select-mask matrix (rows are read with
+  a dynamic leading index, which the TPU lowers cheaply).
+- The grid tiles the stripe-word axis W; masks and the full C-row plane tile
+  live in VMEM. R and C are multiples of 8 by construction (8 or 16 planes
+  per shard), W tiles are multiples of 128 — aligned to the (8, 128) int32
+  layout.
+- The same kernel serves encode (masks = parity rows of the generator) and
+  reconstruct (masks = inverted-submatrix rows): only the mask operand
+  changes (reference equivalents: main.go:262 and main.go:77).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_TILE_WORDS = 512
+DEFAULT_TILE_LANES = 512
+
+
+def _kernel(maskT_ref, planes_ref, out_ref):
+    C = planes_ref.shape[0]
+    R = maskT_ref.shape[1]
+    TW = planes_ref.shape[1]
+
+    def body(c, acc):
+        m = maskT_ref[c, :]  # (R,)
+        p = planes_ref[c, :]  # (TW,)
+        return acc ^ (m[:, None] & p[None, :])
+
+    out_ref[:, :] = jax.lax.fori_loop(
+        0, C, body, jnp.zeros((R, TW), dtype=jnp.uint32)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("tile_words", "interpret"))
+def gf2_matmul_pallas(
+    masks: jnp.ndarray,
+    planes: jnp.ndarray,
+    *,
+    tile_words: int = DEFAULT_TILE_WORDS,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """(R, C) uint32 masks x (C, W) uint32 planes -> (R, W) uint32.
+
+    W is padded to a tile boundary internally; output is sliced back.
+    """
+    R, C = masks.shape
+    Cp, W = planes.shape
+    assert C == Cp, (C, Cp)
+    TW = min(tile_words, max(128, -(-W // 128) * 128))
+    Wpad = -(-W // TW) * TW
+    if Wpad != W:
+        planes = jnp.pad(planes, ((0, 0), (0, Wpad - W)))
+    maskT = masks.T  # (C, R): dynamic *row* reads inside the kernel
+
+    out = pl.pallas_call(
+        _kernel,
+        grid=(Wpad // TW,),
+        in_specs=[
+            pl.BlockSpec((C, R), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, TW), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((R, TW), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R, Wpad), jnp.uint32),
+        interpret=interpret,
+    )(maskT, planes)
+    return out[:, :W] if Wpad != W else out
+
+
+# ---------------------------------------------------------------------------
+# Geometry-specialized sparse kernel (the fast path)
+#
+# The dense kernel above broadcasts a lane-vector of masks across sublanes
+# every iteration and does ~4x the necessary work (AND+XOR over zero entries).
+# This version bakes the bit-matrix into the program at trace time (the
+# reference's runtime-dynamic geometry is handled by caching one compiled
+# kernel per generator matrix — SURVEY.md §7.4): each output plane-row is a
+# balanced XOR tree over exactly the input rows with a set bit. Planes use a
+# "tiled" (C, 8, W8) layout so every XOR is a full (8, lanes) vreg op with no
+# relayouts.
+
+
+def planes_to_tiled(planes: jnp.ndarray) -> jnp.ndarray:
+    """(C, W) packed planes -> (C, 8, W/8) tiled layout (pure reshape).
+
+    Word w of plane c lands at [c, w // (W//8), w % (W//8)]... i.e. row-major
+    reshape; all codec ops are positionwise so any fixed bijection works as
+    long as pack/compute/unpack agree.
+    """
+    C, W = planes.shape
+    if W % 8:
+        planes = jnp.pad(planes, ((0, 0), (0, 8 - W % 8)))
+        W = planes.shape[1]
+    return planes.reshape(C, 8, W // 8)
+
+
+def tiled_to_planes(tiled: jnp.ndarray, num_words: int) -> jnp.ndarray:
+    C = tiled.shape[0]
+    return tiled.reshape(C, -1)[:, :num_words]
+
+
+def _make_sparse_kernel(bits_rows: tuple[tuple[int, ...], ...], sublanes: int, TL: int):
+    """bits_rows[r] = tuple of input-row indices feeding output row r.
+
+    Measured-on-v5e structure (see git history for the experiment): hoist ONE
+    VMEM read per input plane per grid step, then serial XOR chains per
+    output row. Per-row reads (C*density loads instead of C) cost 4x; tree
+    reduction instead of chains costs ~25%. This shape runs at the HBM
+    roofline (~650 GB/s data-in for RS(10,4)).
+    """
+    used = sorted({c for terms in bits_rows for c in terms})
+
+    def kernel(planes_ref, out_ref):
+        vs = {c: planes_ref[c, :, :] for c in used}
+        for r, terms in enumerate(bits_rows):
+            if not terms:
+                out_ref[r, :, :] = jnp.zeros((sublanes, TL), dtype=jnp.uint32)
+                continue
+            acc = vs[terms[0]]
+            for c in terms[1:]:
+                acc = acc ^ vs[c]
+            out_ref[r, :, :] = acc
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=512)
+def _sparse_call(bits_rows: tuple[tuple[int, ...], ...], C: int, W8: int, TL: int,
+                 interpret: bool):
+    R = len(bits_rows)
+    kernel = _make_sparse_kernel(bits_rows, 8, TL)
+    grid = (W8 // TL,)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((C, 8, TL), lambda i: (0, 0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((R, 8, TL), lambda i: (0, 0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R, 8, W8), jnp.uint32),
+        interpret=interpret,
+    )
+
+
+def bits_to_rows(bits) -> tuple[tuple[int, ...], ...]:
+    """(R, C) 0/1 matrix -> hashable per-output-row term tuples."""
+    import numpy as _np
+
+    bits = _np.asarray(bits)
+    return tuple(
+        tuple(int(c) for c in _np.nonzero(bits[r])[0]) for r in range(bits.shape[0])
+    )
+
+
+def gf2_matmul_pallas_sparse_rows(
+    bits_rows: tuple[tuple[int, ...], ...],  # STATIC: baked into the kernel
+    tiled_planes: jnp.ndarray,  # (C, 8, W8) uint32
+    *,
+    tile_lanes: int = DEFAULT_TILE_LANES,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Sparse geometry-specialized GF(2) matmul in tiled layout.
+
+    Returns (R, 8, W8) uint32. W8 is padded to a tile boundary internally.
+    """
+    C, sub, W8 = tiled_planes.shape
+    assert sub == 8, tiled_planes.shape
+    TL = min(tile_lanes, max(128, -(-W8 // 128) * 128))
+    W8p = -(-W8 // TL) * TL
+    if W8p != W8:
+        tiled_planes = jnp.pad(tiled_planes, ((0, 0), (0, 0), (0, W8p - W8)))
+    out = _sparse_call(bits_rows, C, W8p, TL, interpret)(tiled_planes)
+    return out[:, :, :W8] if W8p != W8 else out
+
+
+def gf2_matmul_pallas_sparse(
+    bits,  # (R, C) numpy 0/1 — STATIC: baked into the kernel
+    tiled_planes: jnp.ndarray,
+    *,
+    tile_lanes: int = DEFAULT_TILE_LANES,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    return gf2_matmul_pallas_sparse_rows(
+        bits_to_rows(bits), tiled_planes, tile_lanes=tile_lanes, interpret=interpret
+    )

@@ -1,17 +1,24 @@
 """Test configuration: force an 8-device virtual CPU mesh.
 
 Real multi-chip hardware is unavailable in CI; multi-device sharding tests run
-on XLA's virtual host devices. Must run before jax initializes.
+on XLA's virtual host devices. Tests must never touch the real TPU: the axon
+PJRT plugin (loaded by the environment's sitecustomize) prepends itself to the
+``jax_platforms`` *config* (not just the env var), so we override both before
+any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

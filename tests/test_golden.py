@@ -142,6 +142,32 @@ def test_par1_reconstruct_falls_back_over_subsets(rng):
     assert all(np.array_equal(out[i], cw[i]) for i in range(16))
 
 
+def test_vandermonde_raw_nonsystematic_verify_and_decode(rng):
+    """Exercises the non-systematic paths: encode_all/decode/verify."""
+    codec = GoldenCodec(3, 6, matrix="vandermonde_raw")
+    assert not codec.systematic
+    D = rng.integers(0, 256, size=(3, 8)).astype(np.uint8)
+    cw = codec.encode_all(D)
+    assert codec.verify(cw)
+    out = codec.decode_shares([(1, cw[1]), (3, cw[3]), (5, cw[5])])
+    assert np.array_equal(out, D)
+    bad = cw.copy()
+    bad[2, 0] ^= 1
+    assert not codec.verify(bad)
+    with pytest.raises(ValueError):
+        codec.encode(D)  # encode() demands systematic
+
+
+def test_par1_decode_no_correction_singular_first_subset(rng):
+    """error_correction=False must still find an invertible basis (PAR1)."""
+    codec = GoldenCodec(10, 16, matrix="par1")
+    D = rng.integers(0, 256, size=(10, 8)).astype(np.uint8)
+    cw = codec.encode_all(D)
+    nums = [0, 1, 2, 3, 4, 9, 10, 11, 12, 14, 15]  # first 10 -> singular
+    out = codec.decode_shares([(i, cw[i]) for i in nums], error_correction=False)
+    assert np.array_equal(out, D)
+
+
 def test_gf65536_pow_no_int32_overflow():
     from noise_ec_tpu.gf.field import GF65536
 
