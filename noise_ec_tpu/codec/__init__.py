@@ -1,0 +1,18 @@
+"""Public codec APIs.
+
+Two surfaces, mirroring the two codec interfaces in the reference's world:
+
+- ``rs.ReedSolomon`` — klauspost/reedsolomon-style (the BASELINE.json
+  comparison bar's interface): Encode/Verify/Reconstruct/ReconstructData/
+  Split/Join over a list of shard buffers.
+- ``fec.FEC`` + ``fec.Share`` — vivint/infectious-style (what the reference
+  actually calls: NewFEC/Encode-with-callback/Decode, /root/reference/
+  main.go:248-266, 73-77): share objects carrying their number, systematic
+  layout, decode with error detection/correction.
+
+Both dispatch to the same backends: pure NumPy ("numpy") or the JAX/Pallas
+device path ("device", geometry-cached kernels — see ``noise_ec_tpu.ops``).
+"""
+
+from noise_ec_tpu.codec.rs import ReedSolomon  # noqa: F401
+from noise_ec_tpu.codec.fec import FEC, Share  # noqa: F401

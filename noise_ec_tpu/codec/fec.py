@@ -1,0 +1,156 @@
+"""infectious-style FEC interface — the API shape the reference programs to.
+
+Contract reproduced from the reference's call sites (SURVEY.md §2.3 D1;
+/root/reference/main.go:248-266, 73-77):
+
+- ``FEC(required, total)`` validates 1 <= required <= total <= field order
+  (``infectious.NewFEC``, main.go:248);
+- ``encode(data, output)`` requires ``len(data) % required == 0`` (the
+  reference guarantees this upstream by adjusting k to the largest prime
+  factor of the length — main.go:185-191, never by padding), emits ``total``
+  shares of ``len(data)/required`` bytes, **systematic** (shares 0..k-1
+  concatenate to the data), and calls ``output`` once per share
+  (main.go:255-258). Unlike infectious, the Share buffers handed to the
+  callback are NOT reused — ``deep_copy()`` exists for API parity but is
+  never required for correctness;
+- ``decode(shares)`` needs >= required distinct share numbers and performs
+  error detection/correction when extra shares are present (infectious runs
+  Berlekamp-Welch; we use the consistent-subset search with the same
+  unique-decoding radius — see golden.codec.decode_shares);
+- ``rebuild(shares, output)`` regenerates the missing shares (erasure-only).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Iterable, Optional, Sequence
+
+import numpy as np
+
+from noise_ec_tpu.codec.rs import ReedSolomon
+from noise_ec_tpu.golden.codec import GoldenCodec, NotEnoughShardsError, TooManyErrorsError
+
+__all__ = ["FEC", "Share", "NotEnoughShardsError", "TooManyErrorsError"]
+
+
+@dataclass
+class Share:
+    """One erasure-coded share: its index in the codeword and its bytes."""
+
+    number: int
+    data: bytes
+
+    def deep_copy(self) -> "Share":
+        """API parity with infectious.Share.DeepCopy (the reference must
+        deep-copy because infectious reuses the callback buffer —
+        main.go:255-258). Our buffers are immutable bytes; this is a
+        plain copy."""
+        return Share(self.number, bytes(self.data))
+
+
+class FEC:
+    """Forward-error-correction codec with the infectious API shape."""
+
+    def __init__(
+        self,
+        required: int,
+        total: int,
+        *,
+        field: str = "gf256",
+        matrix: str = "cauchy",
+        backend: str = "device",
+    ):
+        if required < 1:
+            raise ValueError(f"required must be >= 1, got {required}")
+        if total < required:
+            raise ValueError(f"total {total} < required {required}")
+        self.k = required
+        self.n = total
+        self._rs = ReedSolomon(
+            required, total - required, field=field, matrix=matrix, backend=backend
+        )
+        # Error-correcting decode path (consistent-subset search) runs on the
+        # golden codec with the same generator matrix.
+        self._golden = GoldenCodec(required, total, field=field, matrix=matrix)
+
+    @property
+    def required(self) -> int:
+        return self.k
+
+    @property
+    def total(self) -> int:
+        return self.n
+
+    def encode(self, data: bytes, output: Callable[[Share], None]) -> None:
+        """Systematically encode ``data`` into ``total`` shares.
+
+        ``len(data)`` must be a multiple of ``required`` (infectious
+        contract; reference comment main.go:260-261).
+        """
+        if len(data) == 0:
+            raise ValueError("cannot encode empty data")
+        if len(data) % self.k:
+            raise ValueError(
+                f"data length {len(data)} is not a multiple of required={self.k}"
+            )
+        stride = len(data) // self.k
+        arr = np.frombuffer(data, dtype=np.uint8).reshape(self.k, stride)
+        full = self._rs.encode(list(arr))
+        for i, row in enumerate(full):
+            output(Share(i, row.tobytes()))
+
+    def encode_shares(self, data: bytes) -> list[Share]:
+        """Convenience wrapper collecting the callback results."""
+        out: list[Share] = []
+        self.encode(data, out.append)
+        return out
+
+    def decode(self, shares: Iterable[Share]) -> bytes:
+        """Reassemble the original data from >= required shares.
+
+        With more than ``required`` distinct shares, corrupted shares within
+        the unique-decoding radius floor((m-k)/2) are detected and corrected
+        (the guarantee infectious's Berlekamp-Welch decode gives the
+        reference at main.go:77).
+        """
+        pairs = [
+            (s.number, self._sym(np.frombuffer(bytes(s.data), dtype=np.uint8)))
+            for s in shares
+        ]
+        data = self._golden.decode_shares(pairs)  # (k, S) symbol rows
+        return np.ascontiguousarray(data).tobytes()
+
+    def rebuild(
+        self,
+        shares: Iterable[Share],
+        output: Optional[Callable[[Share], None]] = None,
+    ) -> list[Share]:
+        """Regenerate missing shares from any ``required`` present ones
+        (erasure-only; the share numbers present are trusted)."""
+        have: dict[int, np.ndarray] = {}
+        size: Optional[int] = None
+        for s in shares:
+            if not 0 <= s.number < self.n:
+                raise ValueError(f"share number {s.number} out of range [0, {self.n})")
+            arr = np.frombuffer(bytes(s.data), dtype=np.uint8)
+            if size is None:
+                size = arr.size
+            elif arr.size != size:
+                raise ValueError("share lengths differ")
+            if s.number in have and not np.array_equal(have[s.number], arr):
+                raise ValueError(f"conflicting copies of share {s.number}")
+            have[s.number] = arr
+        slots: list[Optional[np.ndarray]] = [have.get(i) for i in range(self.n)]
+        full = self._rs.reconstruct(slots)
+        rebuilt = [
+            Share(i, full[i].tobytes()) for i in range(self.n) if i not in have
+        ]
+        if output is not None:
+            for s in rebuilt:
+                output(s)
+        return rebuilt
+
+    def _sym(self, arr: np.ndarray) -> np.ndarray:
+        if self._golden.gf.degree == 16:
+            return arr.view("<u2")
+        return arr

@@ -1,0 +1,226 @@
+"""klauspost/reedsolomon-style Encoder API over the TPU/NumPy backends.
+
+This is the interface the BASELINE.json north star swaps in under
+(``reedsolomon.Encoder``): Encode fills parity shards from data shards,
+Verify checks consistency, Reconstruct/ReconstructData fill erased shards,
+Split/Join move between a byte stream and shard lists.
+
+Semantics mirrored from klauspost (and matching the reference's observable
+behavior where they overlap):
+
+- shards are equal-length byte buffers; the first k are data, the last n-k
+  parity (systematic — infectious contract, SURVEY.md §2.3 D1);
+- Reconstruct is erasure-only (present shards are trusted — corruption
+  detection is the signature layer's job in the reference, main.go:82-99);
+- Split zero-pads the tail shard; Join takes the output length.
+
+Backends:
+- "device" (default): geometry-cached JAX kernels — Pallas on TPU, XLA
+  elsewhere (see noise_ec_tpu.ops.dispatch).
+- "numpy": pure host path (golden-codec arithmetic).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+from noise_ec_tpu.gf.field import GF, GF256, GF65536
+from noise_ec_tpu.matrix.generators import generator_matrix
+from noise_ec_tpu.matrix.linalg import reconstruction_matrix
+
+Buffer = Union[bytes, bytearray, memoryview, np.ndarray]
+
+_FIELDS = {"gf256": GF256, "gf65536": GF65536}
+
+
+class ReedSolomon:
+    """RS(k = data_shards, n = data_shards + parity_shards) erasure codec.
+
+    The reference's defaults are data_shards=4, parity_shards=2
+    (totalShards=6, minimumNeededShards=4 — /root/reference/main.go:34-35).
+    """
+
+    def __init__(
+        self,
+        data_shards: int,
+        parity_shards: int,
+        *,
+        field: str = "gf256",
+        matrix: str = "cauchy",
+        backend: str = "device",
+    ):
+        if data_shards < 1:
+            raise ValueError("data_shards must be >= 1")
+        if parity_shards < 0:
+            raise ValueError("parity_shards must be >= 0")
+        if field not in _FIELDS:
+            raise ValueError(f"unknown field {field!r}")
+        self.gf: GF = _FIELDS[field]()
+        self.k = data_shards
+        self.r = parity_shards
+        self.n = data_shards + parity_shards
+        if self.n > self.gf.order:
+            raise ValueError(f"total shards {self.n} exceeds field order {self.gf.order}")
+        self.field = field
+        self.matrix_kind = matrix
+        self.backend = backend
+        self.G = generator_matrix(self.gf, self.k, self.n, matrix)
+        if not np.array_equal(self.G[: self.k], np.eye(self.k, dtype=self.gf.dtype)):
+            raise ValueError(
+                f"matrix kind {matrix!r} is not systematic; ReedSolomon requires "
+                "systematic layout (use golden.GoldenCodec for evaluation codes)"
+            )
+        if backend == "device":
+            from noise_ec_tpu.ops.dispatch import DeviceCodec
+
+            self._dev: Optional["DeviceCodec"] = DeviceCodec(field=field)
+        elif backend == "numpy":
+            self._dev = None
+        else:
+            raise ValueError(f"unknown backend {backend!r}")
+
+    # -- internals ---------------------------------------------------------
+
+    def _mul(self, M: np.ndarray, D: np.ndarray) -> np.ndarray:
+        if self._dev is not None:
+            return self._dev.matmul_stripes(M, D)
+        return self.gf.matvec_stripes(M, D)
+
+    def _to_sym(self, buf: Buffer, name: str) -> np.ndarray:
+        arr = np.frombuffer(buf, dtype=np.uint8) if not isinstance(buf, np.ndarray) else buf
+        if arr.dtype == np.uint8 and self.gf.degree == 16:
+            if arr.size % 2:
+                raise ValueError(f"{name}: gf65536 shards need even byte length")
+            arr = arr.view("<u2")
+        return np.ascontiguousarray(arr, dtype=self.gf.dtype)
+
+    def _gather(self, shards: Sequence[Optional[Buffer]], need_all: bool):
+        if len(shards) != self.n:
+            raise ValueError(f"expected {self.n} shards, got {len(shards)}")
+        out: list[Optional[np.ndarray]] = []
+        size: Optional[int] = None
+        for i, s in enumerate(shards):
+            if s is None or (hasattr(s, "__len__") and len(s) == 0):
+                if need_all:
+                    raise ValueError(f"shard {i} missing")
+                out.append(None)
+                continue
+            arr = self._to_sym(s, f"shard {i}")
+            if size is None:
+                size = arr.size
+            elif arr.size != size:
+                raise ValueError(
+                    f"shard {i} length {arr.size} != {size} (all shards must match)"
+                )
+            out.append(arr)
+        if size is None:
+            raise ValueError("all shards missing")
+        return out, size
+
+    # -- the Encoder interface --------------------------------------------
+
+    def encode(self, shards: Sequence[Buffer]) -> list[np.ndarray]:
+        """Compute parity from the k data shards.
+
+        Accepts either k data shards or n shards (parity entries are
+        overwritten — klauspost Encode semantics). Returns the full n-shard
+        list as uint8 arrays.
+        """
+        if len(shards) not in (self.k, self.n):
+            raise ValueError(
+                f"encode takes {self.k} data shards or all {self.n} shards, "
+                f"got {len(shards)}"
+            )
+        data, _ = self._gather(
+            [s for s in shards[: self.k]] + [None] * self.r, need_all=False
+        )
+        if any(d is None for d in data[: self.k]):
+            raise ValueError("all data shards required for encode")
+        D = np.stack(data[: self.k])
+        parity = self._mul(self.G[self.k :], D) if self.r else np.empty((0, D.shape[1]), self.gf.dtype)
+        return [self._as_bytes_arr(row) for row in D] + [
+            self._as_bytes_arr(row) for row in parity
+        ]
+
+    def verify(self, shards: Sequence[Buffer]) -> bool:
+        """True iff parity shards match the data shards."""
+        arrs, _ = self._gather(shards, need_all=True)
+        D = np.stack(arrs[: self.k])
+        want = self._mul(self.G[self.k :], D) if self.r else np.empty((0, D.shape[1]), self.gf.dtype)
+        have = np.stack(arrs[self.k :]) if self.r else want
+        return bool(np.array_equal(want, have))
+
+    def reconstruct(
+        self, shards: Sequence[Optional[Buffer]], data_only: bool = False
+    ) -> list[np.ndarray]:
+        """Fill missing (None/empty) shards from any k present ones.
+
+        Erasure-only, like klauspost Reconstruct (BASELINE config 2); the
+        reference's corruption story is the signature check one layer up
+        (main.go:82-99).
+        """
+        arrs, _ = self._gather(shards, need_all=False)
+        present = [i for i, a in enumerate(arrs) if a is not None]
+        if len(present) < self.k:
+            raise ValueError(
+                f"too few shards to reconstruct: have {len(present)}, need {self.k}"
+            )
+        limit = self.k if data_only else self.n
+        missing = [i for i in range(limit) if arrs[i] is None]
+        if missing:
+            # Prefer the first k present rows; fall back over other subsets
+            # for non-MDS constructions (par1) with singular submatrices.
+            import itertools
+
+            R = basis = None
+            for count, cand in enumerate(itertools.combinations(present, self.k)):
+                if count >= 20000:
+                    break
+                try:
+                    R = reconstruction_matrix(self.gf, self.G, list(cand), missing)
+                    basis = cand
+                    break
+                except np.linalg.LinAlgError:
+                    continue
+            if R is None:
+                raise ValueError(
+                    "no invertible subset of present shards (non-MDS matrix?)"
+                )
+            filled = self._mul(R, np.stack([arrs[i] for i in basis]))
+            for row, i in enumerate(missing):
+                arrs[i] = filled[row]
+        return [self._as_bytes_arr(a) if a is not None else None for a in arrs]
+
+    def reconstruct_data(self, shards: Sequence[Optional[Buffer]]) -> list[np.ndarray]:
+        """Like reconstruct, but only guarantees the k data shards."""
+        return self.reconstruct(shards, data_only=True)
+
+    def split(self, data: Buffer) -> list[np.ndarray]:
+        """Split a byte stream into k equal data shards (zero-padded)."""
+        buf = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+        if buf.size == 0:
+            raise ValueError("cannot split empty data")
+        sym = self.gf.degree // 8
+        shard_bytes = -(-buf.size // (self.k * sym)) * sym
+        padded = np.zeros(self.k * shard_bytes, dtype=np.uint8)
+        padded[: buf.size] = buf
+        return list(padded.reshape(self.k, shard_bytes))
+
+    def join(self, shards: Sequence[Buffer], out_size: int) -> bytes:
+        """Concatenate the k data shards and trim to out_size bytes."""
+        if len(shards) < self.k:
+            raise ValueError(f"join needs the {self.k} data shards")
+        parts = []
+        for i in range(self.k):
+            a = shards[i]
+            if a is None:
+                raise ValueError(f"data shard {i} missing; reconstruct first")
+            parts.append(
+                np.frombuffer(a, dtype=np.uint8) if not isinstance(a, np.ndarray) else a.view(np.uint8)
+            )
+        return np.concatenate(parts).tobytes()[:out_size]
+
+    def _as_bytes_arr(self, row: np.ndarray) -> np.ndarray:
+        return row.view(np.uint8) if self.gf.degree == 16 else row

@@ -37,6 +37,9 @@ from noise_ec_tpu.ops.pallas_gf2mm import (
 
 _FIELDS = {"gf256": GF256, "gf65536": GF65536}
 
+# Jitted shape-generic planes-level matmul (retraces per shape, cached by jit).
+_gf2_matmul_jax_jit = jax.jit(gf2_matmul_jax)
+
 
 def _resolve_kernel(kernel: str) -> str:
     if kernel == "auto":
@@ -89,6 +92,7 @@ class DeviceCodec:
         if self.kernel not in ("pallas", "pallas_interpret", "xla"):
             raise ValueError(f"unknown kernel {self.kernel!r}")
         self._mask_cache: dict[bytes, np.ndarray] = {}
+        self._mask_dev_cache: dict[bytes, jnp.ndarray] = {}
         self._rows_cache: dict[bytes, tuple] = {}
 
     def _key(self, M: np.ndarray) -> bytes:
@@ -136,7 +140,9 @@ class DeviceCodec:
                 m, r, S, self.bits_rows_for(M), self.kernel == "pallas_interpret"
             )
             out = fn(jnp.asarray(D))
-        return np.asarray(out)
+        # np.array (copy) so callers get an ordinary writable ndarray, not a
+        # read-only view of the device buffer.
+        return np.array(out)
 
     def matmul_planes(self, M: np.ndarray, planes: jnp.ndarray) -> jnp.ndarray:
         """Device-level entry on packed (C, W) planes (HBM-resident path).
@@ -146,7 +152,15 @@ class DeviceCodec:
         """
         W = planes.shape[1]
         if self.kernel == "xla":
-            return gf2_matmul_jax(jnp.asarray(self.masks_for(np.asarray(M))), planes)
+            M = np.ascontiguousarray(np.asarray(M, dtype=self.gf.dtype))
+            key = self._key(M)
+            dev = self._mask_dev_cache.get(key)
+            if dev is None:
+                dev = jnp.asarray(self.masks_for(M))
+                if len(self._mask_dev_cache) > 1024:
+                    self._mask_dev_cache.clear()
+                self._mask_dev_cache[key] = dev
+            return _gf2_matmul_jax_jit(dev, planes)
         out = gf2_matmul_pallas_sparse_rows(
             self.bits_rows_for(np.asarray(M)),
             planes_to_tiled(planes),

@@ -1,0 +1,215 @@
+"""Public codec API tests: ReedSolomon (klauspost-style) and FEC
+(infectious-style)."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from noise_ec_tpu.codec import FEC, ReedSolomon, Share
+from noise_ec_tpu.golden.codec import GoldenCodec, TooManyErrorsError
+
+
+@pytest.fixture(params=["numpy", "device"])
+def backend(request):
+    return request.param
+
+
+def test_encode_verify_roundtrip(backend, rng):
+    rs = ReedSolomon(10, 4, backend=backend)
+    data = [rng.integers(0, 256, 128).astype(np.uint8) for _ in range(10)]
+    full = rs.encode(data)
+    assert len(full) == 14
+    assert rs.verify(full)
+    full[12][0] ^= 1
+    assert not rs.verify(full)
+
+
+def test_encode_accepts_n_shards_overwrites_parity(rng):
+    rs = ReedSolomon(4, 2, backend="numpy")
+    data = [rng.integers(0, 256, 64).astype(np.uint8) for _ in range(4)]
+    stale = [np.zeros(64, dtype=np.uint8) for _ in range(2)]
+    full = rs.encode(data + stale)
+    assert rs.verify(full)
+
+
+def test_encode_matches_golden(backend, rng):
+    rs = ReedSolomon(4, 2, backend=backend)
+    g = GoldenCodec(4, 6)
+    D = rng.integers(0, 256, size=(4, 96)).astype(np.uint8)
+    full = rs.encode(list(D))
+    assert np.array_equal(np.stack(full), g.encode_all(D))
+
+
+def test_reconstruct(backend, rng):
+    rs = ReedSolomon(10, 4, backend=backend)
+    data = [rng.integers(0, 256, 256).astype(np.uint8) for _ in range(10)]
+    full = rs.encode(data)
+    damaged = list(full)
+    damaged[0] = None
+    damaged[5] = None
+    damaged[11] = b""  # empty counts as missing (klauspost convention)
+    fixed = rs.reconstruct(damaged)
+    for i in range(14):
+        assert np.array_equal(fixed[i], full[i]), i
+    assert rs.verify(fixed)
+
+
+def test_reconstruct_data_only(rng):
+    rs = ReedSolomon(4, 2, backend="numpy")
+    full = rs.encode([rng.integers(0, 256, 32).astype(np.uint8) for _ in range(4)])
+    damaged = [None, full[1], full[2], full[3], None, full[5]]
+    fixed = rs.reconstruct_data(damaged)
+    assert np.array_equal(fixed[0], full[0])
+    assert fixed[4] is None  # parity not required
+
+
+def test_reconstruct_too_few(rng):
+    rs = ReedSolomon(4, 2, backend="numpy")
+    full = rs.encode([rng.integers(0, 256, 32).astype(np.uint8) for _ in range(4)])
+    with pytest.raises(ValueError, match="too few"):
+        rs.reconstruct([full[0], full[1], full[2], None, None, None])
+
+
+def test_mismatched_lengths_rejected(rng):
+    rs = ReedSolomon(2, 1, backend="numpy")
+    with pytest.raises(ValueError, match="must match"):
+        rs.encode([np.zeros(8, np.uint8), np.zeros(9, np.uint8)])
+
+
+def test_split_join_roundtrip():
+    rs = ReedSolomon(4, 2, backend="numpy")
+    data = bytes(range(256)) * 3 + b"tail"  # 772 bytes, pads to 4x194
+    shards = rs.split(data)
+    assert len(shards) == 4 and all(len(s) == 193 for s in shards)
+    assert rs.join(shards, len(data)) == data
+
+
+def test_gf65536_backend_roundtrip(backend, rng):
+    rs = ReedSolomon(3, 2, field="gf65536", backend=backend)
+    data = [rng.integers(0, 256, 64).astype(np.uint8) for _ in range(3)]
+    full = rs.encode(data)
+    assert rs.verify(full)
+    fixed = rs.reconstruct([None, full[1], None, full[3], full[4]])
+    for i in range(5):
+        assert np.array_equal(fixed[i], full[i])
+
+
+def test_odd_length_gf65536_rejected():
+    rs = ReedSolomon(2, 1, field="gf65536", backend="numpy")
+    with pytest.raises(ValueError, match="even"):
+        rs.encode([np.zeros(7, np.uint8), np.zeros(7, np.uint8)])
+
+
+def test_zero_parity_allowed(rng):
+    rs = ReedSolomon(3, 0, backend="numpy")
+    data = [rng.integers(0, 256, 16).astype(np.uint8) for _ in range(3)]
+    full = rs.encode(data)
+    assert len(full) == 3 and rs.verify(full)
+
+
+def test_nonsystematic_matrix_rejected():
+    with pytest.raises(ValueError, match="systematic"):
+        ReedSolomon(3, 2, matrix="vandermonde_raw", backend="numpy")
+
+
+def test_par1_reconstruct_falls_back(rng):
+    """rs.reconstruct must skip singular PAR1 subsets like golden does."""
+    rs = ReedSolomon(10, 6, matrix="par1", backend="numpy")
+    data = [rng.integers(0, 256, 16).astype(np.uint8) for _ in range(10)]
+    full = rs.encode(data)
+    surv = {0, 1, 2, 3, 4, 9, 10, 11, 12, 14, 15}
+    damaged = [full[i] if i in surv else None for i in range(16)]
+    fixed = rs.reconstruct(damaged)
+    for i in range(16):
+        assert np.array_equal(fixed[i], full[i]), i
+
+
+# -- FEC (infectious-style) -----------------------------------------------
+
+
+def test_fec_contract_validation():
+    with pytest.raises(ValueError):
+        FEC(0, 5)
+    with pytest.raises(ValueError):
+        FEC(5, 3)
+    with pytest.raises(ValueError):
+        FEC(4, 300)  # exceeds GF(2^8) order
+
+
+def test_fec_encode_systematic_and_callback(rng):
+    f = FEC(4, 6, backend="numpy")
+    data = bytes(rng.integers(0, 256, 32, dtype=np.uint8))  # 32 % 4 == 0
+    got: list[Share] = []
+    f.encode(data, got.append)
+    assert [s.number for s in got] == list(range(6))
+    assert b"".join(s.data for s in got[:4]) == data  # systematic
+    c = got[0].deep_copy()
+    assert c.data == got[0].data and c is not got[0]
+
+
+def test_fec_length_contract():
+    f = FEC(4, 6, backend="numpy")
+    with pytest.raises(ValueError, match="multiple"):
+        f.encode(b"12345", lambda s: None)  # 5 % 4 != 0
+
+
+def test_fec_decode_any_k(rng):
+    f = FEC(4, 6, backend="numpy")
+    data = bytes(rng.integers(0, 256, 64, dtype=np.uint8))
+    shares = f.encode_shares(data)
+    assert f.decode([shares[1], shares[3], shares[4], shares[5]]) == data
+
+
+def test_fec_decode_corrects_corruption(rng):
+    f = FEC(4, 6, backend="numpy")
+    data = bytes(rng.integers(0, 256, 64, dtype=np.uint8))
+    shares = f.encode_shares(data)
+    bad = Share(2, bytes([shares[2].data[0] ^ 0xFF]) + shares[2].data[1:])
+    got = f.decode([shares[0], shares[1], bad, shares[3], shares[4], shares[5]])
+    assert got == data
+
+
+def test_fec_rebuild(rng):
+    f = FEC(4, 6, backend="numpy")
+    data = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+    shares = f.encode_shares(data)
+    rebuilt = f.rebuild([shares[0], shares[2], shares[4], shares[5]])
+    nums = {s.number for s in rebuilt}
+    assert nums == {1, 3}
+    by_num = {s.number: s for s in rebuilt}
+    assert by_num[1].data == shares[1].data
+    assert by_num[3].data == shares[3].data
+
+
+def test_fec_rebuild_validates_shares(rng):
+    f = FEC(4, 6, backend="numpy")
+    data = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+    shares = f.encode_shares(data)
+    with pytest.raises(ValueError, match="out of range"):
+        f.rebuild([Share(9, shares[0].data), shares[1], shares[2], shares[3]])
+    bad = Share(0, bytes([shares[0].data[0] ^ 1]) + shares[0].data[1:])
+    with pytest.raises(ValueError, match="conflicting"):
+        f.rebuild([shares[0], bad, shares[1], shares[2], shares[3]])
+
+
+def test_fec_gf65536_roundtrip(rng):
+    f = FEC(3, 5, field="gf65536", backend="numpy")
+    data = bytes(rng.integers(0, 256, 30, dtype=np.uint8))  # 30 % 3 == 0, even stripes
+    shares = f.encode_shares(data)
+    assert f.decode([shares[4], shares[2], shares[0]]) == data
+
+
+@settings(max_examples=20, deadline=None)
+@given(
+    k=st.integers(1, 6),
+    extra=st.integers(0, 3),
+    blocks=st.integers(1, 9),
+    seed=st.integers(0, 2**32 - 1),
+)
+def test_fec_property_roundtrip(k, extra, blocks, seed):
+    rng = np.random.default_rng(seed)
+    f = FEC(k, k + extra, backend="numpy")
+    data = bytes(rng.integers(0, 256, k * blocks, dtype=np.uint8))
+    shares = f.encode_shares(data)
+    keep = sorted(rng.choice(k + extra, size=k, replace=False))
+    assert f.decode([shares[i] for i in keep]) == data

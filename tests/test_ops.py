@@ -137,6 +137,24 @@ def test_device_codec_geometry_cache_reuse(rng):
         assert np.array_equal(dev.matmul_stripes(M, D), want)
 
 
+def test_matmul_planes_device_path(rng):
+    """HBM-resident planes-level entry: bit-exact + device mask caching."""
+    import jax.numpy as jnp
+    from noise_ec_tpu.gf import GF256, expand_generator_bits, pack_bitplanes
+
+    gf = GF256()
+    dev = DeviceCodec(kernel="xla")
+    M = rng.integers(0, 256, size=(2, 4))
+    D = rng.integers(0, 256, size=(4, 64)).astype(np.uint8)
+    planes = jnp.asarray(pack_bitplanes(D, gf))
+    out = np.asarray(dev.matmul_planes(M, planes))
+    want = gf2_matmul_planes(expand_generator_bits(gf, M), pack_bitplanes(D, gf))
+    assert np.array_equal(out, want)
+    assert len(dev._mask_dev_cache) == 1
+    dev.matmul_planes(M, planes)  # cache hit
+    assert len(dev._mask_dev_cache) == 1
+
+
 def test_masks_cache_distinguishes_shapes():
     """Regression: (2,3) and (3,2) matrices with identical bytes."""
     dev = DeviceCodec(kernel="xla")
