@@ -1,0 +1,16 @@
+"""Mesh-parallel batched encode/reconstruct and streaming (SURVEY.md §2.4).
+
+The reference scales by broadcasting shards to every peer over TCP
+(/root/reference/main.go:201-210); the TPU build scales by laying objects and
+generator rows out over a ``jax.sharding.Mesh`` and letting XLA insert ICI
+collectives (BASELINE config 5: "pmap over Shard batches, ICI all-gather
+parity").
+
+- ``mesh``      — device-mesh construction helpers
+- ``batch``     — BatchCodec: multi-object encode/reconstruct, DP + TP
+- ``streaming`` — chunked pipeline for wide/long codes (RS(17,3), RS(50,20))
+"""
+
+from noise_ec_tpu.parallel.mesh import make_mesh  # noqa: F401
+from noise_ec_tpu.parallel.batch import BatchCodec  # noqa: F401
+from noise_ec_tpu.parallel.streaming import StreamingEncoder  # noqa: F401

@@ -1,0 +1,205 @@
+"""Batched multi-object codec over a device mesh (BASELINE config 5).
+
+Design: the bitplane layout is positionwise, so a batch of B objects — planes
+``(B, C, W)`` — folds into one ``(C, B*W)`` stripe and a *single* GF(2)
+matmul encodes the whole batch (bigger lane axis, better VPU utilisation than
+B small calls). On a mesh this one primitive scales two ways:
+
+- **batch axis (DP)**: objects sharded over ``"batch"``; no communication —
+  the TPU analogue of the reference's every-peer-decodes-independently
+  fan-out (/root/reference/main.go:201-210).
+- **row axis (TP)**: generator parity rows sharded over ``"row"``; each chip
+  computes its slice of the parity planes from replicated data and the full
+  parity is assembled with an **all-gather over ICI** (the north star's
+  design; XLA emits the collective from the shard_map spec).
+
+Both encode (parity rows of G — main.go:262) and reconstruct (inverted
+submatrix rows — main.go:77) are the same primitive with a different matrix,
+so ``matmul_batch`` / ``make_sharded_matmul`` serve both.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # JAX >= 0.4.35 exposes shard_map at top level
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # pragma: no cover - version compat
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from noise_ec_tpu.gf.bitmatrix import expand_generator_masks
+from noise_ec_tpu.gf.field import GF, GF256, GF65536
+from noise_ec_tpu.matrix.generators import generator_matrix
+from noise_ec_tpu.matrix.linalg import reconstruction_matrix
+from noise_ec_tpu.ops.bitops import pack_bitplanes_jax, unpack_bitplanes_jax
+from noise_ec_tpu.ops.gf2mm import gf2_matmul_jax
+
+_FIELDS = {"gf256": GF256, "gf65536": GF65536}
+
+
+def _shard_map_compat(f, mesh, in_specs, out_specs):
+    """shard_map across JAX versions (check_rep -> check_vma rename)."""
+    try:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                          check_vma=False)
+    except TypeError:  # pragma: no cover - older JAX
+        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                          check_rep=False)
+
+
+def _fold_matmul(masks: jnp.ndarray, shards: jnp.ndarray, degree: int,
+                 out_rows: int) -> jnp.ndarray:
+    """(Rm, Cm) masks x (B, k, S) symbol shards -> (B, out_rows, S) symbols.
+
+    Pack each object to bitplanes, fold the batch into the word axis, run one
+    GF(2) matmul, unfold, unpack.
+    """
+    B, k, S = shards.shape
+    planes = jax.vmap(lambda s: pack_bitplanes_jax(s, degree))(shards)
+    _, C, W = planes.shape
+    folded = planes.transpose(1, 0, 2).reshape(C, B * W)
+    out = gf2_matmul_jax(masks, folded)  # (out_rows*degree, B*W)
+    out = out.reshape(out_rows * degree, B, W).transpose(1, 0, 2)
+    return jax.vmap(lambda p: unpack_bitplanes_jax(p, out_rows, S, degree))(out)
+
+
+class BatchCodec:
+    """Multi-object RS codec: encode/reconstruct batches on one device or a mesh.
+
+    Geometry matches ``codec.ReedSolomon`` (systematic, Cauchy default); this
+    class adds the batch dimension and the mesh story.
+    """
+
+    def __init__(self, data_shards: int, parity_shards: int, *,
+                 field: str = "gf256", matrix: str = "cauchy"):
+        if field not in _FIELDS:
+            raise ValueError(f"unknown field {field!r}")
+        self.gf: GF = _FIELDS[field]()
+        self.k = data_shards
+        self.r = parity_shards
+        self.n = data_shards + parity_shards
+        self.G = generator_matrix(self.gf, self.k, self.n, matrix)
+        self._masks_cache: dict[bytes, np.ndarray] = {}
+
+    # -- matrices ----------------------------------------------------------
+
+    def _masks(self, M: np.ndarray) -> np.ndarray:
+        key = M.tobytes() + M.shape[1].to_bytes(4, "little")
+        hit = self._masks_cache.get(key)
+        if hit is None:
+            hit = expand_generator_masks(self.gf, M)
+            if len(self._masks_cache) > 1024:
+                self._masks_cache.clear()
+            self._masks_cache[key] = hit
+        return hit
+
+    @property
+    def parity_matrix(self) -> np.ndarray:
+        return self.G[self.k:]
+
+    # -- single-device batched ops ----------------------------------------
+
+    def matmul_batch(self, M: np.ndarray, batch: jnp.ndarray) -> jnp.ndarray:
+        """(R, k) GF matrix x (B, k, S) -> (B, R, S), one fused device call."""
+        M = np.ascontiguousarray(np.asarray(M, dtype=self.gf.dtype))
+        masks = jnp.asarray(self._masks(M))
+        return _jit_fold_matmul(self.gf.degree, M.shape[0])(masks, batch)
+
+    def encode_batch(self, batch: jnp.ndarray) -> jnp.ndarray:
+        """(B, k, S) data shards -> (B, n, S) full codewords."""
+        parity = self.matmul_batch(self.parity_matrix, batch)
+        return jnp.concatenate([jnp.asarray(batch, self._jdtype), parity], axis=1)
+
+    def reconstruct_batch(self, batch_present: jnp.ndarray,
+                          present: list[int]) -> jnp.ndarray:
+        """Rebuild all missing shards for a batch sharing one erasure pattern.
+
+        ``batch_present``: (B, len(present), S) — rows of each codeword that
+        survived, in ``present`` index order (>= k of them; first k used).
+        Returns (B, n, S) full codewords (BASELINE config 2, batched).
+        """
+        if len(present) < self.k:
+            raise ValueError(f"need >= {self.k} present shards, got {len(present)}")
+        basis = sorted(present)[: self.k]
+        rows = [list(present).index(i) for i in basis]
+        missing = [i for i in range(self.n) if i not in present]
+        sub = jnp.asarray(batch_present)[:, rows, :]
+        out_rows: list[Optional[jnp.ndarray]] = [None] * self.n
+        for row, i in enumerate(basis):
+            out_rows[i] = sub[:, row, :]
+        for j in list(present):
+            if j not in basis:
+                out_rows[j] = jnp.asarray(batch_present)[:, list(present).index(j), :]
+        if missing:
+            R = reconstruction_matrix(self.gf, self.G, basis, missing)
+            filled = self.matmul_batch(R, sub)
+            for row, i in enumerate(missing):
+                out_rows[i] = filled[:, row, :]
+        return jnp.stack(out_rows, axis=1)
+
+    # -- mesh-sharded ops --------------------------------------------------
+
+    def make_sharded_matmul(self, mesh: Mesh, M: np.ndarray, *,
+                            batch_axis: str = "batch",
+                            row_axis: Optional[str] = None):
+        """Compile (B, k, S) -> (B, R, S) over ``mesh``.
+
+        Objects are sharded over ``batch_axis``. If ``row_axis`` is given,
+        output rows of ``M`` are additionally sharded over it: each chip
+        computes its row slice and XLA all-gathers the slices over ICI
+        (tiled all_gather inside shard_map).
+        """
+        M = np.ascontiguousarray(np.asarray(M, dtype=self.gf.dtype))
+        R = M.shape[0]
+        m = self.gf.degree
+        masks = self._masks(M)  # (R*m, k*m)
+        if row_axis is not None:
+            rsz = mesh.shape[row_axis]
+            if R % rsz:
+                raise ValueError(
+                    f"matrix rows {R} not divisible by mesh axis "
+                    f"{row_axis!r} size {rsz}"
+                )
+            mask_spec = P(row_axis, None)
+        else:
+            mask_spec = P(None, None)
+
+        def local(masks_local, shards_local):
+            out = _fold_matmul(jnp.asarray(masks_local), shards_local, m,
+                               masks_local.shape[0] // m)
+            if row_axis is not None:
+                # (Bl, R_local, S) -> gather rows over ICI -> (Bl, R, S)
+                out = jax.lax.all_gather(out, row_axis, axis=1, tiled=True)
+            return out
+
+        fn = _shard_map_compat(
+            local, mesh,
+            in_specs=(mask_spec, P(batch_axis, None, None)),
+            out_specs=P(batch_axis, None, None),
+        )
+        jfn = jax.jit(fn)
+        return functools.partial(jfn, jnp.asarray(masks))
+
+    def make_sharded_encoder(self, mesh: Mesh, *, batch_axis: str = "batch",
+                             row_axis: Optional[str] = None):
+        """Compiled batched parity encode over the mesh: (B,k,S) -> (B,r,S)."""
+        return self.make_sharded_matmul(
+            mesh, self.parity_matrix, batch_axis=batch_axis, row_axis=row_axis
+        )
+
+    @property
+    def _jdtype(self):
+        return jnp.uint8 if self.gf.degree == 8 else jnp.uint16
+
+
+@functools.lru_cache(maxsize=256)
+def _jit_fold_matmul(degree: int, out_rows: int):
+    return jax.jit(
+        functools.partial(_fold_matmul, degree=degree, out_rows=out_rows)
+    )

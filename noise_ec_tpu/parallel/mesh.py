@@ -1,0 +1,53 @@
+"""Device-mesh helpers for the parallel codec paths.
+
+The framework's parallel axes (the EC analogue of dp/tp/sp — SURVEY.md §2.4):
+
+- ``"batch"`` — data parallelism over independent objects (the reference's
+  degenerate DP: every peer decodes the full stream independently,
+  main.go:52-107; here each device encodes its slice of a batch);
+- ``"row"``   — tensor parallelism over generator-matrix parity rows
+  (parity shards computed on different chips, assembled with an ICI
+  all-gather — the north star's explicit design);
+- the stripe-length axis is tiled *inside* the Pallas grid, not over the
+  mesh (SURVEY.md §5 "long-context": shard length is the sequence axis).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    axis_names: Sequence[str] = ("batch",),
+    axis_sizes: Optional[Sequence[int]] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all visible JAX devices).
+
+    If ``axis_sizes`` is omitted, all devices go to the first axis and the
+    rest get size 1.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if axis_sizes is None:
+        axis_sizes = [n] + [1] * (len(axis_names) - 1)
+    if math.prod(axis_sizes) != n:
+        raise ValueError(f"axis sizes {axis_sizes} != device count {n}")
+    arr = np.asarray(devices).reshape(tuple(axis_sizes))
+    return Mesh(arr, tuple(axis_names))
+
+
+def default_2d_mesh(devices: Optional[Sequence] = None) -> Mesh:
+    """("batch", "row") mesh: widest batch axis, row axis of 2 when even.
+
+    Used by the multi-chip dry run; real deployments choose explicitly.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    row = 2 if n % 2 == 0 and n >= 2 else 1
+    return make_mesh(("batch", "row"), (n // row, row), devices)

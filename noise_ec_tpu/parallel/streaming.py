@@ -1,0 +1,115 @@
+"""Chunked streaming encode for wide / high-rate codes (BASELINE config 3).
+
+The reference encodes whole messages in one call (main.go:262); for long
+objects (RS(17,3), RS(50,20) streaming configs) the TPU build chunks the byte
+stream on the host and keeps the device busy via JAX's async dispatch: chunk
+i+1 is transferred H2D while chunk i computes (SURVEY.md §2.4 "PP" row — a
+host-side chunk pipeline overlapping H2D/compute/D2H, not mesh pipeline
+parallelism).
+
+Each chunk is an independent codeword batch, so a lost chunk only costs that
+chunk's shards — the same per-message isolation the reference's mempool gives
+(main.go:55).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from noise_ec_tpu.parallel.batch import BatchCodec
+
+
+@dataclass
+class StreamChunk:
+    """Encoded shards for one chunk of the stream."""
+
+    index: int           # chunk sequence number
+    shards: np.ndarray   # (n, shard_len) uint8 — systematic codeword
+    data_len: int        # unpadded payload bytes in this chunk
+
+
+class StreamingEncoder:
+    """Encode an arbitrary byte stream as a sequence of RS codewords.
+
+    ``chunk_bytes`` is the payload per codeword; it is split into k equal
+    stripes (zero-padded tail chunk) and parity is computed on device. The
+    returned iterator is pipelined: the next chunk's H2D copy and compute are
+    dispatched before the previous chunk's result is fetched.
+    """
+
+    def __init__(self, data_shards: int, parity_shards: int, *,
+                 chunk_bytes: int = 1 << 20, field: str = "gf256",
+                 matrix: str = "cauchy"):
+        self.codec = BatchCodec(data_shards, parity_shards, field=field,
+                                matrix=matrix)
+        self.k = data_shards
+        self.n = data_shards + parity_shards
+        sym = self.codec.gf.degree // 8
+        # Round the chunk so each stripe is whole symbols.
+        quantum = data_shards * sym
+        self.chunk_bytes = max(quantum, chunk_bytes - chunk_bytes % quantum)
+
+    def _to_stripes(self, chunk: bytes) -> np.ndarray:
+        buf = np.frombuffer(chunk, dtype=np.uint8)
+        stride = self.chunk_bytes // self.k
+        if buf.size < self.chunk_bytes:
+            pad = np.zeros(self.chunk_bytes, dtype=np.uint8)
+            pad[: buf.size] = buf
+            buf = pad
+        stripes = buf.reshape(self.k, stride)
+        if self.codec.gf.degree == 16:
+            stripes = stripes.view("<u2")
+        return stripes
+
+    def encode_stream(self, chunks: Iterable[bytes],
+                      depth: int = 2) -> Iterator[StreamChunk]:
+        """Yield encoded StreamChunks; keeps ``depth`` chunks in flight."""
+        inflight: list[tuple[int, int, jnp.ndarray]] = []
+        idx = 0
+        for chunk in chunks:
+            if len(chunk) > self.chunk_bytes:
+                raise ValueError(
+                    f"chunk {idx} is {len(chunk)} bytes > chunk_bytes "
+                    f"{self.chunk_bytes}"
+                )
+            stripes = self._to_stripes(chunk)
+            # encode_batch with B=1; async dispatch returns immediately.
+            full = self.codec.encode_batch(jnp.asarray(stripes)[None])[0]
+            inflight.append((idx, len(chunk), full))
+            idx += 1
+            if len(inflight) >= depth:
+                yield self._drain_one(inflight)
+        while inflight:
+            yield self._drain_one(inflight)
+
+    def encode_bytes(self, data: bytes, depth: int = 2) -> Iterator[StreamChunk]:
+        """Convenience: chunk a contiguous buffer and encode_stream it."""
+        def gen():
+            for off in range(0, len(data), self.chunk_bytes):
+                yield data[off: off + self.chunk_bytes]
+        if len(data) == 0:
+            return iter(())
+        return self.encode_stream(gen(), depth=depth)
+
+    def _drain_one(self, inflight) -> StreamChunk:
+        i, dlen, full = inflight.pop(0)
+        arr = np.asarray(full)
+        if arr.dtype != np.uint8:
+            arr = arr.view(np.uint8)
+        return StreamChunk(index=i, shards=arr, data_len=dlen)
+
+
+def decode_stream(chunks: Iterable[StreamChunk], data_shards: int,
+                  total_len: Optional[int] = None) -> bytes:
+    """Reassemble the byte stream from (in-order, complete) StreamChunks."""
+    parts = []
+    for c in chunks:
+        data = c.shards[:data_shards].reshape(-1)[: c.data_len]
+        parts.append(data.tobytes())
+    out = b"".join(parts)
+    return out[:total_len] if total_len is not None else out
