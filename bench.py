@@ -1,0 +1,126 @@
+"""Headline benchmark: RS(10,4) encode GB/s on one chip (BASELINE config 1).
+
+Measures the fused shard-bytes -> parity-bytes encode path (delta-swap pack
+-> bitsliced GF(2) matmul -> unpack, all Pallas) on HBM-resident shards —
+the same bytes-to-parity contract klauspost/reedsolomon's Encode() measures.
+Shard buffers live on device as uint32 words (same bytes; the u8 view is
+host-side metadata — see ops/dispatch.py on the u8 relayout cost).
+
+Timing: the axon tunnel adds multi-ms RPC jitter and block_until_ready does
+not reflect device completion, so each sample runs N dependent encodes
+inside one jitted fori_loop (data-chained so they serialize) and the
+per-encode time is the slope between N=10 and N=60 runs.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": "GB/s", "vs_baseline": ...}
+vs_baseline is against the BASELINE.json north-star bar of 40 GB/s
+(klauspost AVX2-class; the reference itself publishes no numbers).
+Secondary stats (reconstruct latency, per-config rates) go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+NORTH_STAR_GBPS = 40.0
+
+
+def chained_seconds_per_iter(make_encode, x, n_lo=10, n_hi=60, reps=3):
+    """Median slope timing of one fused encode, chained inside fori_loop."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def mk(N):
+        @jax.jit
+        def run(s):
+            def body(i, s):
+                p = make_encode(s)
+                return s.at[: p.shape[0]].set(s[: p.shape[0]] ^ p)
+            return lax.fori_loop(0, N, body, s).sum()
+        return run
+
+    lo, hi = mk(n_lo), mk(n_hi)
+    np.asarray(lo(x)), np.asarray(hi(x))  # compile + warm
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter(); np.asarray(lo(x)); a = time.perf_counter() - t0
+        t0 = time.perf_counter(); np.asarray(hi(x)); b = time.perf_counter() - t0
+        ts.append((b - a) / (n_hi - n_lo))
+    return float(np.median(ts))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from noise_ec_tpu.gf.field import GF256
+    from noise_ec_tpu.matrix.generators import generator_matrix
+    from noise_ec_tpu.matrix.linalg import reconstruction_matrix
+    from noise_ec_tpu.ops.dispatch import DeviceCodec
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    k, r = 10, 4
+    # 8 x 1 MiB per shard folded into the stripe axis (HBM-resident batch,
+    # BASELINE config 5; positionwise layout makes this identical to 8
+    # independent 1 MiB-shard objects).
+    S = (8 if on_tpu else 1) * (1 << 20)
+    TW = S // 4
+    gf = GF256()
+    G = generator_matrix(gf, k, k + r, "cauchy")
+    dev = DeviceCodec(field="gf256", kernel="pallas" if on_tpu else "xla")
+    rng = np.random.default_rng(0)
+    data_bytes = k * S
+
+    stats = {"backend": backend, "kernel": dev.kernel, "data_bytes": data_bytes}
+
+    if dev.kernel == "pallas":
+        words = jnp.asarray(
+            rng.integers(0, 1 << 32, size=(k, TW), dtype=np.uint64).astype(np.uint32)
+        )
+        t_enc = chained_seconds_per_iter(
+            lambda s: dev.matmul_words(G[k:], s), words
+        )
+        gbps = data_bytes / t_enc / 1e9
+
+        # Reconstruct: 3 data-shard erasures, single 1 MiB-shard object.
+        present = list(range(3, 3 + k))
+        R = reconstruction_matrix(gf, G, present, [0, 1, 2])
+        surv = jnp.asarray(
+            rng.integers(0, 1 << 32, size=(k, (1 << 20) // 4), dtype=np.uint64).astype(np.uint32)
+        )
+        t_rec = chained_seconds_per_iter(
+            lambda s: dev.matmul_words(R, s), surv
+        )
+        stats["reconstruct3_1mib_p50_ms"] = round(t_rec * 1e3, 3)
+    else:
+        # Portability fallback (CPU CI): host-path timing, not the headline.
+        shards = rng.integers(0, 256, size=(k, S)).astype(np.uint8)
+        dev.matmul_stripes(G[k:], shards)  # compile
+        t0 = time.perf_counter()
+        for _ in range(3):
+            dev.matmul_stripes(G[k:], shards)
+        t_enc = (time.perf_counter() - t0) / 3
+        gbps = data_bytes / t_enc / 1e9
+
+    stats["encode_s"] = t_enc
+    print(
+        json.dumps(
+            {
+                "metric": "rs10_4_encode_throughput",
+                "value": round(gbps, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(gbps / NORTH_STAR_GBPS, 4),
+            }
+        )
+    )
+    print(json.dumps(stats), file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

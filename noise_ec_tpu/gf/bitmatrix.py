@@ -67,6 +67,24 @@ def expand_generator_masks(gf: GF, G: np.ndarray) -> np.ndarray:
     return (bits.astype(np.uint32) * np.uint32(0xFFFFFFFF)).astype(np.uint32)
 
 
+_MASKS_CACHE: dict[tuple, np.ndarray] = {}
+
+
+def expand_generator_masks_cached(gf: GF, G: np.ndarray) -> np.ndarray:
+    """Cached :func:`expand_generator_masks` (geometry is runtime-dynamic in
+    the reference — main.go:185-191 — so the same matrices recur per
+    message). Shared by DeviceCodec and BatchCodec."""
+    G = np.ascontiguousarray(np.asarray(G, dtype=gf.dtype))
+    key = (gf.degree, G.shape, G.tobytes())
+    hit = _MASKS_CACHE.get(key)
+    if hit is None:
+        hit = expand_generator_masks(gf, G)
+        if len(_MASKS_CACHE) > 1024:
+            _MASKS_CACHE.clear()
+        _MASKS_CACHE[key] = hit
+    return hit
+
+
 # ---------------------------------------------------------------------------
 # Bitplane packing
 

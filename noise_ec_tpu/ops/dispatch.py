@@ -24,7 +24,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from noise_ec_tpu.gf.bitmatrix import expand_generator_bits, expand_generator_masks
+from noise_ec_tpu.gf.bitmatrix import (
+    expand_generator_bits,
+    expand_generator_masks_cached,
+)
 from noise_ec_tpu.gf.field import GF, GF256, GF65536
 from noise_ec_tpu.ops.bitops import pack_bitplanes_jax, unpack_bitplanes_jax
 from noise_ec_tpu.ops.gf2mm import gf2_matmul_jax
@@ -60,10 +63,69 @@ def _fused_xla_fn(degree: int, r: int, k: int, S: int):
 
 
 @functools.lru_cache(maxsize=256)
+def _fused_words_fn(r: int, bits_rows: tuple, interpret: bool):
+    """GF(2^8) fused encode on uint32 WORDS: (k, TW) -> (r, TW).
+
+    The device never touches uint8: XLA's 8-bit (32, 128) tiling makes
+    u8<->u32 bitcasts a ~19 ms relayout on v5e, while host-side
+    ``ndarray.view('<u4')`` is free and HBM holds the same bytes either way.
+    TW must be a multiple of 1024 (callers pad; symbols are positionwise so
+    zero padding is sliced off harmlessly).
+
+    Pipeline: delta-swap pack kernel -> sparse GF(2) matmul kernel ->
+    delta-swap unpack kernel (pallas_pack layout contract).
+    """
+    from noise_ec_tpu.ops.pallas_pack import (
+        pack_words_pallas,
+        unpack_words_pallas,
+    )
+
+    def f(words):
+        k, TW = words.shape
+        planes = pack_words_pallas(words, interpret=interpret)  # (k, 8, W)
+        W = planes.shape[2]
+        tiled = planes.reshape(k * 8, 8, W // 8)
+        out = gf2_matmul_pallas_sparse_rows(
+            bits_rows, tiled, interpret=interpret
+        )  # (r*8, 8, W8)
+        planes_out = tiled_to_planes(out, W).reshape(r, 8, W)
+        return unpack_words_pallas(planes_out, interpret=interpret)
+
+    return jax.jit(f)
+
+
+WORD_QUANTUM = 1024  # uint32 words; 4096 bytes — pack-kernel grouping unit
+
+
+def pad_words(TW: int) -> int:
+    return -(-TW // WORD_QUANTUM) * WORD_QUANTUM
+
+
+@functools.lru_cache(maxsize=256)
 def _fused_sparse_fn(
     degree: int, r: int, S: int, bits_rows: tuple, interpret: bool
 ):
-    """Compiled shards -> product stripes with the matrix baked in."""
+    """Compiled (k, S)-symbol shards -> (r, S) product stripes.
+
+    GF(2^8) wraps ``_fused_words_fn`` in device-side u8 bitcasts — fine
+    under interpret/CPU tests; the TPU hot path enters at the words level
+    (``DeviceCodec.matmul_stripes`` / ``matmul_words``) to avoid the
+    relayout cost. GF(2^16) uses the jnp pack (16-register delta-swap
+    network is future work).
+    """
+    if degree == 8:
+        from noise_ec_tpu.ops.pallas_pack import bytes_to_words, words_to_bytes
+
+        Sp = 4 * pad_words(-(-S // 4))
+        wf = _fused_words_fn(r, bits_rows, interpret)
+
+        def f(shards):
+            if Sp != S:
+                shards = jnp.pad(shards, ((0, 0), (0, Sp - S)))
+            sym = words_to_bytes(wf(bytes_to_words(shards)))
+            return sym[:, :S] if Sp != S else sym
+
+        return jax.jit(f)
 
     def f(shards):
         planes = pack_bitplanes_jax(shards, degree)
@@ -91,7 +153,6 @@ class DeviceCodec:
         self.kernel = _resolve_kernel(kernel)
         if self.kernel not in ("pallas", "pallas_interpret", "xla"):
             raise ValueError(f"unknown kernel {self.kernel!r}")
-        self._mask_cache: dict[bytes, np.ndarray] = {}
         self._mask_dev_cache: dict[bytes, jnp.ndarray] = {}
         self._rows_cache: dict[bytes, tuple] = {}
 
@@ -100,15 +161,7 @@ class DeviceCodec:
 
     def masks_for(self, M: np.ndarray) -> np.ndarray:
         """(r, k) GF matrix -> (m*r, m*k) uint32 select-mask matrix, cached."""
-        M = np.ascontiguousarray(np.asarray(M, dtype=self.gf.dtype))
-        key = self._key(M)
-        hit = self._mask_cache.get(key)
-        if hit is None:
-            hit = expand_generator_masks(self.gf, M)
-            if len(self._mask_cache) > 4096:
-                self._mask_cache.clear()
-            self._mask_cache[key] = hit
-        return hit
+        return expand_generator_masks_cached(self.gf, M)
 
     def bits_rows_for(self, M: np.ndarray) -> tuple:
         """(r, k) GF matrix -> hashable per-row term tuples for the sparse
@@ -135,6 +188,24 @@ class DeviceCodec:
         if self.kernel == "xla":
             fn = _fused_xla_fn(m, r, k, S)
             out = fn(jnp.asarray(self.masks_for(M)), jnp.asarray(D))
+        elif m == 8:
+            # Host-side uint8 -> uint32 view (free when contiguous); the
+            # device program runs entirely on words.
+            TW = -(-S // 4)
+            TWp = pad_words(TW)
+            if 4 * TWp != S:
+                buf = np.zeros((k, 4 * TWp), dtype=np.uint8)
+                buf[:, :S] = D
+            else:
+                buf = np.ascontiguousarray(D)
+            words = buf.view("<u4")
+            fn = _fused_words_fn(
+                r, self.bits_rows_for(M), self.kernel == "pallas_interpret"
+            )
+            # np.array: writable copy (np.asarray of a jax array is read-only
+            # and callers are promised an ordinary ndarray).
+            out_w = np.array(fn(jnp.asarray(words)))
+            return np.ascontiguousarray(out_w.view(np.uint8)[:, :S])
         else:
             fn = _fused_sparse_fn(
                 m, r, S, self.bits_rows_for(M), self.kernel == "pallas_interpret"
@@ -143,6 +214,22 @@ class DeviceCodec:
         # np.array (copy) so callers get an ordinary writable ndarray, not a
         # read-only view of the device buffer.
         return np.array(out)
+
+    def matmul_words(self, M: np.ndarray, words: jnp.ndarray) -> jnp.ndarray:
+        """Device-resident GF(2^8) entry: (k, TW) uint32 -> (r, TW) uint32.
+
+        The words ARE the shard bytes (little-endian u32 view); TW must be a
+        multiple of WORD_QUANTUM. This is the zero-relayout hot path used by
+        bench and the parallel layer.
+        """
+        if self.gf.degree != 8:
+            raise ValueError("matmul_words is the GF(2^8) path")
+        if self.kernel == "xla":
+            raise ValueError("matmul_words requires a pallas kernel")
+        fn = _fused_words_fn(
+            M.shape[0], self.bits_rows_for(M), self.kernel == "pallas_interpret"
+        )
+        return fn(words)
 
     def matmul_planes(self, M: np.ndarray, planes: jnp.ndarray) -> jnp.ndarray:
         """Device-level entry on packed (C, W) planes (HBM-resident path).

@@ -1,0 +1,146 @@
+"""Pallas bitplane pack/unpack: delta-swap bit-matrix transpose kernels.
+
+The jnp pack in ``ops.bitops`` expands every bit to a uint32 lane (a 32x
+blow-up XLA materializes in HBM — measured ~1 GB/s on v5e). These kernels do
+the same bit-plane transpose in-register with the classic 3-round delta-swap
+8x8 bit-matrix transpose (~3 vector ops per word, no blow-up).
+
+Layout contract (consumed by ``ops.dispatch`` fused paths):
+
+- Input words: ``(k, TW)`` uint32 viewed from ``(k, S)`` uint8 shards
+  (TW = S/4), metadata-reshaped to ``(k, G8, 8, TL)`` so 8 consecutive
+  TL-lane runs sit on the sublane axis.
+- One group = the 8 words ``[j, g, 0..7, l]``; the kernel transposes each
+  group's 8x(4x8-bit) matrix so sublane ``i`` holds bit ``i`` of all 32
+  symbols of the group (bit position 8b+c <-> symbol 4c+b — a fixed,
+  bit-index-independent bijection, which is all the positionwise GF(2)
+  matmul needs; see pallas_gf2mm).
+- Pack output: ``(k, 8, W)`` uint32, W = TW/8; row-major reshape to the
+  matmul's ``(k*8, W)`` plane layout is metadata-only (sublane structure is
+  preserved: plane (j, i) = row 8j+i).
+- Unpack is the SAME transform (the transpose is an involution), reading
+  ``(r, 8, W)`` planes and writing ``(r, G8, 8, TL)`` -> ``(r, TW)`` words.
+
+The transpose network (verified against the bit-level spec in
+tests/test_pallas_pack.py): for d in (1, 2, 4) with masks 0x55..., 0x33...,
+0x0F...: t = ((V >> d) ^ roll(V, -d)) & m; V[c] ^= t[c] << d for c&d==0,
+V[c] ^= t[c-d] otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+PACK_TILE_LANES = 512
+_ROUNDS = ((1, 0x55555555), (2, 0x33333333), (4, 0x0F0F0F0F))
+
+
+def delta_swap8(V: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """8x8 bit transpose across the size-8 ``axis`` of uint32 words.
+
+    Involution: applying twice returns the input.
+    """
+    idx = lax.broadcasted_iota(jnp.uint32, V.shape, axis)
+    for d, m in _ROUNDS:
+        s = jnp.roll(V, -d, axis=axis)
+        t = ((V >> jnp.uint32(d)) ^ s) & jnp.uint32(m)
+        lo = V ^ (t << jnp.uint32(d))
+        hi = V ^ jnp.roll(t, d, axis=axis)
+        V = jnp.where((idx & jnp.uint32(d)) == 0, lo, hi)
+    return V
+
+
+def _pack_kernel(in_ref, out_ref):
+    # in: (k, 1, 8, TL) word groups; out: (k, 8, TL) bit-planes.
+    out_ref[:, :, :] = delta_swap8(in_ref[:, 0, :, :], axis=1)
+
+
+def _unpack_kernel(in_ref, out_ref):
+    # in: (r, 8, TL) bit-planes; out: (r, 1, 8, TL) word groups.
+    out_ref[:, 0, :, :] = delta_swap8(in_ref[:, :, :], axis=1)
+
+
+@functools.lru_cache(maxsize=256)
+def _pack_call(k: int, G8: int, TL: int, interpret: bool):
+    return pl.pallas_call(
+        _pack_kernel,
+        grid=(G8,),
+        in_specs=[
+            pl.BlockSpec((k, 1, 8, TL), lambda g: (0, g, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((k, 8, TL), lambda g: (0, 0, g),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((k, 8, G8 * TL), jnp.uint32),
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _unpack_call(r: int, G8: int, TL: int, interpret: bool):
+    return pl.pallas_call(
+        _unpack_kernel,
+        grid=(G8,),
+        in_specs=[
+            pl.BlockSpec((r, 8, TL), lambda g: (0, 0, g),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((r, 1, 8, TL), lambda g: (0, g, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((r, G8, 8, TL), jnp.uint32),
+        interpret=interpret,
+    )
+
+
+def _tile_lanes(TW: int, tile_lanes: int) -> int:
+    TL = min(tile_lanes, max(128, TW // 8))
+    while TW % (8 * TL):
+        TL //= 2
+        if TL < 128:
+            raise ValueError(f"word count {TW} not divisible by 8*128")
+    return TL
+
+
+def pack_words_pallas(xw: jnp.ndarray, *, tile_lanes: int = PACK_TILE_LANES,
+                      interpret: bool = False) -> jnp.ndarray:
+    """(k, TW) uint32 data words -> (k, 8, TW/8) uint32 bit-planes.
+
+    Row [j, i] is bit-plane i of shard j; reshape to (k*8, TW/8) for the
+    GF(2) matmul. TW must be a multiple of 8*128 (wrappers pad).
+    """
+    k, TW = xw.shape
+    TL = _tile_lanes(TW, tile_lanes)
+    G8 = TW // (8 * TL)
+    grouped = xw.reshape(k, G8, 8, TL)
+    return _pack_call(k, G8, TL, interpret)(grouped)
+
+
+def unpack_words_pallas(planes: jnp.ndarray, *,
+                        tile_lanes: int = PACK_TILE_LANES,
+                        interpret: bool = False) -> jnp.ndarray:
+    """(r, 8, W) uint32 bit-planes -> (r, 8*W) uint32 words (pack inverse)."""
+    r, eight, W = planes.shape
+    assert eight == 8, planes.shape
+    TW = 8 * W
+    TL = _tile_lanes(TW, tile_lanes)
+    G8 = TW // (8 * TL)
+    out = _unpack_call(r, G8, TL, interpret)(planes)
+    return out.reshape(r, TW)
+
+
+def bytes_to_words(x: jnp.ndarray) -> jnp.ndarray:
+    """(k, S) uint8 -> (k, S/4) uint32 (bitcast; S % 4 == 0)."""
+    k, S = x.shape
+    return lax.bitcast_convert_type(x.reshape(k, S // 4, 4), jnp.uint32)
+
+
+def words_to_bytes(xw: jnp.ndarray) -> jnp.ndarray:
+    """(r, TW) uint32 -> (r, 4*TW) uint8 (bitcast inverse)."""
+    r, TW = xw.shape
+    return lax.bitcast_convert_type(xw, jnp.uint8).reshape(r, 4 * TW)

@@ -33,7 +33,7 @@ try:  # JAX >= 0.4.35 exposes shard_map at top level
 except ImportError:  # pragma: no cover - version compat
     from jax.experimental.shard_map import shard_map as _shard_map
 
-from noise_ec_tpu.gf.bitmatrix import expand_generator_masks
+from noise_ec_tpu.gf.bitmatrix import expand_generator_masks_cached
 from noise_ec_tpu.gf.field import GF, GF256, GF65536
 from noise_ec_tpu.matrix.generators import generator_matrix
 from noise_ec_tpu.matrix.linalg import reconstruction_matrix
@@ -85,19 +85,16 @@ class BatchCodec:
         self.r = parity_shards
         self.n = data_shards + parity_shards
         self.G = generator_matrix(self.gf, self.k, self.n, matrix)
-        self._masks_cache: dict[bytes, np.ndarray] = {}
+        if not np.array_equal(self.G[: self.k], np.eye(self.k, dtype=self.gf.dtype)):
+            raise ValueError(
+                f"matrix kind {matrix!r} is not systematic; BatchCodec requires "
+                "systematic layout (same contract as codec.ReedSolomon)"
+            )
 
     # -- matrices ----------------------------------------------------------
 
     def _masks(self, M: np.ndarray) -> np.ndarray:
-        key = M.tobytes() + M.shape[1].to_bytes(4, "little")
-        hit = self._masks_cache.get(key)
-        if hit is None:
-            hit = expand_generator_masks(self.gf, M)
-            if len(self._masks_cache) > 1024:
-                self._masks_cache.clear()
-            self._masks_cache[key] = hit
-        return hit
+        return expand_generator_masks_cached(self.gf, M)
 
     @property
     def parity_matrix(self) -> np.ndarray:
@@ -126,16 +123,17 @@ class BatchCodec:
         """
         if len(present) < self.k:
             raise ValueError(f"need >= {self.k} present shards, got {len(present)}")
+        pos = {p: i for i, p in enumerate(present)}
         basis = sorted(present)[: self.k]
-        rows = [list(present).index(i) for i in basis]
-        missing = [i for i in range(self.n) if i not in present]
-        sub = jnp.asarray(batch_present)[:, rows, :]
+        missing = [i for i in range(self.n) if i not in pos]
+        bp = jnp.asarray(batch_present)
+        sub = bp[:, [pos[i] for i in basis], :]
         out_rows: list[Optional[jnp.ndarray]] = [None] * self.n
         for row, i in enumerate(basis):
             out_rows[i] = sub[:, row, :]
-        for j in list(present):
-            if j not in basis:
-                out_rows[j] = jnp.asarray(batch_present)[:, list(present).index(j), :]
+        for j in present:
+            if out_rows[j] is None:
+                out_rows[j] = bp[:, pos[j], :]
         if missing:
             R = reconstruction_matrix(self.gf, self.G, basis, missing)
             filled = self.matmul_batch(R, sub)

@@ -109,7 +109,10 @@ def decode_stream(chunks: Iterable[StreamChunk], data_shards: int,
     """Reassemble the byte stream from (in-order, complete) StreamChunks."""
     parts = []
     for c in chunks:
-        data = c.shards[:data_shards].reshape(-1)[: c.data_len]
+        arr = np.asarray(c.shards[:data_shards])
+        if arr.dtype != np.uint8:  # rebuilt gf65536 chunks arrive as uint16
+            arr = arr.view(np.uint8)
+        data = arr.reshape(-1)[: c.data_len]
         parts.append(data.tobytes())
     out = b"".join(parts)
     return out[:total_len] if total_len is not None else out
