@@ -1,0 +1,33 @@
+"""Host-side plugin runtime.
+
+The reference's layers L0-L5 above the codec (SURVEY.md §1): wire format,
+signing/identity, shard-reassembly mempool, plugin dispatch, transports,
+and the CLI REPL. All host code — the TPU work lives in ``ops``/``parallel``;
+this package is the boundary that feeds it.
+"""
+
+from noise_ec_tpu.host.wire import Shard, WireError
+from noise_ec_tpu.host.crypto import (
+    Blake2bPolicy,
+    Ed25519Policy,
+    KeyPair,
+    PeerID,
+    serialize_message,
+    verify,
+)
+from noise_ec_tpu.host.plugin import ShardPlugin, largest_prime_factor
+from noise_ec_tpu.host.mempool import ShardPool
+
+__all__ = [
+    "Shard",
+    "WireError",
+    "Blake2bPolicy",
+    "Ed25519Policy",
+    "KeyPair",
+    "PeerID",
+    "serialize_message",
+    "verify",
+    "ShardPlugin",
+    "ShardPool",
+    "largest_prime_factor",
+]

@@ -1,0 +1,173 @@
+"""Identity and signing: Ed25519 over BLAKE2b-256.
+
+Reproduces the reference's L1 crypto contract (SURVEY.md §2.3 D3):
+
+- ``KeyPair.random()`` — fresh identity per run (main.go:132), hex
+  accessors for key logging (main.go:134-135);
+- ``keys.sign(sig_policy, hash_policy, msg)`` — Ed25519 signature over
+  ``blake2b_256(msg)`` (main.go:219-223);
+- ``verify(sig_policy, hash_policy, pubkey, msg, sig)`` (main.go:82-89);
+- ``serialize_message(peer_id, message)`` — the canonical signing preimage
+  ``u32le(len(addr)) ‖ addr ‖ u32le(len(id)) ‖ id ‖ message``
+  (main.go:276-302). Used for both signing and verification, so sender and
+  receiver must agree on the peer's address string and node id.
+
+Policies are small strategy objects so alternate algorithms can slot in,
+matching the reference's SignaturePolicy/HashPolicy injection points
+(main.go:38-41, 45-46).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import dataclass
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+
+__all__ = [
+    "Blake2bPolicy",
+    "Ed25519Policy",
+    "KeyPair",
+    "PeerID",
+    "serialize_message",
+    "verify",
+]
+
+
+class Blake2bPolicy:
+    """BLAKE2b-256 hash policy (noise/crypto/blake2b.New())."""
+
+    digest_size = 32
+
+    def hash_bytes(self, data: bytes) -> bytes:
+        return hashlib.blake2b(data, digest_size=self.digest_size).digest()
+
+
+class Ed25519Policy:
+    """Ed25519 signature policy (noise/crypto/ed25519.New())."""
+
+    private_key_size = 32
+    public_key_size = 32
+    signature_size = 64
+
+    def sign(self, private_key: bytes, message: bytes) -> bytes:
+        return Ed25519PrivateKey.from_private_bytes(private_key).sign(message)
+
+    def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
+        if len(public_key) != self.public_key_size:
+            return False
+        try:
+            Ed25519PublicKey.from_public_bytes(public_key).verify(signature, message)
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+
+@dataclass(frozen=True)
+class KeyPair:
+    """An Ed25519 identity (noise/crypto.KeyPair)."""
+
+    private_key: bytes  # 32-byte seed
+    public_key: bytes
+
+    @classmethod
+    def random(cls) -> "KeyPair":
+        """Fresh identity, regenerated per run like the reference
+        (ed25519.RandomKeyPair(), main.go:132)."""
+        sk = Ed25519PrivateKey.generate()
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding,
+            NoEncryption,
+            PrivateFormat,
+            PublicFormat,
+        )
+
+        return cls(
+            private_key=sk.private_bytes(
+                Encoding.Raw, PrivateFormat.Raw, NoEncryption()
+            ),
+            public_key=sk.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw),
+        )
+
+    @classmethod
+    def from_seed(cls, seed: bytes) -> "KeyPair":
+        sk = Ed25519PrivateKey.from_private_bytes(seed)
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding,
+            PublicFormat,
+        )
+
+        return cls(
+            private_key=seed,
+            public_key=sk.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw),
+        )
+
+    def private_key_hex(self) -> str:
+        return self.private_key.hex()
+
+    def public_key_hex(self) -> str:
+        return self.public_key.hex()
+
+    def sign(
+        self, sig_policy: Ed25519Policy, hash_policy: Blake2bPolicy, message: bytes
+    ) -> bytes:
+        """Sign ``hash(message)`` — keys.Sign(sigPolicy, hashPolicy, msg),
+        main.go:219-223."""
+        return sig_policy.sign(self.private_key, hash_policy.hash_bytes(message))
+
+
+def verify(
+    sig_policy: Ed25519Policy,
+    hash_policy: Blake2bPolicy,
+    public_key: bytes,
+    message: bytes,
+    signature: bytes,
+) -> bool:
+    """crypto.Verify(sigPolicy, hashPolicy, pubkey, msg, sig) — main.go:82-89."""
+    return sig_policy.verify(public_key, hash_policy.hash_bytes(message), signature)
+
+
+@dataclass(frozen=True)
+class PeerID:
+    """Node identity on the wire (noise peer.ID: Address, Id, PublicKey).
+
+    ``node_id`` is the BLAKE2b-256 hash of the public key, as in noise's
+    peer.CreateID.
+    """
+
+    address: str
+    node_id: bytes
+    public_key: bytes
+
+    @classmethod
+    def create(cls, address: str, public_key: bytes) -> "PeerID":
+        return cls(
+            address=address,
+            node_id=Blake2bPolicy().hash_bytes(public_key),
+            public_key=public_key,
+        )
+
+
+def serialize_message(peer_id: PeerID, message: bytes) -> bytes:
+    """Canonical signing preimage (main.go:276-302):
+    ``u32le(len(addr)) ‖ addr ‖ u32le(len(id)) ‖ id ‖ message``.
+
+    The reference panics if the assembled buffer length mismatches the
+    precomputed size (main.go:297-299); here the construction makes that
+    impossible by design.
+    """
+    addr = peer_id.address.encode("utf-8")
+    return b"".join(
+        [
+            struct.pack("<I", len(addr)),
+            addr,
+            struct.pack("<I", len(peer_id.node_id)),
+            peer_id.node_id,
+            message,
+        ]
+    )

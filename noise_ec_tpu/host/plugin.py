@@ -1,0 +1,317 @@
+"""The shard plugin: encode/broadcast pipeline and receive state machine.
+
+This is the reference's L4 (``ShardPlugin``, main.go:43-115, 201-241)
+rebuilt on the TPU codec. The observable contract is preserved —
+
+- every outgoing message is signed over the ``serialize_message`` preimage
+  and the signature rides in each ``Shard.file_signature`` (main.go:219-223,
+  228-239);
+- the RS geometry (k, n) rides in every shard and the receiver always uses
+  the arriving message's geometry, never its own defaults (main.go:73);
+- when an input length is not divisible by k, the sender adjusts geometry
+  instead of padding: k := largest prime factor of the length, n += k
+  (main.go:185-191, reproduced bug-for-bug by the default policy);
+
+— while the internal pool defects are fixed (see host.mempool).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional, Protocol
+
+from noise_ec_tpu.codec.fec import FEC, Share
+from noise_ec_tpu.host.crypto import (
+    Blake2bPolicy,
+    Ed25519Policy,
+    KeyPair,
+    PeerID,
+    serialize_message,
+    verify,
+)
+from noise_ec_tpu.host.mempool import PoolTooLargeError, ShardPool
+from noise_ec_tpu.host.wire import Shard
+from noise_ec_tpu.utils.metrics import Counters
+
+__all__ = [
+    "ShardPlugin",
+    "PluginContext",
+    "CorruptionError",
+    "largest_prime_factor",
+    "DEFAULT_MINIMUM_NEEDED_SHARDS",
+    "DEFAULT_TOTAL_SHARDS",
+]
+
+log = logging.getLogger("noise_ec_tpu.host")
+
+# Reference defaults: RS(k=4, n=6), two parity shards (main.go:34-35).
+DEFAULT_MINIMUM_NEEDED_SHARDS = 4
+DEFAULT_TOTAL_SHARDS = 6
+
+
+class CorruptionError(RuntimeError):
+    """All n shards arrived and the signature still does not verify — the
+    message cannot be recovered (the reference's intended hard-failure
+    branch, main.go:96-98; unreachable there, reachable here because the
+    pool keeps accepting shares after a failed verify)."""
+
+
+def largest_prime_factor(n: int) -> int:
+    """Largest prime factor of ``n``; -1 for n <= 1.
+
+    Mirrors ``largestPrimeFactors`` (main.go:303-335, trial division to
+    sqrt) including its unguarded n <= 1 edge returning -1.
+    """
+    if n <= 1:
+        return -1
+    largest = -1
+    while n % 2 == 0:
+        largest = 2
+        n //= 2
+    p = 3
+    while p * p <= n:
+        while n % p == 0:
+            largest = p
+            n //= p
+        p += 2
+    if n > 1:
+        largest = n
+    return largest
+
+
+class PluginContext(Protocol):
+    """What the transport hands to ``receive`` — the slice of noise's
+    ``network.PluginContext`` the reference uses (main.go:53-87)."""
+
+    def message(self) -> object: ...
+    def sender(self) -> PeerID: ...
+    def client_public_key(self) -> bytes: ...
+
+
+class ShardPlugin:
+    """Erasure-shard broadcast/reassembly plugin.
+
+    Construction mirrors ``NewShardPlugin`` (main.go:108-115): signature
+    and hash policies plus the default RS geometry are injected; per-message
+    geometry still rides the wire and wins on receive.
+    """
+
+    def __init__(
+        self,
+        signature_policy: Optional[Ed25519Policy] = None,
+        hash_policy: Optional[Blake2bPolicy] = None,
+        minimum_needed_shards: int = DEFAULT_MINIMUM_NEEDED_SHARDS,
+        total_shards: int = DEFAULT_TOTAL_SHARDS,
+        *,
+        backend: str = "device",
+        on_message: Optional[Callable[[bytes, PeerID], None]] = None,
+        pool_ttl_seconds: Optional[float] = None,
+        adjust_geometry: bool = True,
+    ):
+        self.signature_policy = signature_policy or Ed25519Policy()
+        self.hash_policy = hash_policy or Blake2bPolicy()
+        self.minimum_needed_shards = minimum_needed_shards
+        self.total_shards = total_shards
+        self.backend = backend
+        self.on_message = on_message
+        self.adjust_geometry = adjust_geometry
+        self.pool = ShardPool(ttl_seconds=pool_ttl_seconds)
+        self.counters = Counters()
+        # Geometry is runtime-dynamic (SURVEY.md §7.4); cache one codec per
+        # (k, n) so repeated geometries reuse their jitted kernels.
+        self._fec_cache: dict[tuple[int, int], FEC] = {}
+        # GF(2^8) bound: n distinct evaluation points cap total shards at
+        # the field order (rs.py enforces the same on construction).
+        self.max_total_shards = 256
+
+    # ---------------------------------------------------------------- codec
+
+    def _fec(self, k: int, n: int) -> FEC:
+        fec = self._fec_cache.get((k, n))
+        if fec is None:
+            fec = FEC(k, n, backend=self.backend)
+            self._fec_cache[(k, n)] = fec
+        return fec
+
+    # ----------------------------------------------------------- send path
+
+    def shard_and_broadcast(self, network, input_bytes: bytes) -> list[Shard]:
+        """Encode ``input_bytes`` and broadcast one message per shard to all
+        peers (main.go:201-210). Returns the shards for callers that want
+        them (the reference discards them)."""
+        shards = self.prepare_shards(network.id, network.keys, input_bytes)
+        for shard in shards:
+            network.broadcast(shard)
+        self.counters.add("shards_out", len(shards))
+        self.counters.add("bytes_out", sum(len(s.shard_data) for s in shards))
+        return shards
+
+    def prepare_shards(
+        self, node_id: PeerID, keys: KeyPair, input_bytes: bytes
+    ) -> list[Shard]:
+        """Sign the plaintext, split it into shares, wrap each in a wire
+        ``Shard`` (main.go:211-241).
+
+        The reference shadows and never checks the ``Sign`` error
+        (main.go:219, noted in SURVEY.md C8); here a signing failure
+        propagates.
+        """
+        if not input_bytes:
+            raise ValueError("cannot prepare shards for empty input")  # main.go:215-217
+        k, n = self._adjusted_geometry(len(input_bytes))
+        file_signature = keys.sign(
+            self.signature_policy,
+            self.hash_policy,
+            serialize_message(node_id, input_bytes),
+        )
+        shares = self._fec(k, n).encode_shares(input_bytes)
+        return [
+            Shard(
+                file_signature=file_signature,
+                shard_data=s.data,
+                shard_number=s.number,
+                total_shards=n,
+                minimum_needed_shards=k,
+            )
+            for s in shares
+        ]
+
+    def _adjusted_geometry(self, length: int) -> tuple[int, int]:
+        """Dynamic geometry adjustment (main.go:185-191), reproduced
+        bug-for-bug: when the length is not divisible by k, k becomes the
+        largest prime factor of the length (so a prime-length message
+        degenerates to k = length, 1-byte stripes) and n *accumulates* —
+        ``n += k`` mutates plugin state, so n only ever grows over the
+        process lifetime. Interop is unaffected either way because geometry
+        rides in every shard; pass ``adjust_geometry=False`` to refuse
+        (raise) instead."""
+        k, n = self.minimum_needed_shards, self.total_shards
+        if length % k == 0:
+            return k, n
+        if not self.adjust_geometry:
+            raise ValueError(
+                f"input length {length} is not a multiple of k={k} "
+                "and geometry adjustment is disabled"
+            )
+        k = largest_prime_factor(length)
+        if k < 1:
+            raise ValueError(f"cannot shard {length}-byte input")
+        # Validate BEFORE mutating plugin state: an over-field geometry must
+        # not brick every subsequent send (the reference would panic inside
+        # infectious here; we reject and keep the old geometry).
+        if n + k > self.max_total_shards:
+            raise ValueError(
+                f"adjusted geometry k={k} n={n + k} exceeds the GF(2^8) "
+                f"limit of {self.max_total_shards} total shards; message "
+                f"length {length} cannot be sharded with accumulated n={n}"
+            )
+        self.minimum_needed_shards = k
+        self.total_shards = n + k
+        log.info(
+            "revised geometry: minimum_needed_shards=%d total_shards=%d",
+            self.minimum_needed_shards,
+            self.total_shards,
+        )
+        return self.minimum_needed_shards, self.total_shards
+
+    # -------------------------------------------------------- receive path
+
+    def receive(self, ctx: PluginContext) -> Optional[bytes]:
+        """Shard-reassembly state machine (main.go:52-107).
+
+        Returns the reassembled, signature-verified plaintext when this
+        arrival completes an object, else None. Raises
+        :class:`CorruptionError` / :class:`PoolTooLargeError` where the
+        reference returns its CASE C/D errors.
+
+        Case map vs the reference (§3.2): A/B collapse into ``pool.add``
+        (first arrival and accumulation are the same code path); C fires at
+        k *distinct* shares including this one; D lives in the pool.
+        """
+        msg = ctx.message()
+        if not isinstance(msg, Shard):  # type switch, main.go:53-54
+            return None
+        self.counters.add("shards_in", 1)
+        self.counters.add("bytes_in", len(msg.shard_data))
+        key = msg.file_signature.hex()  # mempool key, main.go:55
+        share = Share(msg.shard_number, bytes(msg.shard_data))
+        k = int(msg.minimum_needed_shards)
+        n = int(msg.total_shards)
+        # Full message validation up front: geometry within the field bound
+        # and share number within the geometry. One malformed (or
+        # adversarial) message must neither crash the transport's dispatch
+        # loop nor poison the pool for the legitimate shards.
+        if not 1 <= k <= n <= self.max_total_shards:
+            self.counters.add("rejected_shards", 1)
+            raise ValueError(f"invalid geometry k={k} n={n} in shard message")
+        if not 0 <= msg.shard_number < n:
+            self.counters.add("rejected_shards", 1)
+            raise ValueError(
+                f"shard number {msg.shard_number} out of range for n={n}"
+            )
+        try:
+            snapshot, distinct, was_new = self.pool.add(key, share, k, n)
+        except PoolTooLargeError:
+            self.counters.add("pool_overflows", 1)
+            raise
+        except ValueError:
+            # Geometry or length disagrees with the pinned pool: drop this
+            # share, keep the pool intact.
+            self.counters.add("rejected_shards", 1)
+            raise
+        if distinct < k:
+            return None  # CASE A/B: keep accumulating (main.go:56-71)
+        if not was_new:
+            # A replayed duplicate adds no information; don't pay another
+            # decode + verify for it.
+            return None
+
+        # CASE C: enough distinct shares — decode + verify (main.go:72-99).
+        fec = self._fec(k, n)
+        try:
+            complete = fec.decode(snapshot)
+        except Exception as exc:
+            # The reference logs decode errors and falls through to a
+            # doomed Verify on nil (main.go:75-80, quirk 5); we log and
+            # wait for more shares — unless every share number has arrived,
+            # in which case no future arrival can help (duplicates
+            # short-circuit above) and the object is unrecoverable.
+            self.counters.add("decode_errors", 1)
+            log.error("decode failed for %s…: %s", key[:16], exc)
+            if distinct >= n:
+                self.pool.evict(key)
+                raise CorruptionError(
+                    f"all {n} shards arrived for {key[:16]}… but decode "
+                    f"fails: {exc}"
+                ) from exc
+            return None
+        self.counters.add("decodes", 1)
+
+        sender = ctx.sender()
+        ok = verify(
+            self.signature_policy,
+            self.hash_policy,
+            ctx.client_public_key(),  # transport sender == original encoder
+            serialize_message(sender, complete),  # (main.go:85, quirk 6)
+            msg.file_signature,
+        )
+        if ok:
+            self.pool.evict(key)  # main.go:90-93
+            self.counters.add("verified", 1)
+            log.info("completed message %s… (%d bytes)", complete[:32].hex(), len(complete))
+            if self.on_message is not None:
+                self.on_message(complete, sender)
+            return complete
+
+        self.counters.add("verify_failures", 1)
+        log.warning("signature verify failed for %s…", key[:16])
+        if distinct >= n:
+            # Every shard arrived and the object still fails verification:
+            # unrecoverable (main.go:96-98 made reachable — see
+            # CorruptionError docstring).
+            self.pool.evict(key)
+            raise CorruptionError(
+                f"all {n} shards arrived for {key[:16]}… but the signature "
+                "does not verify"
+            )
+        return None

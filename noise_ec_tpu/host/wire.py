@@ -1,0 +1,222 @@
+"""Wire format: the ``erasurecode.Shard`` proto3 message.
+
+Byte-compatible with the reference's wire schema (field numbers and types
+are the compatibility contract — SURVEY.md §2.3 D4):
+
+    message Shard {                         // /root/reference/protobuf/shard.proto:21-27
+      bytes  file_signature        = 1;
+      bytes  shard_data            = 2;
+      uint64 shard_number          = 3;
+      uint64 total_shards          = 4;
+      uint64 minimum_needed_shards = 5;
+    }
+
+The codec is hand-rolled (no protobuf runtime dependency), mirroring the
+observable semantics of the reference's generated gogoproto code:
+
+- marshal writes tags 0x0a/0x12/0x18/0x20/0x28 in field order and **omits**
+  empty bytes / zero varints (proto3 default elision —
+  /root/reference/protobuf/shard.pb.go:219-252);
+- unmarshal is a varint-driven field loop with overflow and truncation
+  checks (shard.pb.go:413-581); unknown fields are skipped, including
+  nested group recursion (``skipShard``, shard.pb.go:582-680); a known
+  field with the wrong wire type is an error;
+- ``size()`` equals ``len(marshal())`` (shard.pb.go:355-376);
+- ``populate(rng)`` is the randomized-instance generator the reference's
+  fuzz tests build on (``NewPopulatedShard``, shard.pb.go:263-281).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = ["Shard", "WireError", "marshal_shard", "unmarshal_shard"]
+
+_MAX_VARINT_BYTES = 10  # 64-bit varints occupy at most 10 bytes
+
+
+class WireError(ValueError):
+    """Malformed wire bytes (truncation, varint overflow, bad wire type)."""
+
+
+def _put_varint(out: bytearray, v: int) -> None:
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+
+
+def _varint_size(v: int) -> int:
+    n = 1
+    while v >= 0x80:
+        v >>= 7
+        n += 1
+    return n
+
+
+def _get_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    """Decode a varint at ``pos``; returns (value, new_pos)."""
+    result = 0
+    shift = 0
+    start = pos
+    while True:
+        if pos >= len(buf):
+            raise WireError("unexpected EOF in varint")
+        if pos - start >= _MAX_VARINT_BYTES:
+            raise WireError("varint overflow")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result & 0xFFFFFFFFFFFFFFFF, pos
+        shift += 7
+
+
+def _skip_field(buf: bytes, pos: int, wire_type: int, depth: int = 0) -> int:
+    """Skip one unknown field's payload; mirrors skipShard
+    (shard.pb.go:582-680) including group recursion."""
+    if depth > 64:
+        raise WireError("group nesting too deep")
+    if wire_type == 0:  # varint
+        _, pos = _get_varint(buf, pos)
+        return pos
+    if wire_type == 1:  # fixed64
+        if pos + 8 > len(buf):
+            raise WireError("unexpected EOF in fixed64")
+        return pos + 8
+    if wire_type == 2:  # length-delimited
+        ln, pos = _get_varint(buf, pos)
+        if ln < 0 or pos + ln > len(buf):
+            raise WireError("unexpected EOF in bytes field")
+        return pos + ln
+    if wire_type == 3:  # start group: skip until matching end group
+        while True:
+            if pos >= len(buf):
+                raise WireError("unexpected EOF in group")
+            tag, pos = _get_varint(buf, pos)
+            inner_type = tag & 0x7
+            if inner_type == 4:  # end group
+                return pos
+            pos = _skip_field(buf, pos, inner_type, depth + 1)
+    if wire_type == 5:  # fixed32
+        if pos + 4 > len(buf):
+            raise WireError("unexpected EOF in fixed32")
+        return pos + 4
+    raise WireError(f"illegal wire type {wire_type}")
+
+
+@dataclass
+class Shard:
+    """One erasure-coded shard in flight (SURVEY.md C13).
+
+    ``file_signature`` is the ed25519 signature of the *whole* original
+    message (not this shard) — it identifies the reassembly pool and
+    provides end-to-end integrity. ``total_shards``/``minimum_needed_shards``
+    carry the RS geometry so the receiver never relies on its own defaults
+    (main.go:73, §3.1 geometry note).
+    """
+
+    file_signature: bytes = b""
+    shard_data: bytes = b""
+    shard_number: int = 0
+    total_shards: int = 0
+    minimum_needed_shards: int = 0
+
+    def marshal(self) -> bytes:
+        out = bytearray()
+        if self.file_signature:
+            out.append(0x0A)
+            _put_varint(out, len(self.file_signature))
+            out += self.file_signature
+        if self.shard_data:
+            out.append(0x12)
+            _put_varint(out, len(self.shard_data))
+            out += self.shard_data
+        if self.shard_number:
+            out.append(0x18)
+            _put_varint(out, self.shard_number)
+        if self.total_shards:
+            out.append(0x20)
+            _put_varint(out, self.total_shards)
+        if self.minimum_needed_shards:
+            out.append(0x28)
+            _put_varint(out, self.minimum_needed_shards)
+        return bytes(out)
+
+    def size(self) -> int:
+        n = 0
+        if self.file_signature:
+            ln = len(self.file_signature)
+            n += 1 + _varint_size(ln) + ln
+        if self.shard_data:
+            ln = len(self.shard_data)
+            n += 1 + _varint_size(ln) + ln
+        if self.shard_number:
+            n += 1 + _varint_size(self.shard_number)
+        if self.total_shards:
+            n += 1 + _varint_size(self.total_shards)
+        if self.minimum_needed_shards:
+            n += 1 + _varint_size(self.minimum_needed_shards)
+        return n
+
+    @classmethod
+    def unmarshal(cls, buf: bytes) -> "Shard":
+        buf = bytes(buf)
+        msg = cls()
+        pos = 0
+        while pos < len(buf):
+            tag, pos = _get_varint(buf, pos)
+            field_num = tag >> 3
+            wire_type = tag & 0x7
+            if field_num == 0:
+                raise WireError("illegal field number 0")
+            if field_num in (1, 2):
+                if wire_type != 2:
+                    raise WireError(
+                        f"field {field_num}: expected wire type 2, got {wire_type}"
+                    )
+                ln, pos = _get_varint(buf, pos)
+                if pos + ln > len(buf):
+                    raise WireError("unexpected EOF in bytes field")
+                val = buf[pos : pos + ln]
+                pos += ln
+                if field_num == 1:
+                    msg.file_signature = val
+                else:
+                    msg.shard_data = val
+            elif field_num in (3, 4, 5):
+                if wire_type != 0:
+                    raise WireError(
+                        f"field {field_num}: expected wire type 0, got {wire_type}"
+                    )
+                val, pos = _get_varint(buf, pos)
+                if field_num == 3:
+                    msg.shard_number = val
+                elif field_num == 4:
+                    msg.total_shards = val
+                else:
+                    msg.minimum_needed_shards = val
+            else:
+                pos = _skip_field(buf, pos, wire_type)
+        return msg
+
+    @classmethod
+    def populate(cls, rng) -> "Shard":
+        """Random instance for property/fuzz tests (mirrors
+        NewPopulatedShard, shard.pb.go:263-281: 0-99-byte bytes fields,
+        u32-range varints)."""
+        return cls(
+            file_signature=bytes(rng.integers(0, 256, size=int(rng.integers(0, 100)), dtype=int).tolist()),
+            shard_data=bytes(rng.integers(0, 256, size=int(rng.integers(0, 100)), dtype=int).tolist()),
+            shard_number=int(rng.integers(0, 1 << 32)),
+            total_shards=int(rng.integers(0, 1 << 32)),
+            minimum_needed_shards=int(rng.integers(0, 1 << 32)),
+        )
+
+
+def marshal_shard(s: Shard) -> bytes:
+    return s.marshal()
+
+
+def unmarshal_shard(buf: bytes) -> Shard:
+    return Shard.unmarshal(buf)

@@ -1,0 +1,6 @@
+"""Shared host utilities: metrics counters and logging setup."""
+
+from noise_ec_tpu.utils.metrics import Counters, Timer
+from noise_ec_tpu.utils.logging import setup_logging
+
+__all__ = ["Counters", "Timer", "setup_logging"]

@@ -1,0 +1,74 @@
+"""Crypto-layer tests: Ed25519 policy against RFC 8032 vectors, the
+sign-over-blake2b contract (main.go:219-223), and the serialize_message
+preimage layout (main.go:276-302)."""
+
+import hashlib
+import struct
+
+from noise_ec_tpu.host.crypto import (
+    Blake2bPolicy,
+    Ed25519Policy,
+    KeyPair,
+    PeerID,
+    serialize_message,
+    verify,
+)
+
+# RFC 8032 §7.1 test vector 2 (1-byte message 0x72).
+RFC_SEED = bytes.fromhex(
+    "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb"
+)
+RFC_PUB = bytes.fromhex(
+    "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c"
+)
+RFC_MSG = bytes.fromhex("72")
+RFC_SIG = bytes.fromhex(
+    "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+    "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00"
+)
+
+
+def test_rfc8032_vector():
+    pol = Ed25519Policy()
+    kp = KeyPair.from_seed(RFC_SEED)
+    assert kp.public_key == RFC_PUB
+    assert pol.sign(RFC_SEED, RFC_MSG) == RFC_SIG
+    assert pol.verify(RFC_PUB, RFC_MSG, RFC_SIG)
+    assert not pol.verify(RFC_PUB, RFC_MSG + b"x", RFC_SIG)
+
+
+def test_sign_hashes_with_blake2b():
+    """keys.Sign(sig, hash, msg) signs blake2b_256(msg), not msg itself."""
+    kp = KeyPair.from_seed(RFC_SEED)
+    msg = b"hello shards"
+    sig = kp.sign(Ed25519Policy(), Blake2bPolicy(), msg)
+    digest = hashlib.blake2b(msg, digest_size=32).digest()
+    assert Ed25519Policy().verify(kp.public_key, digest, sig)
+    assert verify(Ed25519Policy(), Blake2bPolicy(), kp.public_key, msg, sig)
+    assert not verify(Ed25519Policy(), Blake2bPolicy(), kp.public_key, msg + b"!", sig)
+
+
+def test_random_keypair_roundtrip_and_hex():
+    kp = KeyPair.random()
+    assert len(kp.private_key) == 32 and len(kp.public_key) == 32
+    assert bytes.fromhex(kp.private_key_hex()) == kp.private_key
+    assert bytes.fromhex(kp.public_key_hex()) == kp.public_key
+    sig = kp.sign(Ed25519Policy(), Blake2bPolicy(), b"m")
+    assert verify(Ed25519Policy(), Blake2bPolicy(), kp.public_key, b"m", sig)
+    other = KeyPair.random()
+    assert not verify(Ed25519Policy(), Blake2bPolicy(), other.public_key, b"m", sig)
+
+
+def test_serialize_message_layout():
+    """u32le(len(addr)) ‖ addr ‖ u32le(len(id)) ‖ id ‖ message."""
+    pid = PeerID(address="tcp://localhost:3000", node_id=b"\x01\x02\x03", public_key=b"")
+    out = serialize_message(pid, b"payload")
+    addr = b"tcp://localhost:3000"
+    assert out == struct.pack("<I", len(addr)) + addr + struct.pack("<I", 3) + b"\x01\x02\x03" + b"payload"
+
+
+def test_peer_id_create_hashes_pubkey():
+    kp = KeyPair.random()
+    pid = PeerID.create("tcp://h:1", kp.public_key)
+    assert pid.node_id == hashlib.blake2b(kp.public_key, digest_size=32).digest()
+    assert pid.public_key == kp.public_key

@@ -1,0 +1,407 @@
+"""ShardPlugin state-machine tests (SURVEY.md §3.2 cases A-D), including the
+deliberate divergences from the reference's quirks 1-4, the dynamic-geometry
+send path (§3.1), and mempool behavior under duplication and threads."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from noise_ec_tpu.codec.fec import Share
+from noise_ec_tpu.host.crypto import Blake2bPolicy, Ed25519Policy, KeyPair, PeerID
+from noise_ec_tpu.host.mempool import PoolTooLargeError, ShardPool
+from noise_ec_tpu.host.plugin import (
+    CorruptionError,
+    ShardPlugin,
+    largest_prime_factor,
+)
+from noise_ec_tpu.host.wire import Shard
+
+
+class Ctx:
+    """Minimal PluginContext for driving receive() directly."""
+
+    def __init__(self, msg, sender: PeerID):
+        self._msg = msg
+        self._sender = sender
+
+    def message(self):
+        return self._msg
+
+    def sender(self):
+        return self._sender
+
+    def client_public_key(self):
+        return self._sender.public_key
+
+
+def make_sender(address="tcp://localhost:3000"):
+    kp = KeyPair.from_seed(bytes(range(32)))
+    return kp, PeerID.create(address, kp.public_key)
+
+
+class FakeNet:
+    def __init__(self, keys, pid):
+        self.keys = keys
+        self.id = pid
+        self.sent = []
+
+    def broadcast(self, msg):
+        self.sent.append(msg)
+
+
+def encode_side(plugin, payload, address="tcp://localhost:3000"):
+    keys, pid = make_sender(address)
+    return pid, plugin.prepare_shards(pid, keys, payload)
+
+
+# ------------------------------------------------------------ receive path
+
+
+def test_receive_completes_at_k_distinct():
+    """Divergence from quirk 1: decode fires on the k-th *distinct* share
+    (the reference needs k+1 arrivals and drops the trigger share,
+    main.go:65-72)."""
+    sender = ShardPlugin(backend="numpy")
+    receiver = ShardPlugin(backend="numpy")
+    payload = b"0123456789ab"  # 12 bytes, k=4 -> stride 3
+    pid, shards = encode_side(sender, payload)
+    assert len(shards) == 6
+    for s in shards[:3]:
+        assert receiver.receive(Ctx(s, pid)) is None
+    assert receiver.receive(Ctx(shards[3], pid)) == payload
+    assert len(receiver.pool) == 0  # evicted on success (main.go:91)
+
+
+def test_receive_any_k_of_n_subsets():
+    payload = b"x" * 64
+    for subset in ([0, 1, 2, 3], [2, 3, 4, 5], [0, 2, 4, 5], [5, 4, 3, 2]):
+        sender = ShardPlugin(backend="numpy")
+        receiver = ShardPlugin(backend="numpy")
+        pid, shards = encode_side(sender, payload)
+        out = None
+        for i in subset:
+            out = receiver.receive(Ctx(shards[i], pid))
+        assert out == payload
+
+
+def test_receive_dedups_by_share_number():
+    """Divergence from quirk 3: duplicate delivery is idempotent."""
+    sender = ShardPlugin(backend="numpy")
+    receiver = ShardPlugin(backend="numpy")
+    pid, shards = encode_side(sender, b"y" * 16)
+    for _ in range(5):
+        assert receiver.receive(Ctx(shards[0], pid)) is None
+    for s in shards[1:3]:
+        assert receiver.receive(Ctx(s, pid)) is None
+    assert receiver.receive(Ctx(shards[3], pid)) == b"y" * 16
+
+
+def test_receive_ignores_non_shard_messages():
+    receiver = ShardPlugin(backend="numpy")
+    _, pid = make_sender()
+    assert receiver.receive(Ctx(object(), pid)) is None
+    assert receiver.receive(Ctx(b"raw", pid)) is None
+
+
+def test_receive_corrected_share_still_verifies():
+    """A corrupted share among the survivors is corrected once enough extra
+    shares arrive (the Berlekamp-Welch-class guarantee the reference gets
+    from infectious.Decode — SURVEY.md §2.3 D1)."""
+    sender = ShardPlugin(backend="numpy")
+    receiver = ShardPlugin(backend="numpy")
+    payload = b"q" * 32
+    pid, shards = encode_side(sender, payload)
+    bad = Shard(
+        file_signature=shards[0].file_signature,
+        shard_data=bytes(b ^ 0xFF for b in shards[0].shard_data),
+        shard_number=shards[0].shard_number,
+        total_shards=shards[0].total_shards,
+        minimum_needed_shards=shards[0].minimum_needed_shards,
+    )
+    receiver.receive(Ctx(bad, pid))
+    out = None
+    for s in shards[1:]:  # 5 good shares + 1 bad = 6 total, radius floor((6-4)/2)=1
+        out = receiver.receive(Ctx(s, pid))
+    assert out == payload
+
+
+def test_receive_unverifiable_raises_corruption_at_n():
+    """CASE C failure path: a stream signed with the wrong key decodes but
+    never verifies; once all n distinct shards arrived → CorruptionError
+    (the reference's intended main.go:96-98 branch, unreachable there —
+    quirk 3a — made reachable here)."""
+    sender = ShardPlugin(backend="numpy")
+    receiver = ShardPlugin(backend="numpy")
+    payload = b"z" * 24
+    pid, shards = encode_side(sender, payload)
+    impostor = KeyPair.random()
+    wrong_pid = PeerID.create("tcp://evil:1", impostor.public_key)
+    for s in shards[:-1]:
+        assert receiver.receive(Ctx(s, wrong_pid)) is None
+    with pytest.raises(CorruptionError):
+        receiver.receive(Ctx(shards[-1], wrong_pid))
+    assert len(receiver.pool) == 0
+    assert receiver.counters.get("verify_failures") >= 1
+
+
+def test_receive_rejects_invalid_geometry():
+    receiver = ShardPlugin(backend="numpy")
+    _, pid = make_sender()
+    bad = Shard(file_signature=b"s", shard_data=b"d", shard_number=0,
+                total_shards=2, minimum_needed_shards=5)
+    with pytest.raises(ValueError):
+        receiver.receive(Ctx(bad, pid))
+
+
+def test_pool_too_large_for_adversarial_geometry():
+    """CASE D (main.go:100-102): reachable only when the advertised geometry
+    varies under one signature (SURVEY.md §3.2 quirk 3a)."""
+    receiver = ShardPlugin(backend="numpy")
+    _, pid = make_sender()
+
+    def shard(num):
+        return Shard(file_signature=b"k", shard_data=bytes(64), shard_number=num,
+                     total_shards=2, minimum_needed_shards=1)
+
+    # distinct=1 -> decode fires (k=1) but verify fails -> pool kept
+    assert receiver.receive(Ctx(shard(0), pid)) is None
+    with pytest.raises((PoolTooLargeError, CorruptionError)):
+        receiver.receive(Ctx(shard(1), pid))
+        receiver.receive(Ctx(shard(2), pid))
+
+
+# --------------------------------------------------------------- send path
+
+
+def test_prepare_shards_contents():
+    plugin = ShardPlugin(backend="numpy")
+    keys, pid = make_sender()
+    payload = b"0123456789ab"
+    shards = plugin.prepare_shards(pid, keys, payload)
+    assert [s.shard_number for s in shards] == list(range(6))
+    assert all(s.total_shards == 6 and s.minimum_needed_shards == 4 for s in shards)
+    sig = shards[0].file_signature
+    assert all(s.file_signature == sig for s in shards)
+    # systematic: first k shards concatenate to the payload
+    assert b"".join(s.shard_data for s in shards[:4]) == payload
+
+
+def test_prepare_shards_empty_raises():
+    plugin = ShardPlugin(backend="numpy")
+    keys, pid = make_sender()
+    with pytest.raises(ValueError):
+        plugin.prepare_shards(pid, keys, b"")  # nil guard, main.go:215-217
+
+
+def test_shard_and_broadcast_fans_out():
+    plugin = ShardPlugin(backend="numpy")
+    keys, pid = make_sender()
+    net = FakeNet(keys, pid)
+    out = plugin.shard_and_broadcast(net, b"a" * 16)
+    assert net.sent == out and len(net.sent) == 6
+    assert plugin.counters.get("shards_out") == 6
+
+
+def test_geometry_adjustment_mirrors_reference():
+    """main.go:185-191: k := lpf(len), n += k; n accumulates across
+    messages."""
+    plugin = ShardPlugin(backend="numpy")
+    keys, pid = make_sender()
+    shards = plugin.prepare_shards(pid, keys, b"q" * 15)  # 15 % 4 != 0, lpf=5
+    assert plugin.minimum_needed_shards == 5 and plugin.total_shards == 11
+    assert len(shards) == 11
+    # a second awkward length grows n again: 14 % 5 != 0, lpf(14)=7, n=11+7
+    plugin.prepare_shards(pid, keys, b"q" * 14)
+    assert plugin.minimum_needed_shards == 7 and plugin.total_shards == 18
+
+
+def test_geometry_adjustment_can_be_disabled():
+    plugin = ShardPlugin(backend="numpy", adjust_geometry=False)
+    keys, pid = make_sender()
+    with pytest.raises(ValueError):
+        plugin.prepare_shards(pid, keys, b"q" * 15)
+
+
+def test_roundtrip_after_geometry_adjustment():
+    """Receiver uses the geometry riding in each message (main.go:73), so
+    sender-side adjustment needs no coordination."""
+    sender = ShardPlugin(backend="numpy")
+    receiver = ShardPlugin(backend="numpy")
+    payload = b"seventeen bytes!!"  # 17 bytes: prime -> k=17, n=6+17=23
+    pid, shards = encode_side(sender, payload)
+    assert sender.minimum_needed_shards == 17
+    out = None
+    for s in shards[:17]:
+        out = receiver.receive(Ctx(s, pid))
+    assert out == payload
+
+
+def test_largest_prime_factor():
+    assert largest_prime_factor(1) == -1  # unguarded edge (main.go:325-333)
+    assert largest_prime_factor(0) == -1
+    assert largest_prime_factor(2) == 2
+    assert largest_prime_factor(12) == 3
+    assert largest_prime_factor(15) == 5
+    assert largest_prime_factor(17) == 17
+    assert largest_prime_factor(49) == 7
+    assert largest_prime_factor(2 * 3 * 5 * 7 * 11) == 11
+
+
+# ----------------------------------------------------------------- mempool
+
+
+def test_mempool_dedup_and_snapshot_order():
+    pool = ShardPool()
+    _, _, new1 = pool.add("k", Share(3, b"c"), 4, 6)
+    _, _, new2 = pool.add("k", Share(1, b"a"), 4, 6)
+    snap, n, new3 = pool.add("k", Share(3, b"z"), 4, 6)  # dup number: first wins
+    assert (new1, new2, new3) == (True, True, False)
+    assert n == 2
+    assert [(s.number, s.data) for s in snap] == [(1, b"a"), (3, b"c")]
+
+
+def test_mempool_rejects_length_mismatch():
+    pool = ShardPool()
+    pool.add("k", Share(0, b"abcd"), 4, 6)
+    with pytest.raises(ValueError):
+        pool.add("k", Share(1, b"ab"), 4, 6)
+    _, n, _ = pool.add("k", Share(2, b"wxyz"), 4, 6)  # pool intact
+    assert n == 2
+
+
+def test_mempool_pins_geometry():
+    """A forged message advertising a different (k, n) under the same
+    signature is rejected and cannot evict the legitimate pool."""
+    from noise_ec_tpu.host.mempool import GeometryMismatchError
+
+    pool = ShardPool()
+    pool.add("k", Share(0, b"abcd"), 4, 6)
+    pool.add("k", Share(1, b"efgh"), 4, 6)
+    with pytest.raises(GeometryMismatchError):
+        pool.add("k", Share(0, b"abcd"), 1, 1)  # forged CASE D trigger
+    _, n, _ = pool.add("k", Share(2, b"ijkl"), 4, 6)  # pool intact
+    assert n == 3
+
+
+def test_mempool_thread_safety():
+    """Divergence from quirk 4: concurrent adds never drop shares."""
+    pool = ShardPool()
+    nthreads, per = 8, 50
+
+    def work(t):
+        for i in range(per):
+            pool.add("k", Share(t * per + i, b"d"), 4, 10**9)
+
+    threads = [threading.Thread(target=work, args=(t,)) for t in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap, n, _ = pool.add("k", Share(10**6, b"d"), 4, 10**9)
+    assert n == nthreads * per + 1
+
+
+def test_mempool_ttl_expiry():
+    pool = ShardPool(ttl_seconds=0.0)
+    pool.add("a", Share(0, b"x"), 4, 6)
+    _, n, _ = pool.add("b", Share(0, b"x"), 4, 6)  # triggers expiry sweep of "a"
+    assert n == 1
+    assert pool.get("a") is None
+
+
+def test_receive_decode_failure_at_n_hard_fails():
+    """When every share number has arrived but decode still fails (poisoned
+    first share pinning a bogus length is the canonical path), the pool is
+    evicted and CorruptionError raised — no silent forever-stuck entry."""
+    receiver = ShardPlugin(backend="numpy")
+    _, pid = make_sender()
+    # k=2, n=2: two 1-byte shares that claim a valid geometry but decode to
+    # something whose signature can never verify; use k=n so decode uses
+    # both, with share data engineered to hit the decode-error path via
+    # mismatched... simpler: k=2,n=3 with all three shares mutually
+    # inconsistent still decodes (erasure math always "succeeds" with k
+    # shares) — so drive the decode failure with an exception-raising FEC.
+    sender = ShardPlugin(backend="numpy")
+    payload = b"h" * 16
+    pid, shards = encode_side(sender, payload)
+
+    class BoomFEC:
+        def decode(self, snapshot):
+            raise RuntimeError("boom")
+
+    receiver._fec_cache[(4, 6)] = BoomFEC()
+    for s in shards[:5]:
+        assert receiver.receive(Ctx(s, pid)) is None
+    with pytest.raises(CorruptionError):
+        receiver.receive(Ctx(shards[5], pid))
+    assert len(receiver.pool) == 0
+
+
+# ------------------------------------------------- adversarial-input guards
+
+
+def test_receive_rejects_over_field_geometry():
+    """One message advertising n > 256 must raise cleanly, not construct a
+    codec (GF(2^8) caps total shards at the field order)."""
+    receiver = ShardPlugin(backend="numpy")
+    _, pid = make_sender()
+    bad = Shard(file_signature=b"s", shard_data=b"d", shard_number=0,
+                total_shards=257, minimum_needed_shards=1)
+    with pytest.raises(ValueError):
+        receiver.receive(Ctx(bad, pid))
+    assert receiver.counters.get("rejected_shards") == 1
+
+
+def test_receive_rejects_out_of_range_shard_number():
+    receiver = ShardPlugin(backend="numpy")
+    _, pid = make_sender()
+    bad = Shard(file_signature=b"s", shard_data=b"d", shard_number=6,
+                total_shards=6, minimum_needed_shards=4)
+    with pytest.raises(ValueError):
+        receiver.receive(Ctx(bad, pid))
+    assert len(receiver.pool) == 0  # nothing pooled
+
+
+def test_receive_length_mismatch_does_not_poison_pool():
+    """A bad-length share is dropped; the legitimate stream still completes."""
+    sender = ShardPlugin(backend="numpy")
+    receiver = ShardPlugin(backend="numpy")
+    payload = b"m" * 16
+    pid, shards = encode_side(sender, payload)
+    receiver.receive(Ctx(shards[0], pid))
+    evil = Shard(file_signature=shards[0].file_signature, shard_data=b"xx",
+                 shard_number=5, total_shards=6, minimum_needed_shards=4)
+    with pytest.raises(ValueError):
+        receiver.receive(Ctx(evil, pid))
+    out = None
+    for s in shards[1:4]:
+        out = receiver.receive(Ctx(s, pid))
+    assert out == payload
+
+
+def test_receive_duplicate_after_k_skips_redecode():
+    """Replaying a pooled share after k distinct arrived must not re-run
+    decode + verify (replay-DoS guard)."""
+    sender = ShardPlugin(backend="numpy")
+    receiver = ShardPlugin(backend="numpy")
+    pid, shards = encode_side(sender, b"r" * 16)
+    impostor = KeyPair.random()
+    wrong_pid = PeerID.create("tcp://evil:1", impostor.public_key)
+    for s in shards[:4]:  # decode fires at 4th, verify fails, pool kept
+        receiver.receive(Ctx(s, wrong_pid))
+    decodes_before = receiver.counters.get("decodes")
+    for _ in range(10):
+        assert receiver.receive(Ctx(shards[0], wrong_pid)) is None
+    assert receiver.counters.get("decodes") == decodes_before
+
+
+def test_send_over_field_geometry_does_not_brick_plugin():
+    """A message whose adjusted geometry would exceed GF(2^8) is rejected
+    WITHOUT mutating plugin state; normal sends keep working after."""
+    plugin = ShardPlugin(backend="numpy")
+    keys, pid = make_sender()
+    with pytest.raises(ValueError):
+        plugin.prepare_shards(pid, keys, b"p" * 509)  # prime > 256
+    assert (plugin.minimum_needed_shards, plugin.total_shards) == (4, 6)
+    assert len(plugin.prepare_shards(pid, keys, b"p" * 16)) == 6

@@ -1,0 +1,151 @@
+"""Wire-format tests, modeled on the reference's generated proto test suite
+(SURVEY.md §4: round-trip + size + fuzz-robustness, shardpb_test.go:22-199)."""
+
+import numpy as np
+import pytest
+
+from noise_ec_tpu.host.wire import Shard, WireError
+
+
+def test_known_bytes():
+    """Golden encoding: proto3 tags 0x0a/0x12/0x18/0x20/0x28 in field order
+    (shard.pb.go:219-252)."""
+    s = Shard(
+        file_signature=b"\x01\x02",
+        shard_data=b"abc",
+        shard_number=3,
+        total_shards=6,
+        minimum_needed_shards=4,
+    )
+    expected = bytes(
+        [0x0A, 2, 1, 2]
+        + [0x12, 3, 0x61, 0x62, 0x63]
+        + [0x18, 3]
+        + [0x20, 6]
+        + [0x28, 4]
+    )
+    assert s.marshal() == expected
+    assert Shard.unmarshal(expected) == s
+
+
+def test_zero_elision():
+    """proto3 default elision: empty/zero fields are absent on the wire."""
+    assert Shard().marshal() == b""
+    assert Shard(shard_number=1).marshal() == b"\x18\x01"
+    assert Shard.unmarshal(b"") == Shard()
+
+
+def test_roundtrip_random():
+    """TestShardProto analogue: populate → marshal → unmarshal → equal."""
+    rng = np.random.default_rng(42)
+    for _ in range(50):
+        s = Shard.populate(rng)
+        assert Shard.unmarshal(s.marshal()) == s
+
+
+def test_size_matches_marshal():
+    """TestShardSize analogue: Size() == len(Marshal())."""
+    rng = np.random.default_rng(7)
+    for _ in range(50):
+        s = Shard.populate(rng)
+        assert s.size() == len(s.marshal())
+
+
+def test_large_varints_roundtrip():
+    s = Shard(shard_number=(1 << 64) - 1, total_shards=1 << 35)
+    assert Shard.unmarshal(s.marshal()) == s
+
+
+def test_unknown_fields_skipped():
+    """skipShard analogue (shard.pb.go:582-680): unknown varint,
+    length-delimited, fixed32/64, and group fields are skipped."""
+    base = Shard(shard_number=9).marshal()
+    unknown = (
+        bytes([0x30, 0x7F])  # field 6, varint
+        + bytes([0x3A, 2, 0xAA, 0xBB])  # field 7, bytes
+        + bytes([0x45, 1, 2, 3, 4])  # field 8, fixed32
+        + bytes([0x49, 1, 2, 3, 4, 5, 6, 7, 8])  # field 9, fixed64
+        + bytes([0x53, 0x58, 0x05, 0x54])  # field 10 group{field 11 varint} end
+    )
+    assert Shard.unmarshal(base + unknown) == Shard(shard_number=9)
+    assert Shard.unmarshal(unknown + base) == Shard(shard_number=9)
+
+
+def test_wrong_wire_type_rejected():
+    with pytest.raises(WireError):
+        Shard.unmarshal(bytes([0x08, 1]))  # field 1 as varint
+    with pytest.raises(WireError):
+        Shard.unmarshal(bytes([0x1A, 1, 0x61]))  # field 3 as bytes
+
+
+def test_truncation_rejected():
+    full = Shard(file_signature=b"\x01" * 20, shard_number=300).marshal()
+    for cut in range(1, len(full)):
+        try:
+            Shard.unmarshal(full[:cut])
+        except WireError:
+            pass  # either parses a prefix of fields or errors; never crashes
+
+
+def test_fuzz_never_crashes():
+    """TestShardProto's 100-iteration corrupted-bytes loop
+    (shardpb_test.go:45-53): Unmarshal of fuzzed bytes must not crash."""
+    rng = np.random.default_rng(1234)
+    base = bytearray(Shard.populate(rng).marshal() or b"\x18\x01")
+    for _ in range(200):
+        buf = bytearray(base)
+        for _ in range(int(rng.integers(1, 8))):
+            buf[int(rng.integers(0, len(buf)))] = int(rng.integers(0, 256))
+        try:
+            Shard.unmarshal(bytes(buf))
+        except WireError:
+            pass
+
+
+def test_varint_overflow_rejected():
+    with pytest.raises(WireError):
+        Shard.unmarshal(b"\x18" + b"\xff" * 11)
+
+
+def test_interop_with_protobuf_runtime():
+    """Cross-check against an independent proto3 implementation when
+    google.protobuf is importable: our bytes must parse there and re-serialize
+    to a message it round-trips (field numbers/types are the contract,
+    SURVEY.md §2.3 D4)."""
+    pytest.importorskip("google.protobuf")
+    from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+    pool = descriptor_pool.DescriptorPool()
+    fd = descriptor_pb2.FileDescriptorProto()
+    fd.name = "shard_interop.proto"
+    fd.package = "erasurecode"
+    fd.syntax = "proto3"
+    m = fd.message_type.add()
+    m.name = "Shard"
+    for i, (name, ftype) in enumerate(
+        [
+            ("file_signature", descriptor_pb2.FieldDescriptorProto.TYPE_BYTES),
+            ("shard_data", descriptor_pb2.FieldDescriptorProto.TYPE_BYTES),
+            ("shard_number", descriptor_pb2.FieldDescriptorProto.TYPE_UINT64),
+            ("total_shards", descriptor_pb2.FieldDescriptorProto.TYPE_UINT64),
+            ("minimum_needed_shards", descriptor_pb2.FieldDescriptorProto.TYPE_UINT64),
+        ]
+    ):
+        f = m.field.add()
+        f.name = name
+        f.number = i + 1
+        f.type = ftype
+        f.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+    pool.Add(fd)
+    cls = message_factory.GetMessageClass(pool.FindMessageTypeByName("erasurecode.Shard"))
+
+    rng = np.random.default_rng(99)
+    for _ in range(25):
+        ours = Shard.populate(rng)
+        theirs = cls.FromString(ours.marshal())
+        assert theirs.file_signature == ours.file_signature
+        assert theirs.shard_data == ours.shard_data
+        assert theirs.shard_number == ours.shard_number
+        assert theirs.total_shards == ours.total_shards
+        assert theirs.minimum_needed_shards == ours.minimum_needed_shards
+        assert Shard.unmarshal(theirs.SerializeToString()) == ours
