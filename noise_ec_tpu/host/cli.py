@@ -1,0 +1,86 @@
+"""CLI / REPL driver — the reference's L5 (main.go:116-200).
+
+Run one node per process:
+
+    python -m noise_ec_tpu.host.cli -port 3001
+    python -m noise_ec_tpu.host.cli -port 3002 -peers tcp://localhost:3001
+
+Each stdin line is erasure-sharded, signed, and broadcast to all peers;
+peers reassemble, verify, and log the completed message. Flags mirror the
+reference (`-port -host -protocol -peers`, main.go:121-124); the codec
+backend flag is new (device = TPU/JAX kernels, numpy = host-only).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from noise_ec_tpu.host.crypto import KeyPair, PeerID
+from noise_ec_tpu.host.plugin import ShardPlugin
+from noise_ec_tpu.host.transport import TCPNetwork
+from noise_ec_tpu.utils.logging import setup_logging
+
+log = logging.getLogger("noise_ec_tpu.host.cli")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="noise-ec-tpu-node",
+        description="erasure-coded broadcast node (TPU codec backend)",
+    )
+    # single-dash long flags, like Go's flag package (main.go:121-124)
+    p.add_argument("-port", type=int, default=3000, help="port to listen on")
+    p.add_argument("-host", default="localhost", help="host to listen on")
+    p.add_argument("-protocol", default="tcp", help="protocol to use (tcp)")
+    p.add_argument("-peers", default="", help="comma-separated peer addresses")
+    p.add_argument(
+        "-backend",
+        default="device",
+        choices=["device", "numpy"],
+        help="codec backend: device (TPU/JAX) or numpy (host)",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    setup_logging()  # stderr-forced, like flag.Set("logtostderr") main.go:118
+    args = build_parser().parse_args(argv)
+
+    keys = KeyPair.random()  # fresh identity per run, main.go:132
+    log.info("private key: %s", keys.private_key_hex())
+    log.info("public key: %s", keys.public_key_hex())
+
+    net = TCPNetwork(
+        host=args.host, port=args.port, keys=keys, protocol=args.protocol
+    )
+
+    def on_message(message: bytes, sender: PeerID) -> None:
+        log.info("message from %s: %s", sender.address, message.hex())
+
+    plugin = ShardPlugin(backend=args.backend, on_message=on_message)
+    net.add_plugin(plugin)
+
+    net.listen()  # background accept loop (go net.Listen(), main.go:169)
+    log.info("listening for peers on %s", net.id.address)
+    peers = [a for a in args.peers.split(",") if a]
+    if peers:
+        net.bootstrap(peers)
+
+    try:
+        for line in sys.stdin:  # blocking REPL, main.go:175-198
+            input_bytes = line.rstrip("\n").encode()
+            if not input_bytes:
+                continue  # skip blank lines, main.go:179-181
+            log.info("broadcasting message: %s", input_bytes.hex())
+            plugin.shard_and_broadcast(net, input_bytes)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        net.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

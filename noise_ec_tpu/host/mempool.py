@@ -19,7 +19,20 @@ from typing import Optional
 
 from noise_ec_tpu.codec.fec import Share
 
-__all__ = ["ShardPool", "PoolEntry", "PoolTooLargeError", "GeometryMismatchError"]
+__all__ = [
+    "ShardPool",
+    "PoolEntry",
+    "PoolTooLargeError",
+    "GeometryMismatchError",
+    "PoolLimitError",
+]
+
+
+class PoolLimitError(ValueError):
+    """The pool's global resource budget (pool count or pinned bytes) is
+    exhausted; the arriving share is rejected. Forged first-arrival shards
+    could otherwise pin unbounded memory for a full TTL — the reference has
+    no cap at all (``sync.Map``, main.go:49)."""
 
 
 class PoolTooLargeError(RuntimeError):
@@ -75,11 +88,21 @@ class ShardPool:
     """
 
     DEFAULT_TTL_SECONDS = 600.0
+    DEFAULT_MAX_POOLS = 65536
+    DEFAULT_MAX_TOTAL_BYTES = 1 << 30  # 1 GiB of pinned share data
 
-    def __init__(self, ttl_seconds: Optional[float] = DEFAULT_TTL_SECONDS):
+    def __init__(
+        self,
+        ttl_seconds: Optional[float] = DEFAULT_TTL_SECONDS,
+        max_pools: int = DEFAULT_MAX_POOLS,
+        max_total_bytes: int = DEFAULT_MAX_TOTAL_BYTES,
+    ):
         self._lock = threading.Lock()
         self._pools: dict[str, PoolEntry] = {}
         self._ttl = ttl_seconds
+        self._max_pools = max_pools
+        self._max_total_bytes = max_total_bytes
+        self._total_bytes = 0
 
     def add(
         self, key: str, share: Share, k: int, n: int
@@ -100,6 +123,10 @@ class ShardPool:
             self._expire_locked()
             entry = self._pools.get(key)
             if entry is None:
+                if len(self._pools) >= self._max_pools:
+                    raise PoolLimitError(
+                        f"pool count limit {self._max_pools} reached"
+                    )
                 entry = self._pools[key] = PoolEntry(
                     k=k, n=n, share_len=len(share.data)
                 )
@@ -115,9 +142,16 @@ class ShardPool:
                         f"share #{share.number} length {len(share.data)} "
                         f"!= pooled share length {entry.share_len}"
                     )
+                if self._total_bytes + len(share.data) > self._max_total_bytes:
+                    if not entry.shares:  # don't keep an empty pool around
+                        del self._pools[key]
+                    raise PoolLimitError(
+                        f"pinned-bytes limit {self._max_total_bytes} reached"
+                    )
                 entry.shares[share.number] = share
+                self._total_bytes += len(share.data)
             if entry.distinct() > entry.n:
-                del self._pools[key]
+                self._drop_locked(key)
                 raise PoolTooLargeError(
                     f"mempool for {key[:16]}… holds {entry.distinct()} distinct "
                     f"shares, more than total_shards={entry.n}"
@@ -125,9 +159,20 @@ class ShardPool:
             snapshot = [entry.shares[i] for i in sorted(entry.shares)]
             return snapshot, len(snapshot), was_new
 
+    def _drop_locked(self, key: str) -> None:
+        entry = self._pools.pop(key, None)
+        if entry is not None:
+            # every pooled share was length-checked against share_len
+            self._total_bytes -= entry.share_len * len(entry.shares)
+
     def evict(self, key: str) -> None:
         with self._lock:
-            self._pools.pop(key, None)
+            self._drop_locked(key)
+
+    @property
+    def pinned_bytes(self) -> int:
+        with self._lock:
+            return self._total_bytes
 
     def get(self, key: str) -> Optional[PoolEntry]:
         with self._lock:
@@ -146,4 +191,4 @@ class ShardPool:
         cutoff = time.monotonic() - self._ttl
         stale = [k for k, e in self._pools.items() if e.created_at < cutoff]
         for k in stale:
-            del self._pools[k]
+            self._drop_locked(k)

@@ -18,6 +18,9 @@ rebuilt on the TPU codec. The observable contract is preserved —
 from __future__ import annotations
 
 import logging
+import threading
+import time
+from collections import OrderedDict
 from typing import Callable, Optional, Protocol
 
 from noise_ec_tpu.codec.fec import FEC, Share
@@ -29,7 +32,7 @@ from noise_ec_tpu.host.crypto import (
     serialize_message,
     verify,
 )
-from noise_ec_tpu.host.mempool import PoolTooLargeError, ShardPool
+from noise_ec_tpu.host.mempool import PoolLimitError, PoolTooLargeError, ShardPool
 from noise_ec_tpu.host.wire import Shard
 from noise_ec_tpu.utils.metrics import Counters
 
@@ -105,7 +108,9 @@ class ShardPlugin:
         *,
         backend: str = "device",
         on_message: Optional[Callable[[bytes, PeerID], None]] = None,
-        pool_ttl_seconds: Optional[float] = None,
+        pool_ttl_seconds: Optional[float] = ShardPool.DEFAULT_TTL_SECONDS,
+        pool_max_pools: int = ShardPool.DEFAULT_MAX_POOLS,
+        pool_max_total_bytes: int = ShardPool.DEFAULT_MAX_TOTAL_BYTES,
         adjust_geometry: bool = True,
     ):
         self.signature_policy = signature_policy or Ed25519Policy()
@@ -115,23 +120,77 @@ class ShardPlugin:
         self.backend = backend
         self.on_message = on_message
         self.adjust_geometry = adjust_geometry
-        self.pool = ShardPool(ttl_seconds=pool_ttl_seconds)
+        self.pool = ShardPool(
+            ttl_seconds=pool_ttl_seconds,
+            max_pools=pool_max_pools,
+            max_total_bytes=pool_max_total_bytes,
+        )
         self.counters = Counters()
         # Geometry is runtime-dynamic (SURVEY.md §7.4); cache one codec per
-        # (k, n) so repeated geometries reuse their jitted kernels.
-        self._fec_cache: dict[tuple[int, int], FEC] = {}
+        # (k, n) so repeated geometries reuse their jitted kernels. LRU-
+        # bounded: geometry is attacker-influenced on the receive path, and
+        # each FEC holds generator matrices + jitted kernels.
+        self._fec_cache: OrderedDict[tuple[int, int], FEC] = OrderedDict()
+        self._fec_lock = threading.Lock()
+        self.fec_cache_size = 64
         # GF(2^8) bound: n distinct evaluation points cap total shards at
         # the field order (rs.py enforces the same on construction).
         self.max_total_shards = 256
+        # Duplicate-delivery suppression: signatures of recently completed
+        # objects with their completion time. Shards still in flight after
+        # a decode+evict can re-accumulate to k distinct and deliver the
+        # message again (the reference re-logs in that case). Suppression
+        # is WINDOWED, not permanent: the signature is deterministic
+        # (Ed25519 over a nonce-free preimage), so an identical message
+        # legitimately re-broadcast later produces the same signature — a
+        # permanent cache would swallow it. Within the window: exactly
+        # once; beyond it: at-least-once, like the reference.
+        self._completed: OrderedDict[str, float] = OrderedDict()
+        self._completed_lock = threading.Lock()
+        self.completed_cache_size = 4096
+        self.dedup_window_seconds = 30.0
 
     # ---------------------------------------------------------------- codec
 
     def _fec(self, k: int, n: int) -> FEC:
-        fec = self._fec_cache.get((k, n))
-        if fec is None:
-            fec = FEC(k, n, backend=self.backend)
-            self._fec_cache[(k, n)] = fec
-        return fec
+        # Locked: receive() runs on the transport thread while
+        # prepare_shards() runs on the caller's, and LRU mutation
+        # (move_to_end / popitem) is not safe to interleave.
+        with self._fec_lock:
+            fec = self._fec_cache.get((k, n))
+            if fec is not None:
+                self._fec_cache.move_to_end((k, n))
+                return fec
+        fec = FEC(k, n, backend=self.backend)  # build outside the lock
+        with self._fec_lock:
+            self._fec_cache.setdefault((k, n), fec)
+            self._fec_cache.move_to_end((k, n))
+            while len(self._fec_cache) > self.fec_cache_size:
+                self._fec_cache.popitem(last=False)
+            return self._fec_cache[(k, n)]
+
+    def _recently_completed(self, key: str) -> bool:
+        """True iff ``key`` completed within the dedup window. Lazily drops
+        expired entries."""
+        with self._completed_lock:
+            done_at = self._completed.get(key)
+            if done_at is None:
+                return False
+            if time.monotonic() - done_at >= self.dedup_window_seconds:
+                del self._completed[key]
+                return False
+            return True
+
+    def _mark_completed(self, key: str) -> bool:
+        """Record completion; returns False if another thread won the race
+        (caller must not deliver again)."""
+        with self._completed_lock:
+            if key in self._completed:
+                return False
+            self._completed[key] = time.monotonic()
+            while len(self._completed) > self.completed_cache_size:
+                self._completed.popitem(last=False)
+            return True
 
     # ----------------------------------------------------------- send path
 
@@ -234,6 +293,9 @@ class ShardPlugin:
         self.counters.add("shards_in", 1)
         self.counters.add("bytes_in", len(msg.shard_data))
         key = msg.file_signature.hex()  # mempool key, main.go:55
+        if self._recently_completed(key):
+            self.counters.add("late_shards", 1)
+            return None
         share = Share(msg.shard_number, bytes(msg.shard_data))
         k = int(msg.minimum_needed_shards)
         n = int(msg.total_shards)
@@ -253,6 +315,11 @@ class ShardPlugin:
             snapshot, distinct, was_new = self.pool.add(key, share, k, n)
         except PoolTooLargeError:
             self.counters.add("pool_overflows", 1)
+            raise
+        except PoolLimitError:
+            # Resource budget exhausted — a distinct signal from malformed
+            # shards: this is the memory-exhaustion alarm.
+            self.counters.add("pool_limit_rejections", 1)
             raise
         except ValueError:
             # Geometry or length disagrees with the pinned pool: drop this
@@ -297,6 +364,11 @@ class ShardPlugin:
         )
         if ok:
             self.pool.evict(key)  # main.go:90-93
+            if not self._mark_completed(key):
+                # A concurrent receive() already delivered this object
+                # between our pool snapshot and now; exactly-once holds.
+                self.counters.add("late_shards", 1)
+                return None
             self.counters.add("verified", 1)
             log.info("completed message %s… (%d bytes)", complete[:32].hex(), len(complete))
             if self.on_message is not None:

@@ -1,0 +1,452 @@
+"""Transports: the L0 layer (SURVEY.md §1) — peer registry, broadcast
+fan-out, and plugin dispatch.
+
+The reference delegates this layer to perlin-network/noise (SURVEY.md §2.3
+D2): a builder-configured network with ordered plugin registration, a
+blocking accept loop, ``Bootstrap(peers...)`` dial-out, per-message
+signatures, and ``Broadcast`` fan-out to every connected peer. Two
+implementations here share that contract:
+
+- :class:`LoopbackHub` / :class:`LoopbackNetwork` — the in-process fake the
+  reference lacks (SURVEY.md §4 "multi-node story"): N peers in one
+  process, deterministic fault injection (drop / duplicate / corrupt /
+  reorder) on every link, driving the full Receive state machine.
+- :class:`TCPNetwork` — a real asyncio TCP transport with length-prefixed,
+  identity-carrying, Ed25519-signed frames, serving the reference's
+  multi-process deployment shape (main.go:137-173).
+
+Both deliver messages to plugins through :class:`Ctx`, the slice of
+noise's ``PluginContext`` the reference uses (main.go:53-87).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from noise_ec_tpu.host.crypto import (
+    Blake2bPolicy,
+    Ed25519Policy,
+    KeyPair,
+    PeerID,
+)
+from noise_ec_tpu.host.wire import Shard, WireError
+
+__all__ = [
+    "Ctx",
+    "FaultInjector",
+    "LoopbackHub",
+    "LoopbackNetwork",
+    "TCPNetwork",
+    "format_address",
+]
+
+log = logging.getLogger("noise_ec_tpu.host.transport")
+
+
+def format_address(protocol: str, host: str, port: int) -> str:
+    """network.FormatAddress(protocol, host, port) — main.go:148."""
+    return f"{protocol}://{host}:{port}"
+
+
+class Ctx:
+    """Plugin context handed to ``plugin.receive`` on every delivery."""
+
+    def __init__(self, msg: object, sender: PeerID):
+        self._msg = msg
+        self._sender = sender
+
+    def message(self) -> object:
+        return self._msg
+
+    def sender(self) -> PeerID:
+        return self._sender
+
+    def client_public_key(self) -> bytes:
+        return self._sender.public_key
+
+
+# --------------------------------------------------------------- loopback
+
+
+class FaultInjector:
+    """Deterministic link-fault model for the loopback transport.
+
+    The reference has no fault-injection story at all (SURVEY.md §5 failure
+    row); this is the first-class harness it calls for. Faults apply
+    per-delivery, driven by a seeded generator so every run reproduces:
+
+    - ``drop``: probability a delivery is discarded;
+    - ``duplicate``: probability a delivery is made twice;
+    - ``corrupt``: probability one byte of the wire bytes is flipped;
+    - ``reorder``: probability a delivery is held in a one-slot delay line
+      and released right after the next delivery on the same link (a
+      pairwise swap; the slot is per-link, so a held message can neither
+      migrate to another receiver nor be attributed to a later sender). At
+      most one delivery per link is pending at stream end — within any
+      k-of-n parity budget.
+    """
+
+    def __init__(
+        self,
+        seed: int = 0,
+        drop: float = 0.0,
+        duplicate: float = 0.0,
+        corrupt: float = 0.0,
+        reorder: float = 0.0,
+    ):
+        self.rng = np.random.default_rng(seed)
+        self.drop = drop
+        self.duplicate = duplicate
+        self.corrupt = corrupt
+        self.reorder = reorder
+        self._slots: dict[str, bytes] = {}  # per-link delay line for reorder
+        self.stats = {"delivered": 0, "dropped": 0, "duplicated": 0,
+                      "corrupted": 0, "reordered": 0}
+
+    def apply(self, deliveries: list[bytes], link: str = "") -> list[bytes]:
+        """Map a list of wire-byte deliveries on ``link`` to the faulted
+        list. Stateful across calls: a reordered delivery from an earlier
+        call is released behind a later one on the same link."""
+        out: list[bytes] = []
+        for buf in deliveries:
+            if self.rng.random() < self.drop:
+                self.stats["dropped"] += 1
+                continue
+            copies = 1
+            if self.rng.random() < self.duplicate:
+                copies = 2
+                self.stats["duplicated"] += 1
+            for _ in range(copies):
+                b = buf
+                if self.rng.random() < self.corrupt:
+                    b = bytearray(b)
+                    if b:
+                        b[int(self.rng.integers(0, len(b)))] ^= 1 << int(
+                            self.rng.integers(0, 8)
+                        )
+                    b = bytes(b)
+                    self.stats["corrupted"] += 1
+                if link not in self._slots and self.rng.random() < self.reorder:
+                    self._slots[link] = b  # held; rides behind the next delivery
+                    self.stats["reordered"] += 1
+                    continue
+                out.append(b)
+                self.stats["delivered"] += 1
+                held = self._slots.pop(link, None)
+                if held is not None:
+                    out.append(held)
+                    self.stats["delivered"] += 1
+        return out
+
+
+class LoopbackHub:
+    """An in-process peer set: every registered network sees every other."""
+
+    def __init__(self, fault_injector: Optional[FaultInjector] = None):
+        self.nodes: dict[str, "LoopbackNetwork"] = {}
+        self.faults = fault_injector
+
+    def register(self, node: "LoopbackNetwork") -> None:
+        self.nodes[node.id.address] = node
+
+    def fan_out(self, sender: "LoopbackNetwork", wire_bytes: bytes) -> None:
+        """Deliver one message to every peer except the sender
+        (net.Broadcast semantics, main.go:206-208)."""
+        for addr, node in self.nodes.items():
+            if addr == sender.id.address:
+                continue
+            bufs = [wire_bytes]
+            if self.faults is not None:
+                bufs = self.faults.apply(bufs, link=f"{sender.id.address}->{addr}")
+            for buf in bufs:
+                node.deliver(buf, sender.id)
+
+
+class LoopbackNetwork:
+    """One fake peer. API mirrors what the plugin needs from noise's
+    ``*network.Network``: ``.id``, ``.keys``, ``.broadcast``, plugin
+    registration and dispatch."""
+
+    def __init__(self, hub: LoopbackHub, address: str, keys: Optional[KeyPair] = None):
+        self.keys = keys or KeyPair.random()
+        self.id = PeerID.create(address, self.keys.public_key)
+        self.hub = hub
+        self.plugins: list = []
+        # bounded: hostile traffic appends one entry per bad frame
+        self.errors: deque[Exception] = deque(maxlen=256)
+        self.error_count = 0
+        hub.register(self)
+
+    def add_plugin(self, plugin) -> None:
+        self.plugins.append(plugin)
+
+    def _record_error(self, exc: Exception) -> None:
+        self.errors.append(exc)
+        self.error_count += 1
+
+    def broadcast(self, msg: Shard) -> None:
+        self.hub.fan_out(self, msg.marshal())
+
+    def deliver(self, wire_bytes: bytes, sender: PeerID) -> None:
+        """Hub-side delivery: decode and dispatch to every plugin in
+        registration order. Decode/dispatch errors are recorded, not
+        raised — one bad message must not kill the receive loop."""
+        try:
+            msg = Shard.unmarshal(wire_bytes)
+        except WireError as exc:
+            self._record_error(exc)
+            return
+        ctx = Ctx(msg, sender)
+        for plugin in self.plugins:
+            try:
+                plugin.receive(ctx)
+            except Exception as exc:  # noqa: BLE001 — isolate the loop
+                self._record_error(exc)
+
+
+# -------------------------------------------------------------------- TCP
+
+# Frame layout (all little-endian):
+#   u32 frame_len | u8 opcode | u32 addr_len | addr utf-8 | 32B pubkey |
+#   u32 payload_len | payload | 64B ed25519 signature over
+#   blake2b256(opcode ‖ payload)
+# HELLO carries an empty payload and introduces the peer (the discovery
+# handshake); SHARD carries a marshaled Shard. Every frame is signed, the
+# transport-level integrity the reference gets from noise's signed messages
+# (SURVEY.md §2.3 D2).
+_OP_HELLO = 1
+_OP_SHARD = 2
+_MAX_FRAME = 64 << 20
+
+
+@dataclass
+class _Peer:
+    pid: PeerID
+    writer: asyncio.StreamWriter
+
+
+class TCPNetwork:
+    """Asyncio TCP transport with the noise-style lifecycle:
+    ``listen()`` (background accept loop), ``bootstrap(peers)`` (dial out),
+    ``broadcast(msg)`` (signed fan-out to all connected peers).
+
+    Runs its event loop on a daemon thread so callers keep the reference's
+    synchronous REPL shape (``go net.Listen()``, main.go:169).
+    """
+
+    def __init__(
+        self,
+        host: str = "localhost",
+        port: int = 3000,
+        keys: Optional[KeyPair] = None,
+        protocol: str = "tcp",
+    ):
+        if protocol != "tcp":
+            raise ValueError(
+                f"protocol {protocol!r} not supported (the reference also "
+                "offers kcp; only tcp is implemented here)"
+            )
+        self.keys = keys or KeyPair.random()
+        self.host = host
+        self.port = port
+        self.id = PeerID.create(format_address(protocol, host, port), self.keys.public_key)
+        self.plugins: list = []
+        self.peers: dict[str, _Peer] = {}  # address -> peer
+        # bounded: hostile traffic appends one entry per bad frame
+        self.errors: deque[Exception] = deque(maxlen=256)
+        self.error_count = 0
+        self._sig = Ed25519Policy()
+        self._hash = Blake2bPolicy()
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._loop.run_forever, daemon=True)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._lock = threading.Lock()
+        self._tasks: set[asyncio.Task] = set()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def listen(self) -> None:
+        """Start the accept loop in the background (go net.Listen())."""
+        self._thread.start()
+        fut = asyncio.run_coroutine_threadsafe(self._start_server(), self._loop)
+        self._server = fut.result(timeout=10)
+        addr = self._server.sockets[0].getsockname()
+        self.port = addr[1]
+        self.id = PeerID.create(
+            format_address("tcp", self.host, self.port), self.keys.public_key
+        )
+
+    async def _start_server(self) -> asyncio.AbstractServer:
+        return await asyncio.start_server(self._handle_conn, self.host, self.port)
+
+    def bootstrap(self, peer_addresses: list[str]) -> None:
+        """Dial out to peers (net.Bootstrap, main.go:171-173)."""
+        for addr in peer_addresses:
+            if not addr:
+                continue
+            fut = asyncio.run_coroutine_threadsafe(self._dial(addr), self._loop)
+            try:
+                fut.result(timeout=10)
+            except Exception as exc:  # noqa: BLE001
+                self._record_error(exc)
+                log.error("bootstrap %s failed: %s", addr, exc)
+
+    def close(self) -> None:
+        async def _shutdown():
+            if self._server is not None:
+                self._server.close()
+            for peer in list(self.peers.values()):
+                peer.writer.close()
+
+        if self._thread.is_alive():
+            asyncio.run_coroutine_threadsafe(_shutdown(), self._loop).result(timeout=5)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------- plugins
+
+    def add_plugin(self, plugin) -> None:
+        self.plugins.append(plugin)
+
+    def _record_error(self, exc: Exception) -> None:
+        self.errors.append(exc)
+        self.error_count += 1
+
+    # --------------------------------------------------------------- wire
+
+    def _frame(self, opcode: int, payload: bytes) -> bytes:
+        addr = self.id.address.encode()
+        sig = self.keys.sign(self._sig, self._hash, bytes([opcode]) + payload)
+        body = b"".join(
+            [
+                bytes([opcode]),
+                struct.pack("<I", len(addr)),
+                addr,
+                self.keys.public_key,
+                struct.pack("<I", len(payload)),
+                payload,
+                sig,
+            ]
+        )
+        return struct.pack("<I", len(body)) + body
+
+    @staticmethod
+    def _parse_frame(body: bytes) -> tuple[int, PeerID, bytes, bytes]:
+        """Returns (opcode, sender_pid, payload, signature)."""
+        pos = 0
+        opcode = body[pos]; pos += 1
+        (alen,) = struct.unpack_from("<I", body, pos); pos += 4
+        addr = body[pos : pos + alen].decode(); pos += alen
+        pubkey = body[pos : pos + 32]; pos += 32
+        (plen,) = struct.unpack_from("<I", body, pos); pos += 4
+        payload = body[pos : pos + plen]; pos += plen
+        sig = body[pos : pos + 64]
+        if len(pubkey) != 32 or len(payload) != plen or len(sig) != 64:
+            raise WireError("truncated frame")
+        return opcode, PeerID.create(addr, pubkey), payload, sig
+
+    # ------------------------------------------------------------ dataflow
+
+    def broadcast(self, msg: Shard) -> None:
+        """Signed fan-out to every connected peer (main.go:206-208)."""
+        frame = self._frame(_OP_SHARD, msg.marshal())
+        with self._lock:
+            writers = [p.writer for p in self.peers.values()]
+        for w in writers:
+            self._loop.call_soon_threadsafe(self._write_safe, w, frame)
+
+    def _write_safe(self, writer: asyncio.StreamWriter, frame: bytes) -> None:
+        try:
+            writer.write(frame)
+        except Exception as exc:  # noqa: BLE001
+            self._record_error(exc)
+
+    async def _dial(self, address: str) -> None:
+        host, port = self._split(address)
+        reader, writer = await asyncio.open_connection(host, port)
+        writer.write(self._frame(_OP_HELLO, b""))
+        # Consume the HELLO reply before returning so bootstrap() blocks
+        # until the peer is registered — otherwise a broadcast immediately
+        # after bootstrap races the handshake and fans out to nobody.
+        hdr = await asyncio.wait_for(reader.readexactly(4), timeout=10)
+        (ln,) = struct.unpack("<I", hdr)
+        if ln > _MAX_FRAME:
+            raise WireError(f"frame length {ln} exceeds cap")
+        body = await asyncio.wait_for(reader.readexactly(ln), timeout=10)
+        self._on_frame(body, writer)
+        task = asyncio.create_task(self._read_loop(reader, writer))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    @staticmethod
+    def _split(address: str) -> tuple[str, int]:
+        hostport = address.split("://", 1)[-1]
+        host, _, port = hostport.rpartition(":")
+        return host, int(port)
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        # Answer the peer's HELLO with ours so both sides learn identities
+        # (the discovery-plugin handshake, main.go:151).
+        writer.write(self._frame(_OP_HELLO, b""))
+        await self._read_loop(reader, writer)
+
+    async def _read_loop(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                (ln,) = struct.unpack("<I", hdr)
+                if ln > _MAX_FRAME:
+                    raise WireError(f"frame length {ln} exceeds cap")
+                body = await reader.readexactly(ln)
+                self._on_frame(body, writer)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        except Exception as exc:  # noqa: BLE001
+            self._record_error(exc)
+        finally:
+            with self._lock:
+                for addr, p in list(self.peers.items()):
+                    if p.writer is writer:
+                        del self.peers[addr]
+
+    def _on_frame(self, body: bytes, writer: asyncio.StreamWriter) -> None:
+        try:
+            opcode, pid, payload, sig = self._parse_frame(body)
+        except (WireError, IndexError, struct.error, UnicodeDecodeError) as exc:
+            self._record_error(WireError(f"bad frame: {exc}"))
+            return
+        if not self._sig.verify(
+            pid.public_key,
+            self._hash.hash_bytes(bytes([opcode]) + payload),
+            sig,
+        ):
+            self._record_error(WireError(f"bad frame signature from {pid.address}"))
+            return
+        if opcode == _OP_HELLO:
+            with self._lock:
+                self.peers[pid.address] = _Peer(pid, writer)
+            return
+        if opcode == _OP_SHARD:
+            try:
+                msg = Shard.unmarshal(payload)
+            except WireError as exc:
+                self._record_error(exc)
+                return
+            ctx = Ctx(msg, pid)
+            for plugin in self.plugins:
+                try:
+                    plugin.receive(ctx)
+                except Exception as exc:  # noqa: BLE001
+                    self._record_error(exc)

@@ -396,6 +396,75 @@ def test_receive_duplicate_after_k_skips_redecode():
     assert receiver.counters.get("decodes") == decodes_before
 
 
+def test_late_shards_suppressed_within_dedup_window():
+    """After an object completes, its remaining in-flight shards are
+    dropped (exactly-once within the window) instead of re-accumulating to
+    k distinct and re-delivering (the reference re-logs in that case)."""
+    sender = ShardPlugin(backend="numpy")
+    delivered = []
+    receiver = ShardPlugin(backend="numpy",
+                           on_message=lambda m, s: delivered.append(m))
+    # 14 bytes -> geometry adjusts to k=7, n=13: 13 shards, plenty left
+    # over after the first decode at 7 distinct.
+    payload = b"redelivery!!!!"
+    pid, shards = encode_side(sender, payload)
+    assert len(shards) == 13
+    for s in shards:
+        receiver.receive(Ctx(s, pid))
+    assert delivered == [payload]  # once, not twice
+    assert receiver.counters.get("late_shards") == 6
+
+
+def test_identical_rebroadcast_after_window_delivers_again():
+    """The signature is deterministic over a nonce-free preimage, so an
+    identical message re-broadcast later has the same shard stream; once
+    the dedup window passes it must deliver again."""
+    sender = ShardPlugin(backend="numpy")
+    delivered = []
+    receiver = ShardPlugin(backend="numpy",
+                           on_message=lambda m, s: delivered.append(m))
+    receiver.dedup_window_seconds = 0.0  # expire immediately
+    payload = b"same msg again!!"
+    pid, shards = encode_side(sender, payload)
+    for _ in range(2):
+        for s in shards[:4]:
+            receiver.receive(Ctx(s, pid))
+    assert delivered == [payload, payload]
+
+
+def test_completed_cache_lru_bound():
+    receiver = ShardPlugin(backend="numpy")
+    receiver.completed_cache_size = 3
+    for i in range(6):
+        assert receiver._mark_completed(f"sig{i}")
+    assert len(receiver._completed) == 3
+    assert receiver._mark_completed("sig0")  # evicted, so it re-registers
+
+
+def test_fec_cache_lru_bound():
+    receiver = ShardPlugin(backend="numpy")
+    receiver.fec_cache_size = 4
+    for n in range(8, 20):
+        receiver._fec(4, n)
+    assert len(receiver._fec_cache) == 4
+
+
+def test_mempool_resource_limits():
+    from noise_ec_tpu.host.mempool import PoolLimitError
+
+    pool = ShardPool(max_pools=2, max_total_bytes=100)
+    pool.add("a", Share(0, b"x" * 40), 4, 6)
+    pool.add("b", Share(0, b"x" * 40), 4, 6)
+    with pytest.raises(PoolLimitError):
+        pool.add("c", Share(0, b"x" * 40), 4, 6)  # pool-count cap
+    with pytest.raises(PoolLimitError):
+        pool.add("a", Share(1, b"x" * 40), 4, 6)  # byte cap (80+40 > 100)
+    assert pool.pinned_bytes == 80
+    pool.evict("a")
+    assert pool.pinned_bytes == 40
+    pool.add("c", Share(0, b"x" * 40), 4, 6)  # capacity freed
+
+
 def test_send_over_field_geometry_does_not_brick_plugin():
     """A message whose adjusted geometry would exceed GF(2^8) is rejected
     WITHOUT mutating plugin state; normal sends keep working after."""
