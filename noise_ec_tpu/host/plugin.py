@@ -145,10 +145,15 @@ class ShardPlugin:
         # legitimately re-broadcast later produces the same signature — a
         # permanent cache would swallow it. Within the window: exactly
         # once; beyond it: at-least-once, like the reference.
+        # The window is a tradeoff, kept SHORT: a user legitimately
+        # re-broadcasting the identical plaintext within the window loses
+        # the repeat (indistinguishable on the wire from the first
+        # broadcast's stragglers). 5s covers in-flight shard tails without
+        # noticeably shadowing interactive repeats; 0 disables dedup.
         self._completed: OrderedDict[str, float] = OrderedDict()
         self._completed_lock = threading.Lock()
         self.completed_cache_size = 4096
-        self.dedup_window_seconds = 30.0
+        self.dedup_window_seconds = 5.0
 
     # ---------------------------------------------------------------- codec
 

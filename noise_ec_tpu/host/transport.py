@@ -23,9 +23,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import struct
 import threading
 from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Optional
 
@@ -222,15 +224,34 @@ class LoopbackNetwork:
 # handshake); SHARD carries a marshaled Shard. Every frame is signed, the
 # transport-level integrity the reference gets from noise's signed messages
 # (SURVEY.md §2.3 D2).
-_OP_HELLO = 1
-_OP_SHARD = 2
+_OP_HELLO = 1        # dialer -> acceptor: payload = dialer 32B nonce
+_OP_HELLO_REPLY = 3  # acceptor -> dialer: payload = dialer_nonce ‖ acceptor_nonce
+_OP_HELLO_ACK = 4    # dialer -> acceptor: payload = acceptor_nonce
+_OP_SHARD = 2        # payload = marshaled Shard
 _MAX_FRAME = 64 << 20
+_NONCE_LEN = 32
 
 
 @dataclass
 class _Peer:
     pid: PeerID
     writer: asyncio.StreamWriter
+
+
+class _Conn:
+    """Per-connection handshake state.
+
+    A peer is registered only after a fresh-nonce proof: every frame is
+    signed (over opcode ‖ address ‖ payload), and registration requires the
+    counterparty to echo OUR nonce for THIS connection inside one of those
+    signed frames — so a captured HELLO/REPLY/ACK replayed on a new
+    connection verifies as a signature but never matches the new nonce and
+    never binds the victim's identity to the attacker's socket."""
+
+    def __init__(self):
+        self.nonce = os.urandom(_NONCE_LEN)
+        self.peer: Optional[PeerID] = None
+        self.registered = asyncio.Event()
 
 
 class TCPNetwork:
@@ -240,7 +261,18 @@ class TCPNetwork:
 
     Runs its event loop on a daemon thread so callers keep the reference's
     synchronous REPL shape (``go net.Listen()``, main.go:169).
+
+    Security model (vs the reference's noise transport, SURVEY.md §2.3 D2):
+    every frame is Ed25519-signed over (opcode ‖ sender address ‖ payload),
+    and peers register through a three-way nonce handshake
+    (HELLO → HELLO_REPLY → HELLO_ACK) so neither the address nor a replayed
+    handshake can bind a foreign identity to an attacker's socket. Shards
+    are accepted only from registered connections whose key matches.
     """
+
+    # Disconnect a peer whose kernel+asyncio write buffer exceeds this —
+    # a stalled reader must not grow sender memory without bound.
+    MAX_PEER_WRITE_BUFFER = 32 << 20
 
     def __init__(
         self,
@@ -259,7 +291,11 @@ class TCPNetwork:
         self.port = port
         self.id = PeerID.create(format_address(protocol, host, port), self.keys.public_key)
         self.plugins: list = []
-        self.peers: dict[str, _Peer] = {}  # address -> peer
+        # Keyed by PUBLIC KEY, not the self-claimed address: an address is
+        # just a claim inside a signed frame, so keying by it would let any
+        # handshake-completing attacker evict a legitimate peer by claiming
+        # the same address. One entry per identity; addresses may collide.
+        self.peers: dict[bytes, _Peer] = {}  # public key -> peer
         # bounded: hostile traffic appends one entry per bad frame
         self.errors: deque[Exception] = deque(maxlen=256)
         self.error_count = 0
@@ -270,6 +306,13 @@ class TCPNetwork:
         self._server: Optional[asyncio.AbstractServer] = None
         self._lock = threading.Lock()
         self._tasks: set[asyncio.Task] = set()
+        # Plugin dispatch (FEC decode; first-geometry jit compile can take
+        # seconds on the device backend) must not run on the event-loop
+        # thread, or every connection's read loop and handshake stalls
+        # behind it. One worker preserves per-node delivery order.
+        self._dispatch = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="noise-ec-dispatch"
+        )
 
     # ------------------------------------------------------------ lifecycle
 
@@ -288,13 +331,15 @@ class TCPNetwork:
         return await asyncio.start_server(self._handle_conn, self.host, self.port)
 
     def bootstrap(self, peer_addresses: list[str]) -> None:
-        """Dial out to peers (net.Bootstrap, main.go:171-173)."""
+        """Dial out to peers (net.Bootstrap, main.go:171-173). Blocks until
+        each handshake completes (or fails), so a broadcast immediately
+        after bootstrap reaches every successfully dialed peer."""
         for addr in peer_addresses:
             if not addr:
                 continue
             fut = asyncio.run_coroutine_threadsafe(self._dial(addr), self._loop)
             try:
-                fut.result(timeout=10)
+                fut.result(timeout=15)
             except Exception as exc:  # noqa: BLE001
                 self._record_error(exc)
                 log.error("bootstrap %s failed: %s", addr, exc)
@@ -310,6 +355,7 @@ class TCPNetwork:
             asyncio.run_coroutine_threadsafe(_shutdown(), self._loop).result(timeout=5)
             self._loop.call_soon_threadsafe(self._loop.stop)
             self._thread.join(timeout=5)
+        self._dispatch.shutdown(wait=True)
 
     # ------------------------------------------------------------- plugins
 
@@ -324,7 +370,9 @@ class TCPNetwork:
 
     def _frame(self, opcode: int, payload: bytes) -> bytes:
         addr = self.id.address.encode()
-        sig = self.keys.sign(self._sig, self._hash, bytes([opcode]) + payload)
+        sig = self.keys.sign(
+            self._sig, self._hash, bytes([opcode]) + addr + payload
+        )
         body = b"".join(
             [
                 bytes([opcode]),
@@ -364,27 +412,44 @@ class TCPNetwork:
             self._loop.call_soon_threadsafe(self._write_safe, w, frame)
 
     def _write_safe(self, writer: asyncio.StreamWriter, frame: bytes) -> None:
+        if writer.transport.get_write_buffer_size() > self.MAX_PEER_WRITE_BUFFER:
+            # A stalled reader must not grow sender memory without bound.
+            self._drop_writer(writer)
+            self._record_error(
+                RuntimeError("peer write buffer exceeded cap; disconnected")
+            )
+            return
         try:
             writer.write(frame)
         except Exception as exc:  # noqa: BLE001
             self._record_error(exc)
 
+    def _drop_writer(self, writer: asyncio.StreamWriter) -> None:
+        with self._lock:
+            for key, p in list(self.peers.items()):
+                if p.writer is writer:
+                    del self.peers[key]
+        try:
+            writer.close()
+        except Exception:  # noqa: BLE001
+            pass
+
     async def _dial(self, address: str) -> None:
         host, port = self._split(address)
         reader, writer = await asyncio.open_connection(host, port)
-        writer.write(self._frame(_OP_HELLO, b""))
-        # Consume the HELLO reply before returning so bootstrap() blocks
-        # until the peer is registered — otherwise a broadcast immediately
-        # after bootstrap races the handshake and fans out to nobody.
-        hdr = await asyncio.wait_for(reader.readexactly(4), timeout=10)
-        (ln,) = struct.unpack("<I", hdr)
-        if ln > _MAX_FRAME:
-            raise WireError(f"frame length {ln} exceeds cap")
-        body = await asyncio.wait_for(reader.readexactly(ln), timeout=10)
-        self._on_frame(body, writer)
-        task = asyncio.create_task(self._read_loop(reader, writer))
-        self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
+        conn = _Conn()
+        try:
+            writer.write(self._frame(_OP_HELLO, conn.nonce))
+            task = asyncio.create_task(self._read_loop(reader, writer, conn))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+            # Block until the HELLO_REPLY echoes our nonce and the peer is
+            # registered; tear the connection down on timeout so a silent
+            # acceptor does not leak a socket per bootstrap attempt.
+            await asyncio.wait_for(conn.registered.wait(), timeout=10)
+        except Exception:
+            self._drop_writer(writer)
+            raise
 
     @staticmethod
     def _split(address: str) -> tuple[str, int]:
@@ -395,13 +460,14 @@ class TCPNetwork:
     async def _handle_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
-        # Answer the peer's HELLO with ours so both sides learn identities
-        # (the discovery-plugin handshake, main.go:151).
-        writer.write(self._frame(_OP_HELLO, b""))
-        await self._read_loop(reader, writer)
+        # The dialer initiates; we answer its HELLO from the read loop.
+        await self._read_loop(reader, writer, _Conn())
 
     async def _read_loop(
-        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        conn: _Conn,
     ) -> None:
         try:
             while True:
@@ -410,18 +476,23 @@ class TCPNetwork:
                 if ln > _MAX_FRAME:
                     raise WireError(f"frame length {ln} exceeds cap")
                 body = await reader.readexactly(ln)
-                self._on_frame(body, writer)
+                self._on_frame(body, writer, conn)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         except Exception as exc:  # noqa: BLE001
             self._record_error(exc)
         finally:
-            with self._lock:
-                for addr, p in list(self.peers.items()):
-                    if p.writer is writer:
-                        del self.peers[addr]
+            self._drop_writer(writer)
 
-    def _on_frame(self, body: bytes, writer: asyncio.StreamWriter) -> None:
+    def _register(self, pid: PeerID, writer: asyncio.StreamWriter, conn: _Conn) -> None:
+        conn.peer = pid
+        with self._lock:
+            self.peers[pid.public_key] = _Peer(pid, writer)
+        conn.registered.set()
+
+    def _on_frame(
+        self, body: bytes, writer: asyncio.StreamWriter, conn: _Conn
+    ) -> None:
         try:
             opcode, pid, payload, sig = self._parse_frame(body)
         except (WireError, IndexError, struct.error, UnicodeDecodeError) as exc:
@@ -429,24 +500,55 @@ class TCPNetwork:
             return
         if not self._sig.verify(
             pid.public_key,
-            self._hash.hash_bytes(bytes([opcode]) + payload),
+            self._hash.hash_bytes(
+                bytes([opcode]) + pid.address.encode() + payload
+            ),
             sig,
         ):
             self._record_error(WireError(f"bad frame signature from {pid.address}"))
             return
+
         if opcode == _OP_HELLO:
-            with self._lock:
-                self.peers[pid.address] = _Peer(pid, writer)
+            # Dialer's opening. Do NOT register yet — a replayed HELLO
+            # carries a stale nonce and its sender cannot complete the ACK.
+            if len(payload) != _NONCE_LEN:
+                self._record_error(WireError("bad HELLO nonce length"))
+                return
+            self._write_safe(writer, self._frame(_OP_HELLO_REPLY, payload + conn.nonce))
+            return
+        if opcode == _OP_HELLO_REPLY:
+            # Acceptor echoed our nonce inside a signed frame: fresh proof.
+            if len(payload) != 2 * _NONCE_LEN or payload[:_NONCE_LEN] != conn.nonce:
+                self._record_error(WireError(f"stale HELLO_REPLY from {pid.address}"))
+                return
+            self._register(pid, writer, conn)
+            self._write_safe(writer, self._frame(_OP_HELLO_ACK, payload[_NONCE_LEN:]))
+            return
+        if opcode == _OP_HELLO_ACK:
+            if payload != conn.nonce:
+                self._record_error(WireError(f"stale HELLO_ACK from {pid.address}"))
+                return
+            self._register(pid, writer, conn)
             return
         if opcode == _OP_SHARD:
+            # Only registered connections may deliver shards, and the frame
+            # identity must match the handshake identity.
+            if conn.peer is None or pid.public_key != conn.peer.public_key:
+                self._record_error(
+                    WireError(f"shard from unregistered connection ({pid.address})")
+                )
+                return
             try:
                 msg = Shard.unmarshal(payload)
             except WireError as exc:
                 self._record_error(exc)
                 return
             ctx = Ctx(msg, pid)
-            for plugin in self.plugins:
-                try:
-                    plugin.receive(ctx)
-                except Exception as exc:  # noqa: BLE001
-                    self._record_error(exc)
+            self._dispatch.submit(self._dispatch_plugins, ctx)
+
+    def _dispatch_plugins(self, ctx: Ctx) -> None:
+        for plugin in self.plugins:
+            try:
+                plugin.receive(ctx)
+            except Exception as exc:  # noqa: BLE001
+                self._record_error(exc)

@@ -1,0 +1,11 @@
+"""Native C-ABI codec shim (SURVEY.md §7.1 ``shim/``).
+
+``rs_shim.cpp`` implements the GF(2^8) RS codec behind a C ABI shaped
+after klauspost/reedsolomon's Encoder, so a Go host can cgo-link the same
+library the Python binding loads. :mod:`noise_ec_tpu.shim.binding` is the
+ctypes loader.
+"""
+
+from noise_ec_tpu.shim.binding import CppReedSolomon, build_shim, shim_available
+
+__all__ = ["CppReedSolomon", "build_shim", "shim_available"]

@@ -1,0 +1,210 @@
+"""ctypes binding for the native RS codec shim.
+
+Loads ``librs_shim.so`` (building it with ``make`` on first use) and wraps
+the C ABI in the same shard-list surface as
+:class:`noise_ec_tpu.codec.rs.ReedSolomon`, so the native backend is a
+drop-in for the Python/NumPy path. The same .so is what a Go host would
+cgo-link under the ``reedsolomon.Encoder`` interface — the C ABI, not this
+module, is the compatibility boundary.
+
+Run ``python -m noise_ec_tpu.shim.binding --selftest`` to build and
+cross-check against the golden codec.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import sys
+from pathlib import Path
+from typing import Optional, Sequence
+
+import numpy as np
+
+_SHIM_DIR = Path(__file__).resolve().parent
+_SO_PATH = _SHIM_DIR / "librs_shim.so"
+
+_MATRIX_KINDS = {"cauchy": 0, "vandermonde": 1}
+
+
+def build_shim(force: bool = False) -> Path:
+    """Build librs_shim.so with make; returns its path."""
+    if force or not _SO_PATH.exists():
+        subprocess.run(
+            ["make", "-C", str(_SHIM_DIR)] + (["-B"] if force else []),
+            check=True,
+            capture_output=True,
+        )
+    return _SO_PATH
+
+
+def shim_available() -> bool:
+    """True if the shared library exists or can be built."""
+    try:
+        build_shim()
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(str(build_shim()))
+        lib.rs_encoder_new.restype = ctypes.c_void_p
+        lib.rs_encoder_new.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        lib.rs_encoder_free.argtypes = [ctypes.c_void_p]
+        lib.rs_encode.restype = ctypes.c_int
+        lib.rs_encode.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+        ]
+        lib.rs_verify.restype = ctypes.c_int
+        lib.rs_verify.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+        ]
+        lib.rs_reconstruct.restype = ctypes.c_int
+        lib.rs_reconstruct.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
+        ]
+        lib.rs_shim_version.restype = ctypes.c_char_p
+        _lib = lib
+    return _lib
+
+
+def _as_u8_ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+class CppReedSolomon:
+    """Native-backend RS codec over contiguous (n, shard_len) buffers."""
+
+    def __init__(self, data_shards: int, parity_shards: int, matrix: str = "cauchy"):
+        if matrix not in _MATRIX_KINDS:
+            raise ValueError(f"unknown matrix kind {matrix!r}")
+        self.k = data_shards
+        self.r = parity_shards
+        self.n = data_shards + parity_shards
+        self._lib = _load()
+        self._enc = self._lib.rs_encoder_new(
+            data_shards, parity_shards, _MATRIX_KINDS[matrix]
+        )
+        if not self._enc:
+            raise ValueError(
+                f"invalid geometry k={data_shards} r={parity_shards} "
+                f"(n must be <= 256)"
+            )
+
+    def __del__(self):
+        enc = getattr(self, "_enc", None)
+        if enc:
+            self._lib.rs_encoder_free(enc)
+            self._enc = None
+
+    @property
+    def version(self) -> str:
+        return self._lib.rs_shim_version().decode()
+
+    def _buffer(self, shards: Sequence[Optional[np.ndarray]]) -> np.ndarray:
+        lens = {s.shape[-1] for s in shards if s is not None}
+        if len(lens) != 1:
+            raise ValueError("present shards must share one length")
+        (ln,) = lens
+        buf = np.zeros((self.n, ln), dtype=np.uint8)
+        for i, s in enumerate(shards):
+            if s is not None:
+                buf[i] = s
+        return buf
+
+    def encode(self, data_shards: Sequence[np.ndarray]) -> np.ndarray:
+        """(k, S) data rows -> full (n, S) codeword (systematic)."""
+        if len(data_shards) != self.k:
+            raise ValueError(f"expected {self.k} data shards, got {len(data_shards)}")
+        buf = self._buffer(list(data_shards) + [None] * self.r)
+        rc = self._lib.rs_encode(self._enc, _as_u8_ptr(buf), buf.shape[1])
+        if rc != 0:
+            raise RuntimeError(f"rs_encode failed: {rc}")
+        return buf
+
+    def encode_into(self, codeword: np.ndarray) -> None:
+        """Zero-copy encode: fill the parity rows of a contiguous
+        C-order (n, S) uint8 buffer in place."""
+        if codeword.shape[0] != self.n or codeword.dtype != np.uint8:
+            raise ValueError(f"need a C-contiguous ({self.n}, S) uint8 buffer")
+        if not codeword.flags.c_contiguous:
+            raise ValueError("buffer must be C-contiguous")
+        rc = self._lib.rs_encode(self._enc, _as_u8_ptr(codeword), codeword.shape[1])
+        if rc != 0:
+            raise RuntimeError(f"rs_encode failed: {rc}")
+
+    def verify(self, shards: Sequence[np.ndarray]) -> bool:
+        if len(shards) != self.n:
+            raise ValueError(f"expected {self.n} shards, got {len(shards)}")
+        buf = self._buffer(shards)
+        rc = self._lib.rs_verify(self._enc, _as_u8_ptr(buf), buf.shape[1])
+        if rc < 0:
+            raise RuntimeError(f"rs_verify failed: {rc}")
+        return bool(rc)
+
+    def reconstruct(
+        self,
+        shards: Sequence[Optional[np.ndarray]],
+        data_only: bool = False,
+    ) -> np.ndarray:
+        """Fill ``None`` rows; returns the full (n, S) (or repaired-data)
+        buffer. Present rows are trusted (erasure-only — corruption
+        detection is the signature layer's job, main.go:82-99)."""
+        if len(shards) != self.n:
+            raise ValueError(f"expected {self.n} shards, got {len(shards)}")
+        present = np.array(
+            [0 if s is None else 1 for s in shards], dtype=np.uint8
+        )
+        if int(present.sum()) < self.k:
+            raise ValueError(
+                f"need >= {self.k} present shards, have {int(present.sum())}"
+            )
+        buf = self._buffer(shards)
+        rc = self._lib.rs_reconstruct(
+            self._enc, _as_u8_ptr(buf), buf.shape[1], _as_u8_ptr(present),
+            1 if data_only else 0,
+        )
+        if rc != 0:
+            raise RuntimeError(f"rs_reconstruct failed: {rc}")
+        return buf
+
+
+def _selftest() -> int:
+    from noise_ec_tpu.golden.codec import GoldenCodec
+
+    rng = np.random.default_rng(0)
+    for k, r in [(4, 2), (10, 4), (17, 3), (50, 20), (1, 1), (2, 0)]:
+        for matrix in ("cauchy", "vandermonde"):
+            S = 512
+            cpp = CppReedSolomon(k, r, matrix=matrix)
+            gold = GoldenCodec(k, k + r, matrix=matrix)
+            data = rng.integers(0, 256, size=(k, S)).astype(np.uint8)
+            cw_cpp = cpp.encode(list(data))
+            cw_gold = gold.encode_all(data)
+            assert np.array_equal(cw_cpp, cw_gold), (k, r, matrix, "encode")
+            assert cpp.verify(list(cw_cpp)), (k, r, matrix, "verify")
+            if r:
+                bad = cw_cpp.copy()
+                bad[k, 0] ^= 1
+                assert not cpp.verify(list(bad)), (k, r, matrix, "verify-neg")
+                erased = [
+                    None if i < min(r, k) else cw_cpp[i] for i in range(k + r)
+                ]
+                rec = cpp.reconstruct(erased)
+                assert np.array_equal(rec, cw_cpp), (k, r, matrix, "reconstruct")
+    print("shim selftest OK:", CppReedSolomon(4, 2).version)
+    return 0
+
+
+if __name__ == "__main__":
+    if "--selftest" in sys.argv:
+        sys.exit(_selftest())
+    build_shim(force="--force" in sys.argv)
+    print(_SO_PATH)

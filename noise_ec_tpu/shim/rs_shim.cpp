@@ -1,0 +1,354 @@
+// rs_shim: GF(2^8) Reed-Solomon erasure codec behind a plain C ABI.
+//
+// This is the framework's native host-side codec (SURVEY.md §2.2: the one
+// native component, and §7.1 "shim/"): a C-ABI boundary shaped after the
+// klauspost/reedsolomon Encoder interface (Encode / Verify / Reconstruct)
+// so a Go host can `cgo`-link it as a drop-in backend, exactly where the
+// reference links vivint/infectious (/root/reference/main.go:248-266).
+//
+// Bit-compatible with the Python/TPU path by construction: the same
+// primitive polynomial 0x11D (noise_ec_tpu/gf/field.py) and the same
+// systematic Cauchy / Vandermonde generators
+// (noise_ec_tpu/matrix/generators.py) — shards encoded here reconstruct
+// there and vice versa.
+//
+// The hot loop is table-driven: each coefficient c expands to two 16-entry
+// nibble tables so one byte product is two loads and a XOR, with the rows
+// XOR-accumulated in place (the klauspost AVX2 kernels are the same split-
+// nibble scheme in SIMD registers; -O3 autovectorizes the inner loop).
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <new>
+#include <vector>
+
+#if defined(__AVX2__) || defined(__SSSE3__)
+#include <immintrin.h>
+#endif
+
+namespace {
+
+constexpr int kPoly = 0x11D;  // x^8 + x^4 + x^3 + x^2 + 1
+constexpr int kOrder = 256;
+
+struct Tables {
+  uint8_t exp[2 * (kOrder - 1)];
+  int log[kOrder];
+  Tables() {
+    int x = 1;
+    for (int i = 0; i < kOrder - 1; ++i) {
+      exp[i] = static_cast<uint8_t>(x);
+      log[x] = i;
+      x <<= 1;
+      if (x & kOrder) x ^= kPoly;
+    }
+    log[0] = 0;  // never used: mul() guards zero operands
+    for (int i = 0; i < kOrder - 1; ++i) exp[kOrder - 1 + i] = exp[i];
+  }
+};
+
+const Tables& tables() {
+  static const Tables t;
+  return t;
+}
+
+inline uint8_t gf_mul(uint8_t a, uint8_t b) {
+  if (a == 0 || b == 0) return 0;
+  const Tables& t = tables();
+  return t.exp[t.log[a] + t.log[b]];
+}
+
+inline uint8_t gf_inv(uint8_t a) {
+  const Tables& t = tables();
+  return t.exp[kOrder - 1 - t.log[a]];
+}
+
+inline uint8_t gf_pow(uint8_t a, int e) {
+  if (e == 0) return 1;
+  if (a == 0) return 0;
+  const Tables& t = tables();
+  return t.exp[(t.log[a] * e) % (kOrder - 1)];
+}
+
+// Dense k x k inversion by Gauss-Jordan; returns false when singular.
+bool invert(std::vector<uint8_t>& m, int k) {
+  std::vector<uint8_t> aug(static_cast<size_t>(k) * 2 * k, 0);
+  for (int r = 0; r < k; ++r) {
+    std::memcpy(&aug[static_cast<size_t>(r) * 2 * k], &m[static_cast<size_t>(r) * k], k);
+    aug[static_cast<size_t>(r) * 2 * k + k + r] = 1;
+  }
+  for (int col = 0; col < k; ++col) {
+    int piv = -1;
+    for (int r = col; r < k; ++r) {
+      if (aug[static_cast<size_t>(r) * 2 * k + col]) { piv = r; break; }
+    }
+    if (piv < 0) return false;
+    if (piv != col) {
+      for (int c = 0; c < 2 * k; ++c)
+        std::swap(aug[static_cast<size_t>(piv) * 2 * k + c],
+                  aug[static_cast<size_t>(col) * 2 * k + c]);
+    }
+    uint8_t inv_p = gf_inv(aug[static_cast<size_t>(col) * 2 * k + col]);
+    for (int c = 0; c < 2 * k; ++c)
+      aug[static_cast<size_t>(col) * 2 * k + c] =
+          gf_mul(aug[static_cast<size_t>(col) * 2 * k + c], inv_p);
+    for (int r = 0; r < k; ++r) {
+      if (r == col) continue;
+      uint8_t f = aug[static_cast<size_t>(r) * 2 * k + col];
+      if (!f) continue;
+      for (int c = 0; c < 2 * k; ++c)
+        aug[static_cast<size_t>(r) * 2 * k + c] ^=
+            gf_mul(f, aug[static_cast<size_t>(col) * 2 * k + c]);
+    }
+  }
+  for (int r = 0; r < k; ++r)
+    std::memcpy(&m[static_cast<size_t>(r) * k],
+                &aug[static_cast<size_t>(r) * 2 * k + k], k);
+  return true;
+}
+
+// out[len] ^= c * in[len], split-nibble tables: the product of c with any
+// byte b is lo[b & 15] ^ hi[b >> 4]. On x86 the two 16-entry tables live in
+// vector registers and pshufb does 32 (AVX2) or 16 (SSSE3) byte lookups per
+// instruction — the same scheme as klauspost/reedsolomon's assembly kernels.
+void mul_add_row(uint8_t* out, const uint8_t* in, uint8_t c, size_t len) {
+  if (c == 0) return;
+  if (c == 1) {
+    size_t i = 0;
+    for (; i + 8 <= len; i += 8) {
+      uint64_t a, b;
+      std::memcpy(&a, out + i, 8);
+      std::memcpy(&b, in + i, 8);
+      a ^= b;
+      std::memcpy(out + i, &a, 8);
+    }
+    for (; i < len; ++i) out[i] ^= in[i];
+    return;
+  }
+  alignas(32) uint8_t lo[16], hi[16];
+  for (int v = 0; v < 16; ++v) {
+    lo[v] = gf_mul(c, static_cast<uint8_t>(v));
+    hi[v] = gf_mul(c, static_cast<uint8_t>(v << 4));
+  }
+  size_t i = 0;
+#if defined(__GFNI__) && defined(__AVX512BW__)
+  // GFNI: mul-by-c is GF(2)-linear, i.e. an 8x8 bit-matrix (the same
+  // bitsliced formulation as the Pallas kernels — gf/bitmatrix.py);
+  // gf2p8affineqb applies it to 64 bytes per instruction for ANY
+  // polynomial, unlike gf2p8mulb which hardwires AES's 0x11B.
+  {
+    uint64_t aff = 0;
+    uint8_t col[8];
+    for (int k = 0; k < 8; ++k) col[k] = gf_mul(c, static_cast<uint8_t>(1 << k));
+    for (int j = 0; j < 8; ++j) {  // A.byte[7-j] = row for output bit j
+      uint64_t row = 0;
+      for (int k = 0; k < 8; ++k) row |= static_cast<uint64_t>((col[k] >> j) & 1) << k;
+      aff |= row << (8 * (7 - j));
+    }
+    const __m512i A = _mm512_set1_epi64(static_cast<long long>(aff));
+    for (; i + 64 <= len; i += 64) {
+      __m512i x = _mm512_loadu_si512(reinterpret_cast<const void*>(in + i));
+      __m512i y = _mm512_loadu_si512(reinterpret_cast<const void*>(out + i));
+      y = _mm512_xor_si512(y, _mm512_gf2p8affine_epi64_epi8(x, A, 0));
+      _mm512_storeu_si512(reinterpret_cast<void*>(out + i), y);
+    }
+  }
+#endif
+#if defined(__AVX2__)
+  {
+    const __m256i vlo = _mm256_broadcastsi128_si256(
+        _mm_load_si128(reinterpret_cast<const __m128i*>(lo)));
+    const __m256i vhi = _mm256_broadcastsi128_si256(
+        _mm_load_si128(reinterpret_cast<const __m128i*>(hi)));
+    const __m256i mask = _mm256_set1_epi8(0x0F);
+    for (; i + 32 <= len; i += 32) {
+      __m256i x = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(in + i));
+      __m256i y = _mm256_loadu_si256(reinterpret_cast<__m256i*>(out + i));
+      __m256i pl = _mm256_shuffle_epi8(vlo, _mm256_and_si256(x, mask));
+      __m256i ph = _mm256_shuffle_epi8(
+          vhi, _mm256_and_si256(_mm256_srli_epi64(x, 4), mask));
+      y = _mm256_xor_si256(y, _mm256_xor_si256(pl, ph));
+      _mm256_storeu_si256(reinterpret_cast<__m256i*>(out + i), y);
+    }
+  }
+#elif defined(__SSSE3__)
+  {
+    const __m128i vlo = _mm_load_si128(reinterpret_cast<const __m128i*>(lo));
+    const __m128i vhi = _mm_load_si128(reinterpret_cast<const __m128i*>(hi));
+    const __m128i mask = _mm_set1_epi8(0x0F);
+    for (; i + 16 <= len; i += 16) {
+      __m128i x = _mm_loadu_si128(reinterpret_cast<const __m128i*>(in + i));
+      __m128i y = _mm_loadu_si128(reinterpret_cast<__m128i*>(out + i));
+      __m128i pl = _mm_shuffle_epi8(vlo, _mm_and_si128(x, mask));
+      __m128i ph =
+          _mm_shuffle_epi8(vhi, _mm_and_si128(_mm_srli_epi64(x, 4), mask));
+      y = _mm_xor_si128(y, _mm_xor_si128(pl, ph));
+      _mm_storeu_si128(reinterpret_cast<__m128i*>(out + i), y);
+    }
+  }
+#endif
+  for (; i < len; ++i)
+    out[i] = static_cast<uint8_t>(out[i] ^ lo[in[i] & 0x0F] ^ hi[in[i] >> 4]);
+}
+
+struct Encoder {
+  int k;
+  int r;
+  std::vector<uint8_t> gen;  // (k + r, k) systematic generator, row-major
+};
+
+// Systematic Cauchy generator — matches matrix/generators.py:cauchy_parity:
+// top block identity, parity P[i][j] = inv((k + i) ^ j).
+bool build_cauchy(Encoder* e) {
+  if (e->k + e->r > kOrder) return false;
+  e->gen.assign(static_cast<size_t>(e->k + e->r) * e->k, 0);
+  for (int i = 0; i < e->k; ++i) e->gen[static_cast<size_t>(i) * e->k + i] = 1;
+  for (int i = 0; i < e->r; ++i)
+    for (int j = 0; j < e->k; ++j)
+      e->gen[static_cast<size_t>(e->k + i) * e->k + j] =
+          gf_inv(static_cast<uint8_t>((e->k + i) ^ j));
+  return true;
+}
+
+// Systematic Vandermonde — matches generators.py:vandermonde_systematic:
+// raw V[row][col] = row^col, then right-multiplied by inv(V[:k]).
+bool build_vandermonde(Encoder* e) {
+  int n = e->k + e->r;
+  if (n > kOrder) return false;
+  std::vector<uint8_t> V(static_cast<size_t>(n) * e->k);
+  for (int row = 0; row < n; ++row)
+    for (int col = 0; col < e->k; ++col)
+      V[static_cast<size_t>(row) * e->k + col] =
+          gf_pow(static_cast<uint8_t>(row), col);
+  std::vector<uint8_t> top(V.begin(), V.begin() + static_cast<size_t>(e->k) * e->k);
+  if (!invert(top, e->k)) return false;
+  e->gen.assign(static_cast<size_t>(n) * e->k, 0);
+  for (int row = 0; row < n; ++row)
+    for (int col = 0; col < e->k; ++col) {
+      uint8_t acc = 0;
+      for (int t = 0; t < e->k; ++t)
+        acc ^= gf_mul(V[static_cast<size_t>(row) * e->k + t],
+                      top[static_cast<size_t>(t) * e->k + col]);
+      e->gen[static_cast<size_t>(row) * e->k + col] = acc;
+    }
+  return true;
+}
+
+// parity/verify core: out rows = M (rows x k) applied to k input rows.
+// Blocked over the stripe axis so each output tile stays cache-resident
+// across all k accumulations — the unblocked loop re-streams every output
+// row from DRAM k times and saturates memory bandwidth long before ALUs.
+void matmul_rows(const uint8_t* M, int rows, int k, const uint8_t* const* in,
+                 uint8_t* const* out, size_t len) {
+  constexpr size_t kTile = 32 << 10;  // fits L1d alongside one input tile
+  for (size_t off = 0; off < len || off == 0; off += kTile) {
+    size_t t = len - off < kTile ? len - off : kTile;
+    for (int i = 0; i < rows; ++i) {
+      std::memset(out[i] + off, 0, t);
+      for (int j = 0; j < k; ++j)
+        mul_add_row(out[i] + off, in[j] + off,
+                    M[static_cast<size_t>(i) * k + j], t);
+    }
+    if (len == 0) break;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* rs_shim_version() { return "noise-ec-tpu-shim/1 gf256 poly=0x11D"; }
+
+// matrix_kind: 0 = cauchy (default), 1 = systematic vandermonde.
+// Returns nullptr on invalid geometry.
+void* rs_encoder_new(int data_shards, int parity_shards, int matrix_kind) {
+  if (data_shards < 1 || parity_shards < 0 ||
+      data_shards + parity_shards > kOrder)
+    return nullptr;
+  Encoder* e = new (std::nothrow) Encoder{data_shards, parity_shards, {}};
+  if (!e) return nullptr;
+  bool ok = matrix_kind == 1 ? build_vandermonde(e) : build_cauchy(e);
+  if (!ok) { delete e; return nullptr; }
+  return e;
+}
+
+void rs_encoder_free(void* enc) { delete static_cast<Encoder*>(enc); }
+
+// shards: contiguous (k + r) x shard_len buffer, data rows first.
+// Fills the parity rows. Returns 0 on success.
+int rs_encode(void* enc, uint8_t* shards, size_t shard_len) {
+  Encoder* e = static_cast<Encoder*>(enc);
+  if (!e || !shards) return -1;
+  std::vector<const uint8_t*> in(e->k);
+  std::vector<uint8_t*> out(e->r);
+  for (int j = 0; j < e->k; ++j) in[j] = shards + static_cast<size_t>(j) * shard_len;
+  for (int i = 0; i < e->r; ++i)
+    out[i] = shards + static_cast<size_t>(e->k + i) * shard_len;
+  matmul_rows(&e->gen[static_cast<size_t>(e->k) * e->k], e->r, e->k, in.data(),
+              out.data(), shard_len);
+  return 0;
+}
+
+// Returns 1 when parity rows match the data rows, 0 on mismatch, <0 error.
+int rs_verify(void* enc, const uint8_t* shards, size_t shard_len) {
+  Encoder* e = static_cast<Encoder*>(enc);
+  if (!e || !shards) return -1;
+  std::vector<uint8_t> expect(static_cast<size_t>(e->r) * shard_len);
+  std::vector<const uint8_t*> in(e->k);
+  std::vector<uint8_t*> out(e->r);
+  for (int j = 0; j < e->k; ++j) in[j] = shards + static_cast<size_t>(j) * shard_len;
+  for (int i = 0; i < e->r; ++i) out[i] = &expect[static_cast<size_t>(i) * shard_len];
+  matmul_rows(&e->gen[static_cast<size_t>(e->k) * e->k], e->r, e->k, in.data(),
+              out.data(), shard_len);
+  return std::memcmp(expect.data(), shards + static_cast<size_t>(e->k) * shard_len,
+                     expect.size()) == 0
+             ? 1
+             : 0;
+}
+
+// present: n flags (nonzero = shard row holds valid bytes). Missing rows of
+// `shards` are overwritten with the reconstructed bytes. data_only != 0
+// restores only the first k rows (ReconstructData). Returns 0 on success,
+// -2 with fewer than k present shards, -3 on a singular submatrix.
+int rs_reconstruct(void* enc, uint8_t* shards, size_t shard_len,
+                   const uint8_t* present, int data_only) {
+  Encoder* e = static_cast<Encoder*>(enc);
+  if (!e || !shards || !present) return -1;
+  int n = e->k + e->r;
+  std::vector<int> have;
+  for (int i = 0; i < n && static_cast<int>(have.size()) < e->k; ++i)
+    if (present[i]) have.push_back(i);
+  if (static_cast<int>(have.size()) < e->k) return -2;
+
+  // A = generator rows of the k survivors; data = inv(A) @ survivors.
+  std::vector<uint8_t> A(static_cast<size_t>(e->k) * e->k);
+  for (int i = 0; i < e->k; ++i)
+    std::memcpy(&A[static_cast<size_t>(i) * e->k],
+                &e->gen[static_cast<size_t>(have[i]) * e->k], e->k);
+  if (!invert(A, e->k)) return -3;
+
+  std::vector<const uint8_t*> surv(e->k);
+  for (int i = 0; i < e->k; ++i)
+    surv[i] = shards + static_cast<size_t>(have[i]) * shard_len;
+
+  // For each missing row m: coeffs = G[m] @ inv(A), then row = coeffs @ surv.
+  for (int m = 0; m < n; ++m) {
+    if (present[m]) continue;
+    if (data_only && m >= e->k) continue;
+    std::vector<uint8_t> coeffs(e->k, 0);
+    for (int c = 0; c < e->k; ++c) {
+      uint8_t acc = 0;
+      for (int t = 0; t < e->k; ++t)
+        acc ^= gf_mul(e->gen[static_cast<size_t>(m) * e->k + t],
+                      A[static_cast<size_t>(t) * e->k + c]);
+      coeffs[c] = acc;
+    }
+    uint8_t* dst = shards + static_cast<size_t>(m) * shard_len;
+    std::memset(dst, 0, shard_len);
+    for (int c = 0; c < e->k; ++c) mul_add_row(dst, surv[c], coeffs[c], shard_len);
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -212,6 +212,154 @@ def test_tcp_three_node_fan_out():
             net.close()
 
 
+class FakeWriter:
+    """Stands in for an asyncio StreamWriter in handshake unit tests."""
+
+    def __init__(self):
+        self.frames = []
+        self.closed = False
+
+        class _T:
+            @staticmethod
+            def get_write_buffer_size():
+                return 0
+
+        self.transport = _T()
+
+    def write(self, data):
+        self.frames.append(data)
+
+    def close(self):
+        self.closed = True
+
+
+def make_tcp(port=3900):
+    net = TCPNetwork(host="127.0.0.1", port=port)
+    net.add_plugin(ShardPlugin(backend="numpy"))
+    return net
+
+
+def deliver(net, frame_bytes, writer, conn):
+    net._on_frame(frame_bytes[4:], writer, conn)  # strip length prefix
+
+
+def test_handshake_replayed_hello_never_registers():
+    """A captured HELLO replayed on a fresh connection verifies as a
+    signature but cannot complete the nonce handshake: the victim's
+    identity is never bound to the replaying socket."""
+    from noise_ec_tpu.host.transport import _Conn
+
+    alice, victim = make_tcp(3901), make_tcp(3902)
+    hello = victim._frame(1, b"\x11" * 32)  # victim's genuine HELLO bytes
+    w, conn = FakeWriter(), _Conn()
+    deliver(alice, hello, w, conn)  # attacker replays it to alice
+    assert victim.keys.public_key not in alice.peers  # no registration
+    assert len(w.frames) == 1  # only a HELLO_REPLY challenge went back
+    # ...and the attacker cannot produce the matching ACK: a stale ACK
+    # (wrong nonce) is rejected too.
+    stale_ack = victim._frame(4, b"\x22" * 32)
+    deliver(alice, stale_ack, w, conn)
+    assert victim.keys.public_key not in alice.peers
+    assert alice.error_count >= 1
+
+
+def test_handshake_full_exchange_registers_both():
+    from noise_ec_tpu.host.transport import _Conn
+
+    a, b = make_tcp(3903), make_tcp(3904)
+    wa, wb = FakeWriter(), FakeWriter()  # a's socket to b, b's socket to a
+    conn_a, conn_b = _Conn(), _Conn()
+
+    hello = a._frame(1, conn_a.nonce)       # a dials b
+    deliver(b, hello, wb, conn_b)           # b answers with REPLY
+    assert len(wb.frames) == 1 and not b.peers
+    deliver(a, wb.frames[0], wa, conn_a)    # a sees REPLY: registers + ACKs
+    assert a.peers and conn_a.peer.address == b.id.address
+    deliver(b, wa.frames[0], wb, conn_b)    # b sees ACK: registers
+    assert b.peers and conn_b.peer.address == a.id.address
+
+
+def test_shard_from_unregistered_connection_rejected():
+    from noise_ec_tpu.host.transport import _Conn
+    from noise_ec_tpu.host.wire import Shard
+
+    a, stranger = make_tcp(3905), make_tcp(3906)
+    shard = Shard(file_signature=b"s", shard_data=b"abcd", shard_number=0,
+                  total_shards=6, minimum_needed_shards=4)
+    frame = stranger._frame(2, shard.marshal())
+    w, conn = FakeWriter(), _Conn()
+    deliver(a, frame, w, conn)  # no handshake ran on this conn
+    assert a.error_count == 1
+    assert a.plugins[0].counters.get("shards_in") == 0
+
+
+def test_frame_signature_covers_address():
+    """Rewriting the unsigned-looking address field invalidates the frame:
+    the signature preimage includes it."""
+    a, b = make_tcp(3907), make_tcp(3908)
+    frame = b._frame(1, b"\x07" * 32)[4:]
+    # splice a different address of the same length into the frame
+    addr = b.id.address.encode()
+    evil = addr.replace(b"3908", b"6666")
+    tampered = frame.replace(addr, evil, 1)
+    from noise_ec_tpu.host.transport import _Conn
+
+    w, conn = FakeWriter(), _Conn()
+    a._on_frame(tampered, w, conn)
+    assert a.error_count == 1  # bad signature recorded
+    assert not w.frames  # no HELLO_REPLY was sent
+
+
+def test_address_claim_cannot_evict_registered_peer():
+    """The registry is keyed by public key: an attacker who completes a
+    handshake with its OWN key while claiming a victim's address registers
+    as itself and cannot evict the victim from the broadcast fan-out."""
+    from noise_ec_tpu.host.crypto import PeerID
+    from noise_ec_tpu.host.transport import _Conn
+
+    alice, bob = make_tcp(3910), make_tcp(3911)
+    wb = FakeWriter()
+    alice._register(bob.id, wb, _Conn())  # bob legitimately registered
+
+    atk = make_tcp(3912)
+    atk.id = PeerID.create(bob.id.address, atk.keys.public_key)  # forged claim
+    conn, wa = _Conn(), FakeWriter()
+    deliver(alice, atk._frame(1, conn.nonce), wa, conn)
+    _, _, payload, _ = alice._parse_frame(wa.frames[0][4:])
+    alice_nonce = payload[32:]  # the handshake proves key possession only
+    deliver(alice, atk._frame(4, alice_nonce), wa, conn)
+
+    assert alice.peers[bob.keys.public_key].writer is wb  # bob intact
+    assert atk.keys.public_key in alice.peers  # attacker is itself, not bob
+
+
+def test_stalled_peer_disconnected_on_buffer_cap():
+    from noise_ec_tpu.host.transport import _Peer
+
+    a = make_tcp(3909)
+
+    class StalledWriter(FakeWriter):
+        def __init__(self):
+            super().__init__()
+
+            class _T:
+                @staticmethod
+                def get_write_buffer_size():
+                    return TCPNetwork.MAX_PEER_WRITE_BUFFER + 1
+
+            self.transport = _T()
+
+    w = StalledWriter()
+    from noise_ec_tpu.host.crypto import KeyPair, PeerID
+
+    pid = PeerID.create("tcp://stalled:1", KeyPair.random().public_key)
+    a.peers[pid.public_key] = _Peer(pid, w)
+    a._write_safe(w, b"frame")
+    assert pid.public_key not in a.peers  # dropped
+    assert w.closed
+    assert not w.frames  # nothing written past the cap
+
+
 def test_cli_parser_defaults():
     from noise_ec_tpu.host.cli import build_parser
 

@@ -1,0 +1,81 @@
+"""Native shim tests: the C-ABI codec must be bit-exact with the Python
+path in both directions (encode here, reconstruct there, and vice versa) —
+the interop contract a Go host relies on when cgo-linking the same .so."""
+
+import numpy as np
+import pytest
+
+from noise_ec_tpu.golden.codec import GoldenCodec
+
+shim = pytest.importorskip("noise_ec_tpu.shim")
+if not shim.shim_available():
+    pytest.skip("native toolchain unavailable", allow_module_level=True)
+
+from noise_ec_tpu.shim import CppReedSolomon  # noqa: E402
+
+
+@pytest.mark.parametrize("k,r", [(4, 2), (10, 4), (17, 3), (1, 1), (3, 5)])
+@pytest.mark.parametrize("matrix", ["cauchy", "vandermonde"])
+def test_encode_matches_golden(k, r, matrix):
+    rng = np.random.default_rng(k * 100 + r)
+    data = rng.integers(0, 256, size=(k, 256)).astype(np.uint8)
+    cpp = CppReedSolomon(k, r, matrix=matrix)
+    gold = GoldenCodec(k, k + r, matrix=matrix)
+    assert np.array_equal(cpp.encode(list(data)), gold.encode_all(data))
+
+
+def test_verify_positive_and_negative():
+    rng = np.random.default_rng(1)
+    cpp = CppReedSolomon(10, 4)
+    cw = cpp.encode(list(rng.integers(0, 256, size=(10, 128)).astype(np.uint8)))
+    assert cpp.verify(list(cw))
+    cw[11, 7] ^= 0x40
+    assert not cpp.verify(list(cw))
+
+
+@pytest.mark.parametrize("erase", [[0], [0, 1, 2], [9, 10, 13], [0, 5, 11, 12]])
+def test_reconstruct_erasures(erase):
+    rng = np.random.default_rng(7)
+    cpp = CppReedSolomon(10, 4)
+    cw = cpp.encode(list(rng.integers(0, 256, size=(10, 200)).astype(np.uint8)))
+    holes = [None if i in erase else cw[i] for i in range(14)]
+    assert np.array_equal(cpp.reconstruct(holes), cw)
+
+
+def test_reconstruct_data_only_leaves_parity_unfilled():
+    rng = np.random.default_rng(8)
+    cpp = CppReedSolomon(4, 2)
+    cw = cpp.encode(list(rng.integers(0, 256, size=(4, 64)).astype(np.uint8)))
+    holes = [None, cw[1], cw[2], cw[3], None, cw[5]]
+    rec = cpp.reconstruct(holes, data_only=True)
+    assert np.array_equal(rec[:4], cw[:4])
+    assert not rec[4].any()  # parity row 4 was erased and not restored
+
+
+def test_cross_backend_interop():
+    """Encode natively, reconstruct with the golden codec — and the other
+    way around. Same generator, same field, same bytes."""
+    rng = np.random.default_rng(9)
+    k, r, S = 10, 4, 300
+    cpp = CppReedSolomon(k, r)
+    gold = GoldenCodec(k, k + r)
+    data = rng.integers(0, 256, size=(k, S)).astype(np.uint8)
+
+    cw = cpp.encode(list(data))
+    out = gold.reconstruct([None if i in (0, 4, 12) else cw[i] for i in range(k + r)])
+    assert np.array_equal(np.stack(out), cw)
+
+    cw2 = gold.encode_all(data)
+    rec = cpp.reconstruct([None if i in (1, 2, 13) else cw2[i] for i in range(k + r)])
+    assert np.array_equal(rec, cw2)
+
+
+def test_insufficient_shards_raises():
+    cpp = CppReedSolomon(4, 2)
+    with pytest.raises(ValueError):
+        cpp.reconstruct([None, None, None, np.zeros(8, np.uint8), None, None])
+
+
+def test_invalid_geometry_rejected():
+    with pytest.raises(ValueError):
+        CppReedSolomon(200, 100)  # n > 256
