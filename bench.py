@@ -88,16 +88,101 @@ def main() -> None:
         )
         gbps = data_bytes / t_enc / 1e9
 
-        # Reconstruct: 3 data-shard erasures, single 1 MiB-shard object.
-        present = list(range(3, 3 + k))
-        R = reconstruction_matrix(gf, G, present, [0, 1, 2])
+        # --- config 2: Reconstruct() p50, 1-4 data-shard erasures, 1 MiB
+        # shards (matrix changes per erasure count; kernel is the same
+        # fused bitsliced matmul the decode hot loop runs, main.go:77).
         surv = jnp.asarray(
             rng.integers(0, 1 << 32, size=(k, (1 << 20) // 4), dtype=np.uint64).astype(np.uint32)
         )
-        t_rec = chained_seconds_per_iter(
-            lambda s: dev.matmul_words(R, s), surv
+        for e in (1, 2, 3, 4):
+            erased = list(range(e))
+            present = [i for i in range(k + r) if i not in erased][:k]
+            R = reconstruction_matrix(gf, G, present, erased)
+            t_rec = chained_seconds_per_iter(
+                lambda s, R=R: dev.matmul_words(R, s), surv, n_lo=5, n_hi=25
+            )
+            stats[f"reconstruct{e}_1mib_p50_ms"] = round(t_rec * 1e3, 3)
+
+        # --- config 3: high-rate RS(17,3) and wide RS(50,20) streaming
+        # encode (HBM-resident chunked stream, stripe axis folded).
+        for (k3, r3) in ((17, 3), (50, 20)):
+            G3 = generator_matrix(gf, k3, k3 + r3, "cauchy")
+            S3 = ((8 << 20) // k3 // 2048) * 2048 // 4  # ~8 MiB object, words
+            w3 = jnp.asarray(
+                rng.integers(0, 1 << 32, size=(k3, S3), dtype=np.uint64).astype(np.uint32)
+            )
+            t3 = chained_seconds_per_iter(
+                lambda s, M=G3[k3:]: dev.matmul_words(M, s), w3, n_lo=5, n_hi=25
+            )
+            stats[f"rs{k3}_{r3}_encode_gbps"] = round(k3 * S3 * 4 / t3 / 1e9, 2)
+
+        # --- config 4a: Cauchy vs PAR1-Vandermonde generator, RS(10,4).
+        Gp = generator_matrix(gf, k, k + r, "par1")
+        tp = chained_seconds_per_iter(
+            lambda s: dev.matmul_words(Gp[k:], s), words, n_lo=5, n_hi=25
         )
-        stats["reconstruct3_1mib_p50_ms"] = round(t_rec * 1e3, 3)
+        stats["rs10_4_par1_encode_gbps"] = round(data_bytes / tp / 1e9, 2)
+
+        # --- config 4b: GF(2^16) field variant (16x16 bit-matrix per
+        # coefficient; u8-stripe entry, includes the device relayout).
+        try:
+            from noise_ec_tpu.gf.field import GF65536
+
+            gf16 = GF65536()
+            G16 = generator_matrix(gf16, k, k + r, "cauchy")
+            dev16 = DeviceCodec(field="gf65536", kernel="xla")
+            S16 = 1 << 18  # symbols per stripe (512 KiB of u16 per shard)
+            st16 = rng.integers(0, 1 << 16, size=(k, S16)).astype(np.uint16)
+            dev16.matmul_stripes(G16[k:], st16)  # compile
+            t0 = time.perf_counter()
+            for _ in range(3):
+                dev16.matmul_stripes(G16[k:], st16)
+            t16 = (time.perf_counter() - t0) / 3
+            stats["rs10_4_gf65536_encode_gbps"] = round(
+                k * S16 * 2 / t16 / 1e9, 2
+            )
+        except Exception as exc:  # noqa: BLE001 — secondary stat only
+            stats["rs10_4_gf65536_error"] = str(exc)[:80]
+
+        # --- config 5: batched multi-object sharded encode over a device
+        # mesh with parity assembled across the row axis (ICI all-gather;
+        # single-chip here, the dryrun_multichip path covers N>1).
+        try:
+            from noise_ec_tpu.parallel.batch import BatchCodec
+            from noise_ec_tpu.parallel.mesh import make_mesh
+
+            devs = jax.devices()
+            mesh = make_mesh(("batch", "row"), (len(devs), 1), devs)
+            bc = BatchCodec(k, r)
+            B, Sb = 8 * len(devs), 1 << 18
+            data_b = rng.integers(0, 256, size=(B, k, Sb)).astype(np.uint8)
+            enc_b = bc.make_sharded_encoder(mesh, row_axis="row")
+            xb = jnp.asarray(data_b)
+            jax.block_until_ready(enc_b(xb))  # compile
+            t0 = time.perf_counter()
+            for _ in range(3):
+                jax.block_until_ready(enc_b(xb))
+            tb = (time.perf_counter() - t0) / 3
+            stats["batch_mesh_encode_gbps"] = round(B * k * Sb / tb / 1e9, 2)
+            stats["batch_mesh_devices"] = len(devs)
+        except Exception as exc:  # noqa: BLE001
+            stats["batch_mesh_error"] = str(exc)[:80]
+
+        # --- comparison bar: the native CPU shim (klauspost-class path).
+        try:
+            from noise_ec_tpu.shim import CppReedSolomon
+
+            cpp = CppReedSolomon(k, r)
+            buf = np.zeros((k + r, 1 << 20), dtype=np.uint8)
+            buf[:k] = rng.integers(0, 256, size=(k, 1 << 20)).astype(np.uint8)
+            cpp.encode_into(buf)
+            t0 = time.perf_counter()
+            for _ in range(5):
+                cpp.encode_into(buf)
+            tc = (time.perf_counter() - t0) / 5
+            stats["cpu_shim_encode_gbps"] = round(k * (1 << 20) / tc / 1e9, 2)
+        except Exception as exc:  # noqa: BLE001
+            stats["cpu_shim_error"] = str(exc)[:80]
     else:
         # Portability fallback (CPU CI): host-path timing, not the headline.
         shards = rng.integers(0, 256, size=(k, S)).astype(np.uint8)
