@@ -218,9 +218,11 @@ class DeviceCodec:
     def matmul_words(self, M: np.ndarray, words: jnp.ndarray) -> jnp.ndarray:
         """Device-resident GF(2^8) entry: (k, TW) uint32 -> (r, TW) uint32.
 
-        The words ARE the shard bytes (little-endian u32 view); TW must be a
-        multiple of WORD_QUANTUM. This is the zero-relayout hot path used by
-        bench and the parallel layer.
+        The words ARE the shard bytes (little-endian u32 view). Any TW is
+        accepted: non-WORD_QUANTUM sizes are zero-padded on device and the
+        product sliced back (symbols are positionwise, so padding is inert;
+        under an enclosing jit the pad/slice fuse into the program). This is
+        the zero-relayout hot path used by bench and the parallel layer.
         """
         if self.gf.degree != 8:
             raise ValueError("matmul_words is the GF(2^8) path")
@@ -229,6 +231,11 @@ class DeviceCodec:
         fn = _fused_words_fn(
             M.shape[0], self.bits_rows_for(M), self.kernel == "pallas_interpret"
         )
+        TW = words.shape[1]
+        TWp = pad_words(TW)
+        if TWp != TW:
+            out = fn(jnp.pad(words, ((0, 0), (0, TWp - TW))))
+            return out[:, :TW]
         return fn(words)
 
     def matmul_planes(self, M: np.ndarray, planes: jnp.ndarray) -> jnp.ndarray:
