@@ -29,6 +29,7 @@ import numpy as np
 
 from noise_ec_tpu.codec.rs import ReedSolomon
 from noise_ec_tpu.golden.codec import GoldenCodec, NotEnoughShardsError, TooManyErrorsError
+from noise_ec_tpu.matrix.linalg import gf_inv
 
 __all__ = ["FEC", "Share", "NotEnoughShardsError", "TooManyErrorsError"]
 
@@ -72,6 +73,10 @@ class FEC:
         # Error-correcting decode path (consistent-subset search) runs on the
         # golden codec with the same generator matrix.
         self._golden = GoldenCodec(required, total, field=field, matrix=matrix)
+        # Decode-path instrumentation: "fast" = submatrix-inverse multiply on
+        # the configured backend (the main.go:77 hot loop on the device
+        # codec); "subset" = golden consistent-subset search fallback.
+        self.stats = {"fast_decodes": 0, "subset_decodes": 0}
 
     @property
     def required(self) -> int:
@@ -112,13 +117,62 @@ class FEC:
         the unique-decoding radius floor((m-k)/2) are detected and corrected
         (the guarantee infectious's Berlekamp-Welch decode gives the
         reference at main.go:77).
+
+        The common case — k distinct consistent shares, or more that all
+        agree — runs on the configured backend: the k x k submatrix inverse
+        is computed on the host (tiny, O(k^3)) and the inverse x survivors
+        multiply plus the consistency re-encode run on the device codec.
+        Only inconsistent share sets (corruption within the decoding
+        radius) drop to the golden consistent-subset search.
         """
-        pairs = [
-            (s.number, self._sym(np.frombuffer(bytes(s.data), dtype=np.uint8)))
-            for s in shares
-        ]
+        dedup: dict[int, np.ndarray] = {}
+        for s in shares:
+            num = int(s.number)
+            if not 0 <= num < self.n:
+                raise ValueError(
+                    f"share number {num} out of range [0, {self.n})"
+                )
+            arr = self._sym(np.frombuffer(bytes(s.data), dtype=np.uint8))
+            if num in dedup:
+                if not np.array_equal(dedup[num], arr):
+                    raise ValueError(f"conflicting copies of share {num}")
+                continue
+            dedup[num] = arr
+        if len(dedup) < self.k:
+            raise NotEnoughShardsError(
+                f"have {len(dedup)} shares, need {self.k}"
+            )
+        nums = sorted(dedup)
+        fast = self._decode_fast(nums, dedup)
+        if fast is not None:
+            self.stats["fast_decodes"] += 1
+            return np.ascontiguousarray(fast).tobytes()
+        self.stats["subset_decodes"] += 1
+        pairs = [(i, dedup[i]) for i in nums]
         data = self._golden.decode_shares(pairs)  # (k, S) symbol rows
         return np.ascontiguousarray(data).tobytes()
+
+    def _decode_fast(
+        self, nums: list[int], stripes: dict[int, np.ndarray]
+    ) -> Optional[np.ndarray]:
+        """Backend-accelerated decode of the first k distinct shares,
+        accepted only if every received share agrees with the result.
+        Returns None (caller falls back to subset search) on a singular
+        basis (non-MDS matrices) or any disagreement."""
+        G = self._golden.G
+        basis = nums[: self.k]
+        try:
+            inv = gf_inv(self._golden.gf, G[basis])
+        except np.linalg.LinAlgError:
+            return None
+        data = self._rs._mul(inv, np.stack([stripes[i] for i in basis]))
+        if len(nums) == self.k:
+            return data  # no redundancy to check against (main.go:77 case)
+        codeword = self._rs._mul(G[nums], data)
+        for row, i in enumerate(nums):
+            if not np.array_equal(codeword[row], stripes[i]):
+                return None
+        return data
 
     def rebuild(
         self,

@@ -185,10 +185,17 @@ class ShardPool:
     def _expire_locked(self) -> None:
         """Drop pools older than the TTL. The reference keeps partial pools
         forever (in-memory ``sync.Map``, no expiry — SURVEY.md §5
-        checkpoint/resume row); a TTL bounds memory under shard loss."""
+        checkpoint/resume row); a TTL bounds memory under shard loss.
+
+        Pools are only ever inserted with ``created_at = now`` and dicts
+        preserve insertion order, so the stale pools are exactly a prefix of
+        iteration order: this scans stale entries plus one, not all 65k
+        pools per arriving shard (round-1 ADVICE finding 4)."""
         if self._ttl is None:
             return
         cutoff = time.monotonic() - self._ttl
-        stale = [k for k, e in self._pools.items() if e.created_at < cutoff]
-        for k in stale:
-            self._drop_locked(k)
+        while self._pools:
+            key = next(iter(self._pools))
+            if self._pools[key].created_at >= cutoff:
+                break
+            self._drop_locked(key)

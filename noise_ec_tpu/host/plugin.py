@@ -154,6 +154,11 @@ class ShardPlugin:
         self._completed_lock = threading.Lock()
         self.completed_cache_size = 4096
         self.dedup_window_seconds = 5.0
+        # Guards the (minimum_needed_shards, total_shards) read-modify-write
+        # in _adjusted_geometry: concurrent prepare_shards calls must not
+        # tear the geometry or skip the max_total_shards validation
+        # (round-1 ADVICE finding 5).
+        self._geometry_lock = threading.Lock()
 
     # ---------------------------------------------------------------- codec
 
@@ -249,34 +254,35 @@ class ShardPlugin:
         process lifetime. Interop is unaffected either way because geometry
         rides in every shard; pass ``adjust_geometry=False`` to refuse
         (raise) instead."""
-        k, n = self.minimum_needed_shards, self.total_shards
-        if length % k == 0:
-            return k, n
-        if not self.adjust_geometry:
-            raise ValueError(
-                f"input length {length} is not a multiple of k={k} "
-                "and geometry adjustment is disabled"
+        with self._geometry_lock:
+            k, n = self.minimum_needed_shards, self.total_shards
+            if length % k == 0:
+                return k, n
+            if not self.adjust_geometry:
+                raise ValueError(
+                    f"input length {length} is not a multiple of k={k} "
+                    "and geometry adjustment is disabled"
+                )
+            k = largest_prime_factor(length)
+            if k < 1:
+                raise ValueError(f"cannot shard {length}-byte input")
+            # Validate BEFORE mutating plugin state: an over-field geometry
+            # must not brick every subsequent send (the reference would panic
+            # inside infectious here; we reject and keep the old geometry).
+            if n + k > self.max_total_shards:
+                raise ValueError(
+                    f"adjusted geometry k={k} n={n + k} exceeds the GF(2^8) "
+                    f"limit of {self.max_total_shards} total shards; message "
+                    f"length {length} cannot be sharded with accumulated n={n}"
+                )
+            self.minimum_needed_shards = k
+            self.total_shards = n + k
+            log.info(
+                "revised geometry: minimum_needed_shards=%d total_shards=%d",
+                self.minimum_needed_shards,
+                self.total_shards,
             )
-        k = largest_prime_factor(length)
-        if k < 1:
-            raise ValueError(f"cannot shard {length}-byte input")
-        # Validate BEFORE mutating plugin state: an over-field geometry must
-        # not brick every subsequent send (the reference would panic inside
-        # infectious here; we reject and keep the old geometry).
-        if n + k > self.max_total_shards:
-            raise ValueError(
-                f"adjusted geometry k={k} n={n + k} exceeds the GF(2^8) "
-                f"limit of {self.max_total_shards} total shards; message "
-                f"length {length} cannot be sharded with accumulated n={n}"
-            )
-        self.minimum_needed_shards = k
-        self.total_shards = n + k
-        log.info(
-            "revised geometry: minimum_needed_shards=%d total_shards=%d",
-            self.minimum_needed_shards,
-            self.total_shards,
-        )
-        return self.minimum_needed_shards, self.total_shards
+            return self.minimum_needed_shards, self.total_shards
 
     # -------------------------------------------------------- receive path
 

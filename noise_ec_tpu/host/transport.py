@@ -219,17 +219,117 @@ class LoopbackNetwork:
 # Frame layout (all little-endian):
 #   u32 frame_len | u8 opcode | u32 addr_len | addr utf-8 | 32B pubkey |
 #   u32 payload_len | payload | 64B ed25519 signature over
-#   blake2b256(opcode ‖ payload)
-# HELLO carries an empty payload and introduces the peer (the discovery
-# handshake); SHARD carries a marshaled Shard. Every frame is signed, the
-# transport-level integrity the reference gets from noise's signed messages
-# (SURVEY.md §2.3 D2).
+#   blake2b256(opcode ‖ u32le(addr_len) ‖ addr ‖ u32le(payload_len) ‖ payload)
+# The preimage is length-delimited, so no byte can migrate between the addr
+# and payload fields without invalidating the signature (frame malleability).
+# HELLO carries the dialer's nonce and introduces the peer (the discovery
+# handshake); SHARD carries a marshaled Shard; PEERS carries a list of peer
+# addresses (gossip). Every frame is signed, the transport-level integrity
+# the reference gets from noise's signed messages (SURVEY.md §2.3 D2).
 _OP_HELLO = 1        # dialer -> acceptor: payload = dialer 32B nonce
 _OP_HELLO_REPLY = 3  # acceptor -> dialer: payload = dialer_nonce ‖ acceptor_nonce
 _OP_HELLO_ACK = 4    # dialer -> acceptor: payload = acceptor_nonce
 _OP_SHARD = 2        # payload = marshaled Shard
+_OP_PEERS = 5        # payload = u32 count | count x (u32 len | addr utf-8)
 _MAX_FRAME = 64 << 20
 _NONCE_LEN = 32
+
+
+def _sign_preimage(opcode: int, addr: bytes, payload: bytes) -> bytes:
+    return b"".join(
+        [
+            bytes([opcode]),
+            struct.pack("<I", len(addr)),
+            addr,
+            struct.pack("<I", len(payload)),
+            payload,
+        ]
+    )
+
+
+def _encode_peer_list(addresses: list[str]) -> bytes:
+    parts = [struct.pack("<I", len(addresses))]
+    for a in addresses:
+        raw = a.encode()
+        parts.append(struct.pack("<I", len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def _decode_peer_list(payload: bytes) -> list[str]:
+    pos = 0
+    (count,) = struct.unpack_from("<I", payload, pos); pos += 4
+    if count > 4096:
+        raise WireError(f"peer list count {count} exceeds cap")
+    out = []
+    for _ in range(count):
+        (ln,) = struct.unpack_from("<I", payload, pos); pos += 4
+        if pos + ln > len(payload):
+            raise WireError("truncated peer list")
+        out.append(payload[pos : pos + ln].decode()); pos += ln
+    if pos != len(payload):
+        raise WireError("trailing bytes in peer list")
+    return out
+
+
+class _SerialDispatcher:
+    """Per-key ordered dispatch on a shared worker pool.
+
+    Deliveries from one sender run strictly in order (the reference's
+    per-connection dispatch semantics), but a slow handler on one sender's
+    stream — e.g. a first-geometry FEC jit taking seconds — never blocks
+    delivery from other senders (the single-worker head-of-line blocking
+    flagged in round 1). Each key holds a bounded FIFO; one drain task per
+    key runs on the pool at a time.
+    """
+
+    def __init__(self, max_workers: int = 4, max_queue: int = 4096):
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="noise-ec-dispatch"
+        )
+        self._lock = threading.Lock()
+        self._queues: dict[bytes, deque] = {}
+        self._active: set[bytes] = set()
+        self.max_queue = max_queue
+        self.overflows = 0
+
+    def submit(self, key: bytes, fn, *args) -> bool:
+        """Enqueue ``fn(*args)`` on ``key``'s ordered stream. Returns False
+        (and counts an overflow) if the key's window is full."""
+        with self._lock:
+            q = self._queues.setdefault(key, deque())
+            if len(q) >= self.max_queue:
+                self.overflows += 1
+                return False
+            q.append((fn, args))
+            if key not in self._active:
+                self._active.add(key)
+                self._pool.submit(self._drain, key)
+        return True
+
+    # Items drained per pool turn: a continuously-busy sender yields the
+    # worker back to the pool every batch, so max_workers concurrent hot
+    # senders cannot starve everyone else's delivery.
+    DRAIN_BATCH = 16
+
+    def _drain(self, key: bytes) -> None:
+        for _ in range(self.DRAIN_BATCH):
+            with self._lock:
+                q = self._queues.get(key)
+                if not q:
+                    self._active.discard(key)
+                    self._queues.pop(key, None)
+                    return
+                fn, args = q.popleft()
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001 — handlers record their own errors
+                pass
+        # Batch exhausted with work remaining: requeue behind other senders.
+        self._pool.submit(self._drain, key)
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._pool.shutdown(wait=wait)
 
 
 @dataclass
@@ -280,7 +380,37 @@ class TCPNetwork:
         port: int = 3000,
         keys: Optional[KeyPair] = None,
         protocol: str = "tcp",
+        *,
+        connection_timeout: float = 60.0,
+        recv_window: int = 4096,
+        send_window: int = 4096,
+        write_buffer_size: int = 4096,
+        write_flush_latency: float = 0.050,
+        write_timeout: float = 3.0,
+        discovery: bool = True,
+        max_discovered_peers: int = 64,
     ):
+        """Tuning knobs default to the reference's builder options
+        (/root/reference/main.go:27-33): connection timeout 60s, recv/send
+        window 4096 messages, write buffer 4096 bytes, write-flush latency
+        50ms, write timeout 3s. Semantics here:
+
+        - ``connection_timeout`` bounds dial + nonce handshake;
+        - ``recv_window`` caps each sender's ordered dispatch queue;
+        - ``send_window`` caps coalesced-but-unflushed frames per peer
+          (overflow forces an immediate flush);
+        - ``write_buffer_size`` is the coalesce buffer: a pending batch at
+          or above this many bytes flushes without waiting for the timer;
+        - ``write_flush_latency`` is the coalescing timer for small writes;
+        - ``write_timeout`` bounds the post-flush drain; a peer that cannot
+          accept bytes for this long is disconnected.
+
+        ``discovery`` enables the peer-exchange gossip the reference gets
+        from noise's discovery plugin (main.go:151): on every registration
+        the node sends the newcomer its known peer addresses and announces
+        the newcomer to existing peers; learned addresses are dialed
+        (deduped, capped at ``max_discovered_peers``).
+        """
         if protocol != "tcp":
             raise ValueError(
                 f"protocol {protocol!r} not supported (the reference also "
@@ -291,6 +421,14 @@ class TCPNetwork:
         self.port = port
         self.id = PeerID.create(format_address(protocol, host, port), self.keys.public_key)
         self.plugins: list = []
+        self.connection_timeout = connection_timeout
+        self.recv_window = recv_window
+        self.send_window = send_window
+        self.write_buffer_size = write_buffer_size
+        self.write_flush_latency = write_flush_latency
+        self.write_timeout = write_timeout
+        self.discovery = discovery
+        self.max_discovered_peers = max_discovered_peers
         # Keyed by PUBLIC KEY, not the self-claimed address: an address is
         # just a claim inside a signed frame, so keying by it would let any
         # handshake-completing attacker evict a legitimate peer by claiming
@@ -309,10 +447,19 @@ class TCPNetwork:
         # Plugin dispatch (FEC decode; first-geometry jit compile can take
         # seconds on the device backend) must not run on the event-loop
         # thread, or every connection's read loop and handshake stalls
-        # behind it. One worker preserves per-node delivery order.
-        self._dispatch = ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="noise-ec-dispatch"
-        )
+        # behind it. Per-sender ordered queues on a shared pool: order is
+        # preserved within a sender, and one sender's slow decode cannot
+        # stall delivery from other peers.
+        self._dispatch = _SerialDispatcher(max_workers=4, max_queue=recv_window)
+        # Write coalescing state — touched only on the event-loop thread.
+        self._pending: dict[asyncio.StreamWriter, list[bytes]] = {}
+        self._pending_bytes: dict[asyncio.StreamWriter, int] = {}
+        self._flush_handles: dict[asyncio.StreamWriter, asyncio.TimerHandle] = {}
+        self._draining: set[asyncio.StreamWriter] = set()
+        # Discovery state: addresses we are responsible for dialing (dedup +
+        # budget). Entries are removed on dial failure and on disconnect of
+        # the dialed peer, so churned peers can be re-learned from gossip.
+        self._dialing: set[str] = set()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -339,7 +486,7 @@ class TCPNetwork:
                 continue
             fut = asyncio.run_coroutine_threadsafe(self._dial(addr), self._loop)
             try:
-                fut.result(timeout=15)
+                fut.result(timeout=self.connection_timeout + 5)
             except Exception as exc:  # noqa: BLE001
                 self._record_error(exc)
                 log.error("bootstrap %s failed: %s", addr, exc)
@@ -348,6 +495,11 @@ class TCPNetwork:
         async def _shutdown():
             if self._server is not None:
                 self._server.close()
+            for h in self._flush_handles.values():
+                h.cancel()
+            self._flush_handles.clear()
+            for w in list(self._pending):
+                self._flush_writer(w)  # best-effort final flush
             for peer in list(self.peers.values()):
                 peer.writer.close()
 
@@ -371,7 +523,7 @@ class TCPNetwork:
     def _frame(self, opcode: int, payload: bytes) -> bytes:
         addr = self.id.address.encode()
         sig = self.keys.sign(
-            self._sig, self._hash, bytes([opcode]) + addr + payload
+            self._sig, self._hash, _sign_preimage(opcode, addr, payload)
         )
         body = b"".join(
             [
@@ -399,19 +551,87 @@ class TCPNetwork:
         sig = body[pos : pos + 64]
         if len(pubkey) != 32 or len(payload) != plen or len(sig) != 64:
             raise WireError("truncated frame")
+        if pos + 64 != len(body):
+            # No unauthenticated trailing bytes: the signature must be the
+            # last 64 bytes of the body, exactly.
+            raise WireError("trailing bytes after frame signature")
         return opcode, PeerID.create(addr, pubkey), payload, sig
 
     # ------------------------------------------------------------ dataflow
 
     def broadcast(self, msg: Shard) -> None:
-        """Signed fan-out to every connected peer (main.go:206-208)."""
+        """Signed fan-out to every connected peer (main.go:206-208).
+
+        Frames ride the per-peer coalescing buffer: consecutive broadcasts
+        within ``write_flush_latency`` batch into one socket write (noise's
+        WriteFlushLatency semantics)."""
         frame = self._frame(_OP_SHARD, msg.marshal())
         with self._lock:
             writers = [p.writer for p in self.peers.values()]
         for w in writers:
-            self._loop.call_soon_threadsafe(self._write_safe, w, frame)
+            self._loop.call_soon_threadsafe(self._enqueue_frame, w, frame)
+
+    # -- write path (event-loop thread only) --
+
+    def _enqueue_frame(self, writer: asyncio.StreamWriter, frame: bytes) -> None:
+        """Coalesce ``frame`` into the peer's pending batch; flush when the
+        batch reaches ``write_buffer_size`` bytes or ``send_window`` frames,
+        otherwise after ``write_flush_latency``."""
+        if writer.transport.get_write_buffer_size() > self.MAX_PEER_WRITE_BUFFER:
+            self._drop_writer(writer)
+            self._record_error(
+                RuntimeError("peer write buffer exceeded cap; disconnected")
+            )
+            return
+        pend = self._pending.setdefault(writer, [])
+        pend.append(frame)
+        total = self._pending_bytes.get(writer, 0) + len(frame)
+        self._pending_bytes[writer] = total
+        if total >= self.write_buffer_size or len(pend) >= self.send_window:
+            self._flush_writer(writer)
+        elif writer not in self._flush_handles:
+            self._flush_handles[writer] = self._loop.call_later(
+                self.write_flush_latency, self._flush_writer, writer
+            )
+
+    def _flush_writer(self, writer: asyncio.StreamWriter) -> None:
+        handle = self._flush_handles.pop(writer, None)
+        if handle is not None:
+            handle.cancel()
+        pend = self._pending.pop(writer, None)
+        self._pending_bytes.pop(writer, None)
+        if not pend:
+            return
+        try:
+            writer.write(b"".join(pend))
+        except Exception as exc:  # noqa: BLE001
+            self._record_error(exc)
+            return
+        # Enforce write_timeout: a peer that cannot drain for that long is
+        # disconnected. One drain task per writer at a time (asyncio allows
+        # a single drain waiter).
+        if writer not in self._draining:
+            self._draining.add(writer)
+            task = self._loop.create_task(self._drain_writer(writer))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+
+    async def _drain_writer(self, writer: asyncio.StreamWriter) -> None:
+        try:
+            await asyncio.wait_for(writer.drain(), timeout=self.write_timeout)
+        except (asyncio.TimeoutError, TimeoutError):
+            self._record_error(
+                RuntimeError(f"write timeout ({self.write_timeout}s); disconnected")
+            )
+            self._drop_writer(writer)
+        except Exception as exc:  # noqa: BLE001
+            self._record_error(exc)
+            self._drop_writer(writer)
+        finally:
+            self._draining.discard(writer)
 
     def _write_safe(self, writer: asyncio.StreamWriter, frame: bytes) -> None:
+        """Immediate (uncoalesced) write — handshake/control frames."""
         if writer.transport.get_write_buffer_size() > self.MAX_PEER_WRITE_BUFFER:
             # A stalled reader must not grow sender memory without bound.
             self._drop_writer(writer)
@@ -429,14 +649,24 @@ class TCPNetwork:
             for key, p in list(self.peers.items()):
                 if p.writer is writer:
                     del self.peers[key]
+                    # Allow gossip to re-establish a churned peer.
+                    self._dialing.discard(p.pid.address)
+        handle = self._flush_handles.pop(writer, None)
+        if handle is not None:
+            handle.cancel()
+        self._pending.pop(writer, None)
+        self._pending_bytes.pop(writer, None)
         try:
             writer.close()
         except Exception:  # noqa: BLE001
             pass
 
     async def _dial(self, address: str) -> None:
+        self._dialing.add(address)
         host, port = self._split(address)
-        reader, writer = await asyncio.open_connection(host, port)
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), timeout=self.connection_timeout
+        )
         conn = _Conn()
         try:
             writer.write(self._frame(_OP_HELLO, conn.nonce))
@@ -446,10 +676,23 @@ class TCPNetwork:
             # Block until the HELLO_REPLY echoes our nonce and the peer is
             # registered; tear the connection down on timeout so a silent
             # acceptor does not leak a socket per bootstrap attempt.
-            await asyncio.wait_for(conn.registered.wait(), timeout=10)
+            await asyncio.wait_for(
+                conn.registered.wait(), timeout=self.connection_timeout
+            )
         except Exception:
             self._drop_writer(writer)
             raise
+
+    async def _dial_discovered(self, address: str) -> None:
+        """Dial an address learned from peer gossip (best-effort). A failed
+        dial refunds its budget and dedup slot so later gossip can retry
+        (a crashed-and-restarted peer must not stay partitioned forever)."""
+        try:
+            await self._dial(address)
+        except Exception as exc:  # noqa: BLE001
+            self._dialing.discard(address)
+            self._record_error(exc)
+            log.info("discovery dial %s failed: %s", address, exc)
 
     @staticmethod
     def _split(address: str) -> tuple[str, int]:
@@ -487,8 +730,35 @@ class TCPNetwork:
     def _register(self, pid: PeerID, writer: asyncio.StreamWriter, conn: _Conn) -> None:
         conn.peer = pid
         with self._lock:
+            others = [
+                p for key, p in self.peers.items() if key != pid.public_key
+            ]
+            prev = self.peers.get(pid.public_key)
             self.peers[pid.public_key] = _Peer(pid, writer)
+        if prev is not None and prev.writer is not writer:
+            # Simultaneous mutual dials (common under gossip) produce two
+            # connections per peer pair; keep the newest and close the old
+            # socket. Its read-loop teardown calls _drop_writer, which only
+            # removes entries whose writer matches — the new entry survives.
+            try:
+                prev.writer.close()
+            except Exception:  # noqa: BLE001
+                pass
         conn.registered.set()
+        if self.discovery and others:
+            # Peer exchange (the reference's discovery.Plugin, main.go:151):
+            # tell the newcomer who we know, and announce the newcomer to
+            # everyone else, so broadcast reach is transitive rather than
+            # limited to the bootstrap list.
+            self._write_safe(
+                writer,
+                self._frame(
+                    _OP_PEERS, _encode_peer_list([p.pid.address for p in others])
+                ),
+            )
+            announce = self._frame(_OP_PEERS, _encode_peer_list([pid.address]))
+            for p in others:
+                self._write_safe(p.writer, announce)
 
     def _on_frame(
         self, body: bytes, writer: asyncio.StreamWriter, conn: _Conn
@@ -501,7 +771,7 @@ class TCPNetwork:
         if not self._sig.verify(
             pid.public_key,
             self._hash.hash_bytes(
-                bytes([opcode]) + pid.address.encode() + payload
+                _sign_preimage(opcode, pid.address.encode(), payload)
             ),
             sig,
         ):
@@ -521,14 +791,47 @@ class TCPNetwork:
             if len(payload) != 2 * _NONCE_LEN or payload[:_NONCE_LEN] != conn.nonce:
                 self._record_error(WireError(f"stale HELLO_REPLY from {pid.address}"))
                 return
-            self._register(pid, writer, conn)
+            # ACK before registering: _register may immediately gossip a
+            # PEERS frame on this writer, and the acceptor must see our ACK
+            # (and register us) first — TCP preserves per-connection order.
             self._write_safe(writer, self._frame(_OP_HELLO_ACK, payload[_NONCE_LEN:]))
+            self._register(pid, writer, conn)
             return
         if opcode == _OP_HELLO_ACK:
             if payload != conn.nonce:
                 self._record_error(WireError(f"stale HELLO_ACK from {pid.address}"))
                 return
             self._register(pid, writer, conn)
+            return
+        if opcode == _OP_PEERS:
+            # Gossip is accepted only from registered peers (same gate as
+            # shards): an unauthenticated socket must not steer our dials.
+            if conn.peer is None or pid.public_key != conn.peer.public_key:
+                self._record_error(
+                    WireError(f"peer list from unregistered connection ({pid.address})")
+                )
+                return
+            if not self.discovery:
+                return
+            try:
+                addresses = _decode_peer_list(payload)
+            except (WireError, struct.error, UnicodeDecodeError) as exc:
+                self._record_error(WireError(f"bad peer list: {exc}"))
+                return
+            with self._lock:
+                known = {p.pid.address for p in self.peers.values()}
+            for addr in addresses:
+                if (
+                    addr == self.id.address
+                    or addr in known
+                    or addr in self._dialing
+                    or len(self._dialing) >= self.max_discovered_peers
+                ):
+                    continue
+                self._dialing.add(addr)
+                task = self._loop.create_task(self._dial_discovered(addr))
+                self._tasks.add(task)
+                task.add_done_callback(self._tasks.discard)
             return
         if opcode == _OP_SHARD:
             # Only registered connections may deliver shards, and the frame
@@ -544,7 +847,15 @@ class TCPNetwork:
                 self._record_error(exc)
                 return
             ctx = Ctx(msg, pid)
-            self._dispatch.submit(self._dispatch_plugins, ctx)
+            if not self._dispatch.submit(
+                pid.public_key, self._dispatch_plugins, ctx
+            ):
+                self._record_error(
+                    RuntimeError(
+                        f"recv window ({self.recv_window}) overflow from "
+                        f"{pid.address}; shard dropped"
+                    )
+                )
 
     def _dispatch_plugins(self, ctx: Ctx) -> None:
         for plugin in self.plugins:

@@ -169,6 +169,47 @@ def test_fec_decode_corrects_corruption(rng):
     assert got == data
 
 
+def test_fec_decode_paths_instrumented(rng):
+    """The common case (k distinct, or more that all agree) takes the
+    backend fast path (submatrix inverse x survivors — the main.go:77 hot
+    loop on the device codec); only inconsistent share sets drop to the
+    golden subset search (round-1 VERDICT item 4)."""
+    f = FEC(4, 6, backend="device")
+    data = bytes(rng.integers(0, 256, 64, dtype=np.uint8))
+    shares = f.encode_shares(data)
+    assert f.decode([shares[1], shares[3], shares[4], shares[5]]) == data
+    assert f.stats == {"fast_decodes": 1, "subset_decodes": 0}
+    assert f.decode(shares) == data  # > k consistent shares: still fast
+    assert f.stats == {"fast_decodes": 2, "subset_decodes": 0}
+    bad = Share(2, bytes([shares[2].data[0] ^ 0xFF]) + shares[2].data[1:])
+    got = f.decode([shares[0], shares[1], bad, shares[3], shares[4], shares[5]])
+    assert got == data
+    assert f.stats == {"fast_decodes": 2, "subset_decodes": 1}
+
+
+def test_plugin_receive_uses_device_decode(rng):
+    """Plugin round-trip on the device backend: the decode hot loop runs on
+    the device codec, not the golden subset search."""
+    from noise_ec_tpu.host.crypto import KeyPair, PeerID
+    from noise_ec_tpu.host.plugin import ShardPlugin
+    from noise_ec_tpu.host.transport import Ctx
+
+    keys = KeyPair.random()
+    pid = PeerID.create("tcp://localhost:4000", keys.public_key)
+    sender_plugin = ShardPlugin(backend="device")
+    shards = sender_plugin.prepare_shards(pid, keys, b"device decode!!!")
+    receiver = ShardPlugin(backend="device")
+    got = None
+    for s in shards:
+        out = receiver.receive(Ctx(s, pid))
+        if out is not None:
+            got = out
+    assert got == b"device decode!!!"
+    fec = receiver._fec(4, 6)
+    assert fec.stats["fast_decodes"] >= 1
+    assert fec.stats["subset_decodes"] == 0
+
+
 def test_fec_rebuild(rng):
     f = FEC(4, 6, backend="numpy")
     data = bytes(rng.integers(0, 256, 32, dtype=np.uint8))

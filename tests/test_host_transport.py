@@ -360,6 +360,177 @@ def test_stalled_peer_disconnected_on_buffer_cap():
     assert not w.frames  # nothing written past the cap
 
 
+def test_frame_malleability_rejected():
+    """Shifting bytes between the addr and payload fields (same
+    concatenation, different boundary) invalidates the signature: the
+    preimage is length-delimited (ADVICE round 1, finding 1)."""
+    import struct
+
+    from noise_ec_tpu.host.transport import _Conn
+
+    a, b = make_tcp(3913), make_tcp(3914)
+    payload = b"\x07" * 32
+    frame = b._frame(1, payload)[4:]
+    addr = b.id.address.encode()
+    # Rebuild the body moving the first payload byte into the addr field,
+    # keeping opcode ‖ addr ‖ payload concatenation (and the sig) identical.
+    sig = frame[-64:]
+    evil = b"".join(
+        [
+            frame[0:1],
+            struct.pack("<I", len(addr) + 1),
+            addr + payload[:1],
+            b.keys.public_key,
+            struct.pack("<I", len(payload) - 1),
+            payload[1:],
+            sig,
+        ]
+    )
+    w, conn = FakeWriter(), _Conn()
+    a._on_frame(evil, w, conn)
+    assert a.error_count == 1  # signature rejected
+    assert not w.frames
+
+
+def test_frame_trailing_bytes_rejected():
+    """Unauthenticated bytes after the 64-byte signature fail parsing
+    (ADVICE round 1, finding 2)."""
+    from noise_ec_tpu.host.transport import _Conn
+
+    a, b = make_tcp(3915), make_tcp(3916)
+    frame = b._frame(1, b"\x07" * 32)[4:]
+    w, conn = FakeWriter(), _Conn()
+    a._on_frame(frame + b"extra", w, conn)
+    assert a.error_count == 1
+    assert not w.frames
+
+
+def test_tuning_constant_defaults_match_reference():
+    """Constructor knobs default to the reference's builder options
+    (/root/reference/main.go:27-33)."""
+    net = make_tcp(3917)
+    assert net.connection_timeout == 60.0
+    assert net.recv_window == 4096
+    assert net.send_window == 4096
+    assert net.write_buffer_size == 4096
+    assert net.write_flush_latency == 0.050
+    assert net.write_timeout == 3.0
+
+
+def test_serial_dispatcher_no_cross_sender_blocking():
+    """A slow handler on sender A's stream does not delay sender B's
+    deliveries; per-sender order is preserved."""
+    import threading
+
+    from noise_ec_tpu.host.transport import _SerialDispatcher
+
+    d = _SerialDispatcher(max_workers=4)
+    release = threading.Event()
+    b_done = threading.Event()
+    order_a, order_b = [], []
+
+    def slow_a(i):
+        release.wait(timeout=10)
+        order_a.append(i)
+
+    def fast_b(i):
+        order_b.append(i)
+        if i == 9:
+            b_done.set()
+
+    for i in range(3):
+        d.submit(b"sender-a", slow_a, i)
+    for i in range(10):
+        d.submit(b"sender-b", fast_b, i)
+    # B's stream drains while A's first delivery is still blocked.
+    assert b_done.wait(timeout=10)
+    assert order_a == []
+    release.set()
+    d.shutdown(wait=True)
+    assert order_a == [0, 1, 2]  # per-sender order preserved
+    assert order_b == list(range(10))
+
+
+def test_serial_dispatcher_recv_window_overflow():
+    import threading
+
+    from noise_ec_tpu.host.transport import _SerialDispatcher
+
+    d = _SerialDispatcher(max_workers=1, max_queue=4)
+    release = threading.Event()
+    started = threading.Event()
+
+    def block():
+        started.set()
+        release.wait(10)
+
+    d.submit(b"k", block)
+    assert started.wait(10)  # the worker has POPPED the blocker: queue empty
+    accepted = sum(d.submit(b"k", lambda: None) for _ in range(10))
+    assert accepted == 4
+    assert d.overflows == 6
+    release.set()
+    d.shutdown(wait=True)
+
+
+def test_tcp_discovery_transitive_broadcast():
+    """C bootstraps only to B, yet receives A's broadcast: peer exchange
+    makes reach transitive (the reference's discovery.Plugin,
+    main.go:151)."""
+    nets, inboxes = [], []
+    try:
+        for _ in range(3):
+            inbox = []
+            net = TCPNetwork(host="127.0.0.1", port=0)
+            net.add_plugin(
+                ShardPlugin(backend="numpy",
+                            on_message=lambda m, s, inbox=inbox: inbox.append(m))
+            )
+            net.listen()
+            nets.append(net)
+            inboxes.append(inbox)
+        a, b, c = nets
+        a.bootstrap([b.id.address])   # A-B
+        c.bootstrap([b.id.address])   # C-B; C never dials A
+        deadline = time.time() + 10
+        while time.time() < deadline and (len(a.peers) < 2 or len(c.peers) < 2):
+            time.sleep(0.02)
+        assert len(a.peers) == 2, (a.errors, b.errors, c.errors)
+        assert len(c.peers) == 2, (a.errors, b.errors, c.errors)
+
+        a.plugins[0].shard_and_broadcast(a, b"transitive reach!")
+        deadline = time.time() + 10
+        while time.time() < deadline and not (inboxes[1] and inboxes[2]):
+            time.sleep(0.02)
+        assert inboxes[2] == [b"transitive reach!"], (c.errors,)
+        assert inboxes[1] == [b"transitive reach!"]
+    finally:
+        for net in nets:
+            net.close()
+
+
+def test_tcp_discovery_disabled_stays_bootstrap_only():
+    nets = []
+    try:
+        for _ in range(3):
+            net = TCPNetwork(host="127.0.0.1", port=0, discovery=False)
+            net.add_plugin(ShardPlugin(backend="numpy"))
+            net.listen()
+            nets.append(net)
+        a, b, c = nets
+        a.bootstrap([b.id.address])
+        c.bootstrap([b.id.address])
+        deadline = time.time() + 3
+        while time.time() < deadline and len(b.peers) < 2:
+            time.sleep(0.02)
+        assert len(b.peers) == 2
+        time.sleep(0.3)  # would be enough for gossip if it existed
+        assert len(a.peers) == 1 and len(c.peers) == 1
+    finally:
+        for net in nets:
+            net.close()
+
+
 def test_cli_parser_defaults():
     from noise_ec_tpu.host.cli import build_parser
 
