@@ -95,44 +95,41 @@ def _fused_words_fn(r: int, bits_rows: tuple, interpret: bool):
 
 
 WORD_QUANTUM = 1024  # uint32 words; 4096 bytes — pack-kernel grouping unit
+WORD_QUANTUM16 = 2048  # uint32 words; GF(2^16) groups 16 words x 128 lanes
 
 
 def pad_words(TW: int) -> int:
     return -(-TW // WORD_QUANTUM) * WORD_QUANTUM
 
 
+def pad_words16(TW: int) -> int:
+    return -(-TW // WORD_QUANTUM16) * WORD_QUANTUM16
+
+
 @functools.lru_cache(maxsize=256)
-def _fused_sparse_fn(
-    degree: int, r: int, S: int, bits_rows: tuple, interpret: bool
-):
-    """Compiled (k, S)-symbol shards -> (r, S) product stripes.
+def _fused_words16_fn(r: int, bits_rows: tuple, interpret: bool):
+    """GF(2^16) fused encode on uint32 WORDS: (k, TW) -> (r, TW).
 
-    GF(2^8) wraps ``_fused_words_fn`` in device-side u8 bitcasts — fine
-    under interpret/CPU tests; the TPU hot path enters at the words level
-    (``DeviceCodec.matmul_stripes`` / ``matmul_words``) to avoid the
-    relayout cost. GF(2^16) uses the jnp pack (16-register delta-swap
-    network is future work).
+    Each word holds two little-endian uint16 symbols; TW must be a multiple
+    of WORD_QUANTUM16 (callers pad; zero symbols are positionwise-inert).
+    Pipeline mirrors the GF(2^8) path with the 16x16 delta-swap network:
+    pack16 -> sparse GF(2) matmul on 16 planes/shard -> unpack16.
     """
-    if degree == 8:
-        from noise_ec_tpu.ops.pallas_pack import bytes_to_words, words_to_bytes
+    from noise_ec_tpu.ops.pallas_pack import (
+        pack_words16_pallas,
+        unpack_words16_pallas,
+    )
 
-        Sp = 4 * pad_words(-(-S // 4))
-        wf = _fused_words_fn(r, bits_rows, interpret)
-
-        def f(shards):
-            if Sp != S:
-                shards = jnp.pad(shards, ((0, 0), (0, Sp - S)))
-            sym = words_to_bytes(wf(bytes_to_words(shards)))
-            return sym[:, :S] if Sp != S else sym
-
-        return jax.jit(f)
-
-    def f(shards):
-        planes = pack_bitplanes_jax(shards, degree)
-        W = planes.shape[1]
-        tiled = planes_to_tiled(planes)
-        out = gf2_matmul_pallas_sparse_rows(bits_rows, tiled, interpret=interpret)
-        return unpack_bitplanes_jax(tiled_to_planes(out, W), r, S, degree)
+    def f(words):
+        k, TW = words.shape
+        planes = pack_words16_pallas(words, interpret=interpret)  # (k, 16, Wp)
+        Wp = planes.shape[2]
+        tiled = planes.reshape(k * 16, 8, Wp // 8)
+        out = gf2_matmul_pallas_sparse_rows(
+            bits_rows, tiled, interpret=interpret
+        )  # (r*16, 8, Wp/8)
+        planes_out = tiled_to_planes(out, Wp).reshape(r, 16, Wp)
+        return unpack_words16_pallas(planes_out, interpret=interpret)
 
     return jax.jit(f)
 
@@ -188,51 +185,46 @@ class DeviceCodec:
         if self.kernel == "xla":
             fn = _fused_xla_fn(m, r, k, S)
             out = fn(jnp.asarray(self.masks_for(M)), jnp.asarray(D))
-        elif m == 8:
-            # Host-side uint8 -> uint32 view (free when contiguous); the
+        else:
+            # Host-side symbol -> uint32 view (free when contiguous); the
             # device program runs entirely on words.
-            TW = -(-S // 4)
-            TWp = pad_words(TW)
-            if 4 * TWp != S:
-                buf = np.zeros((k, 4 * TWp), dtype=np.uint8)
+            sym_per_word = 4 if m == 8 else 2
+            quantize = pad_words if m == 8 else pad_words16
+            TWp = quantize(-(-S // sym_per_word))
+            if sym_per_word * TWp != S:
+                buf = np.zeros((k, sym_per_word * TWp), dtype=self.gf.dtype)
                 buf[:, :S] = D
             else:
                 buf = np.ascontiguousarray(D)
             words = buf.view("<u4")
-            fn = _fused_words_fn(
-                r, self.bits_rows_for(M), self.kernel == "pallas_interpret"
-            )
+            mk = _fused_words_fn if m == 8 else _fused_words16_fn
+            fn = mk(r, self.bits_rows_for(M), self.kernel == "pallas_interpret")
             # np.array: writable copy (np.asarray of a jax array is read-only
             # and callers are promised an ordinary ndarray).
             out_w = np.array(fn(jnp.asarray(words)))
-            return np.ascontiguousarray(out_w.view(np.uint8)[:, :S])
-        else:
-            fn = _fused_sparse_fn(
-                m, r, S, self.bits_rows_for(M), self.kernel == "pallas_interpret"
-            )
-            out = fn(jnp.asarray(D))
+            return np.ascontiguousarray(out_w.view(self.gf.dtype)[:, :S])
         # np.array (copy) so callers get an ordinary writable ndarray, not a
         # read-only view of the device buffer.
         return np.array(out)
 
     def matmul_words(self, M: np.ndarray, words: jnp.ndarray) -> jnp.ndarray:
-        """Device-resident GF(2^8) entry: (k, TW) uint32 -> (r, TW) uint32.
+        """Device-resident words entry: (k, TW) uint32 -> (r, TW) uint32.
 
-        The words ARE the shard bytes (little-endian u32 view). Any TW is
-        accepted: non-WORD_QUANTUM sizes are zero-padded on device and the
-        product sliced back (symbols are positionwise, so padding is inert;
-        under an enclosing jit the pad/slice fuse into the program). This is
-        the zero-relayout hot path used by bench and the parallel layer.
+        The words ARE the shard bytes (little-endian u32 view; 4 GF(2^8) or
+        2 GF(2^16) symbols per word). Any TW is accepted: non-quantum sizes
+        are zero-padded on device and the product sliced back (symbols are
+        positionwise, so padding is inert; under an enclosing jit the
+        pad/slice fuse into the program). This is the zero-relayout hot
+        path used by bench and the parallel layer.
         """
-        if self.gf.degree != 8:
-            raise ValueError("matmul_words is the GF(2^8) path")
         if self.kernel == "xla":
             raise ValueError("matmul_words requires a pallas kernel")
-        fn = _fused_words_fn(
+        mk = _fused_words_fn if self.gf.degree == 8 else _fused_words16_fn
+        fn = mk(
             M.shape[0], self.bits_rows_for(M), self.kernel == "pallas_interpret"
         )
         TW = words.shape[1]
-        TWp = pad_words(TW)
+        TWp = pad_words(TW) if self.gf.degree == 8 else pad_words16(TW)
         if TWp != TW:
             out = fn(jnp.pad(words, ((0, 0), (0, TWp - TW))))
             return out[:, :TW]

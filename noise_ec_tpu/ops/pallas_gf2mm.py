@@ -28,6 +28,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_TILE_WORDS = 512
 DEFAULT_TILE_LANES = 512
+# Scoped-VMEM ceiling for one grid step's buffers. The hardware limit is
+# 16 MiB; Pallas double-buffers grid inputs/outputs, so wide codes (e.g.
+# RS(50,20): 400 input + 160 output plane-rows) must shrink the lane tile
+# or the launch OOMs at compile time.
+VMEM_BUDGET_BYTES = 12 << 20
 
 
 def _kernel(maskT_ref, planes_ref, out_ref):
@@ -178,7 +183,10 @@ def gf2_matmul_pallas_sparse_rows(
     """
     C, sub, W8 = tiled_planes.shape
     assert sub == 8, tiled_planes.shape
-    TL = min(tile_lanes, max(128, -(-W8 // 128) * 128))
+    # Double-buffered in+out bytes per lane of tile; cap TL to the budget.
+    per_lane = (C + len(bits_rows)) * sub * 4 * 2
+    cap = max(128, VMEM_BUDGET_BYTES // per_lane // 128 * 128)
+    TL = min(tile_lanes, cap, max(128, -(-W8 // 128) * 128))
     W8p = -(-W8 // TL) * TL
     if W8p != W8:
         tiled_planes = jnp.pad(tiled_planes, ((0, 0), (0, 0), (0, W8p - W8)))

@@ -39,6 +39,18 @@ from jax.experimental.pallas import tpu as pltpu
 
 PACK_TILE_LANES = 512
 _ROUNDS = ((1, 0x55555555), (2, 0x33333333), (4, 0x0F0F0F0F))
+_ROUNDS16 = _ROUNDS + ((8, 0x00FF00FF),)
+
+
+def _delta_swap(V: jnp.ndarray, axis: int, rounds) -> jnp.ndarray:
+    idx = lax.broadcasted_iota(jnp.uint32, V.shape, axis)
+    for d, m in rounds:
+        s = jnp.roll(V, -d, axis=axis)
+        t = ((V >> jnp.uint32(d)) ^ s) & jnp.uint32(m)
+        lo = V ^ (t << jnp.uint32(d))
+        hi = V ^ jnp.roll(t, d, axis=axis)
+        V = jnp.where((idx & jnp.uint32(d)) == 0, lo, hi)
+    return V
 
 
 def delta_swap8(V: jnp.ndarray, axis: int) -> jnp.ndarray:
@@ -46,14 +58,19 @@ def delta_swap8(V: jnp.ndarray, axis: int) -> jnp.ndarray:
 
     Involution: applying twice returns the input.
     """
-    idx = lax.broadcasted_iota(jnp.uint32, V.shape, axis)
-    for d, m in _ROUNDS:
-        s = jnp.roll(V, -d, axis=axis)
-        t = ((V >> jnp.uint32(d)) ^ s) & jnp.uint32(m)
-        lo = V ^ (t << jnp.uint32(d))
-        hi = V ^ jnp.roll(t, d, axis=axis)
-        V = jnp.where((idx & jnp.uint32(d)) == 0, lo, hi)
-    return V
+    return _delta_swap(V, axis, _ROUNDS)
+
+
+def delta_swap16(V: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """16x16 bit transpose across the size-16 ``axis`` of uint32 words.
+
+    Each uint32 word holds two independent 16-bit columns (halves h = 0, 1):
+    out[i] bit (16h + j) == in[j] bit (16h + i). One extra delta-swap round
+    (d=8, mask 0x00FF00FF) on top of the 8x8 network; all exchanged bit
+    positions satisfy (p & d) == 0 so p+d never crosses a 16-bit half.
+    Involution.
+    """
+    return _delta_swap(V, axis, _ROUNDS16)
 
 
 def _pack_kernel(in_ref, out_ref):
@@ -98,12 +115,12 @@ def _unpack_call(r: int, G8: int, TL: int, interpret: bool):
     )
 
 
-def _tile_lanes(TW: int, tile_lanes: int) -> int:
-    TL = min(tile_lanes, max(128, TW // 8))
-    while TW % (8 * TL):
+def _tile_lanes(TW: int, tile_lanes: int, group: int = 8) -> int:
+    TL = min(tile_lanes, max(128, TW // group))
+    while TW % (group * TL):
         TL //= 2
         if TL < 128:
-            raise ValueError(f"word count {TW} not divisible by 8*128")
+            raise ValueError(f"word count {TW} not divisible by {group}*128")
     return TL
 
 
@@ -132,6 +149,96 @@ def unpack_words_pallas(planes: jnp.ndarray, *,
     G8 = TW // (8 * TL)
     out = _unpack_call(r, G8, TL, interpret)(planes)
     return out.reshape(r, TW)
+
+
+# ---------------------------------------------------------------------------
+# GF(2^16): 16-plane variant. A group is 16 words = 32 little-endian uint16
+# symbols; after the 16x16 transpose, sublane i holds bit i of all 32 symbols
+# (bit position 16h + w of plane word <-> symbol (w, half h) — a fixed
+# bijection, which is all the positionwise GF(2) matmul needs).
+
+
+def _pack16_kernel(in_ref, out_ref):
+    # in: (k, 1, 16, TL) word groups; out: (k, 16, TL) bit-planes.
+    out_ref[:, :, :] = delta_swap16(in_ref[:, 0, :, :], axis=1)
+
+
+def _unpack16_kernel(in_ref, out_ref):
+    # in: (r, 16, TL) bit-planes; out: (r, 1, 16, TL) word groups.
+    out_ref[:, 0, :, :] = delta_swap16(in_ref[:, :, :], axis=1)
+
+
+@functools.lru_cache(maxsize=256)
+def _pack16_call(k: int, G16: int, TL: int, interpret: bool):
+    return pl.pallas_call(
+        _pack16_kernel,
+        grid=(G16,),
+        in_specs=[
+            pl.BlockSpec((k, 1, 16, TL), lambda g: (0, g, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((k, 16, TL), lambda g: (0, 0, g),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((k, 16, G16 * TL), jnp.uint32),
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _unpack16_call(r: int, G16: int, TL: int, interpret: bool):
+    return pl.pallas_call(
+        _unpack16_kernel,
+        grid=(G16,),
+        in_specs=[
+            pl.BlockSpec((r, 16, TL), lambda g: (0, 0, g),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((r, 1, 16, TL), lambda g: (0, g, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((r, G16, 16, TL), jnp.uint32),
+        interpret=interpret,
+    )
+
+
+def pack_words16_pallas(xw: jnp.ndarray, *, tile_lanes: int = PACK_TILE_LANES,
+                        interpret: bool = False) -> jnp.ndarray:
+    """(k, TW) uint32 data words (2 uint16 symbols each) -> (k, 16, TW/16)
+    uint32 bit-planes.
+
+    Row [j, i] is bit-plane i of shard j; reshape to (k*16, TW/16) for the
+    GF(2) matmul. TW must be a multiple of 16*128 (wrappers pad).
+    """
+    k, TW = xw.shape
+    TL = _tile_lanes(TW, tile_lanes, group=16)
+    G16 = TW // (16 * TL)
+    grouped = xw.reshape(k, G16, 16, TL)
+    return _pack16_call(k, G16, TL, interpret)(grouped)
+
+
+def unpack_words16_pallas(planes: jnp.ndarray, *,
+                          tile_lanes: int = PACK_TILE_LANES,
+                          interpret: bool = False) -> jnp.ndarray:
+    """(r, 16, W) uint32 bit-planes -> (r, 16*W) uint32 words (pack
+    inverse)."""
+    r, sixteen, W = planes.shape
+    assert sixteen == 16, planes.shape
+    TW = 16 * W
+    TL = _tile_lanes(TW, tile_lanes, group=16)
+    G16 = TW // (16 * TL)
+    out = _unpack16_call(r, G16, TL, interpret)(planes)
+    return out.reshape(r, TW)
+
+
+def u16_to_words(x: jnp.ndarray) -> jnp.ndarray:
+    """(k, S) uint16 -> (k, S/2) uint32 (bitcast; S % 2 == 0)."""
+    k, S = x.shape
+    return lax.bitcast_convert_type(x.reshape(k, S // 2, 2), jnp.uint32)
+
+
+def words_to_u16(xw: jnp.ndarray) -> jnp.ndarray:
+    """(r, TW) uint32 -> (r, 2*TW) uint16 (bitcast inverse)."""
+    r, TW = xw.shape
+    return lax.bitcast_convert_type(xw, jnp.uint16).reshape(r, 2 * TW)
 
 
 def bytes_to_words(x: jnp.ndarray) -> jnp.ndarray:
