@@ -29,7 +29,7 @@ import numpy as np
 NORTH_STAR_GBPS = 40.0
 
 
-def chained_seconds_per_iter(make_encode, x, n_lo=10, n_hi=60, reps=3):
+def chained_seconds_per_iter(make_encode, x, n_lo=10, n_hi=60, reps=5):
     """Median slope timing of one fused encode, chained inside fori_loop."""
     import jax
     import jax.numpy as jnp
@@ -80,6 +80,18 @@ def main() -> None:
     stats = {"backend": backend, "kernel": dev.kernel, "data_bytes": data_bytes}
 
     if dev.kernel == "pallas":
+        # Correctness smoke BEFORE any timing: the bench must not be the
+        # first time a shape runs on real hardware — one small fused encode
+        # checked bit-exactly against the NumPy golden codec catches
+        # miscompiles that interpret-mode CI cannot.
+        from noise_ec_tpu.golden.codec import GoldenCodec
+
+        smoke = rng.integers(0, 256, size=(k, 8192)).astype(np.uint8)
+        got = dev.matmul_stripes(G[k:], smoke)
+        want = np.asarray(GoldenCodec(k, k + r).encode(smoke))
+        assert np.array_equal(got, want), "TPU fused encode != golden codec"
+        stats["tpu_smoke"] = "ok"
+
         words = jnp.asarray(
             rng.integers(0, 1 << 32, size=(k, TW), dtype=np.uint64).astype(np.uint32)
         )
@@ -99,7 +111,7 @@ def main() -> None:
             present = [i for i in range(k + r) if i not in erased][:k]
             R = reconstruction_matrix(gf, G, present, erased)
             t_rec = chained_seconds_per_iter(
-                lambda s, R=R: dev.matmul_words(R, s), surv, n_lo=5, n_hi=25
+                lambda s, R=R: dev.matmul_words(R, s), surv, n_lo=10, n_hi=60
             )
             stats[f"reconstruct{e}_1mib_p50_ms"] = round(t_rec * 1e3, 3)
 
@@ -112,34 +124,40 @@ def main() -> None:
                 rng.integers(0, 1 << 32, size=(k3, S3), dtype=np.uint64).astype(np.uint32)
             )
             t3 = chained_seconds_per_iter(
-                lambda s, M=G3[k3:]: dev.matmul_words(M, s), w3, n_lo=5, n_hi=25
+                lambda s, M=G3[k3:]: dev.matmul_words(M, s), w3, n_lo=10, n_hi=60
             )
             stats[f"rs{k3}_{r3}_encode_gbps"] = round(k3 * S3 * 4 / t3 / 1e9, 2)
 
         # --- config 4a: Cauchy vs PAR1-Vandermonde generator, RS(10,4).
         Gp = generator_matrix(gf, k, k + r, "par1")
         tp = chained_seconds_per_iter(
-            lambda s: dev.matmul_words(Gp[k:], s), words, n_lo=5, n_hi=25
+            lambda s: dev.matmul_words(Gp[k:], s), words, n_lo=10, n_hi=60
         )
         stats["rs10_4_par1_encode_gbps"] = round(data_bytes / tp / 1e9, 2)
 
         # --- config 4b: GF(2^16) field variant (16x16 bit-matrix per
-        # coefficient; u8-stripe entry, includes the device relayout).
+        # coefficient) on the 16-plane delta-swap Pallas pipeline,
+        # HBM-resident words like the headline config.
         try:
             from noise_ec_tpu.gf.field import GF65536
 
             gf16 = GF65536()
             G16 = generator_matrix(gf16, k, k + r, "cauchy")
-            dev16 = DeviceCodec(field="gf65536", kernel="xla")
-            S16 = 1 << 18  # symbols per stripe (512 KiB of u16 per shard)
-            st16 = rng.integers(0, 1 << 16, size=(k, S16)).astype(np.uint16)
-            dev16.matmul_stripes(G16[k:], st16)  # compile
-            t0 = time.perf_counter()
-            for _ in range(3):
-                dev16.matmul_stripes(G16[k:], st16)
-            t16 = (time.perf_counter() - t0) / 3
+            dev16 = DeviceCodec(field="gf65536", kernel="pallas")
+            smoke16 = rng.integers(0, 1 << 16, size=(k, 4096)).astype(np.uint16)
+            assert np.array_equal(
+                dev16.matmul_stripes(G16[k:], smoke16),
+                np.asarray(GoldenCodec(k, k + r, field="gf65536").encode(smoke16)),
+            ), "TPU GF(2^16) fused encode != golden codec"
+            TW16 = (1 << 20) // 4 * 8  # 8 x 1 MiB per shard, as words
+            w16 = jnp.asarray(
+                rng.integers(0, 1 << 32, size=(k, TW16), dtype=np.uint64).astype(np.uint32)
+            )
+            t16 = chained_seconds_per_iter(
+                lambda s: dev16.matmul_words(G16[k:], s), w16, n_lo=10, n_hi=60
+            )
             stats["rs10_4_gf65536_encode_gbps"] = round(
-                k * S16 * 2 / t16 / 1e9, 2
+                k * TW16 * 4 / t16 / 1e9, 2
             )
         except Exception as exc:  # noqa: BLE001 — secondary stat only
             stats["rs10_4_gf65536_error"] = str(exc)[:80]
@@ -154,16 +172,19 @@ def main() -> None:
             devs = jax.devices()
             mesh = make_mesh(("batch", "row"), (len(devs), 1), devs)
             bc = BatchCodec(k, r)
-            B, Sb = 8 * len(devs), 1 << 18
-            data_b = rng.integers(0, 256, size=(B, k, Sb)).astype(np.uint8)
-            enc_b = bc.make_sharded_encoder(mesh, row_axis="row")
-            xb = jnp.asarray(data_b)
-            jax.block_until_ready(enc_b(xb))  # compile
-            t0 = time.perf_counter()
-            for _ in range(3):
-                jax.block_until_ready(enc_b(xb))
-            tb = (time.perf_counter() - t0) / 3
-            stats["batch_mesh_encode_gbps"] = round(B * k * Sb / tb / 1e9, 2)
+            B, TWb = 8 * len(devs), (1 << 20) // 4  # 1 MiB per shard, words
+            wb = jnp.asarray(
+                rng.integers(0, 1 << 32, size=(B, k, TWb), dtype=np.uint64).astype(np.uint32)
+            )
+            enc_b = bc.make_sharded_encoder_words(mesh, row_axis="row")
+
+            def enc_chain(s):
+                # Pad parity rows (B, r, TW) up to (B, k, TW) so the timing
+                # chain's axis-0 XOR matches the input shape.
+                return jnp.pad(enc_b(s), ((0, 0), (0, k - r), (0, 0)))
+
+            tb = chained_seconds_per_iter(enc_chain, wb, n_lo=10, n_hi=60)
+            stats["batch_mesh_encode_gbps"] = round(B * k * TWb * 4 / tb / 1e9, 2)
             stats["batch_mesh_devices"] = len(devs)
         except Exception as exc:  # noqa: BLE001
             stats["batch_mesh_error"] = str(exc)[:80]

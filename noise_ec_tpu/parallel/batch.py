@@ -26,6 +26,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 try:  # JAX >= 0.4.35 exposes shard_map at top level
@@ -39,6 +40,7 @@ from noise_ec_tpu.matrix.generators import generator_matrix
 from noise_ec_tpu.matrix.linalg import reconstruction_matrix
 from noise_ec_tpu.ops.bitops import pack_bitplanes_jax, unpack_bitplanes_jax
 from noise_ec_tpu.ops.gf2mm import gf2_matmul_jax
+from noise_ec_tpu.ops.pallas_gf2mm import gf2_matmul_pallas
 
 _FIELDS = {"gf256": GF256, "gf65536": GF65536}
 
@@ -189,6 +191,108 @@ class BatchCodec:
         """Compiled batched parity encode over the mesh: (B,k,S) -> (B,r,S)."""
         return self.make_sharded_matmul(
             mesh, self.parity_matrix, batch_axis=batch_axis, row_axis=row_axis
+        )
+
+    # -- mesh-sharded words ops (the TPU hot path) -------------------------
+
+    def make_sharded_matmul_words(self, mesh: Mesh, M: np.ndarray, *,
+                                  batch_axis: str = "batch",
+                                  row_axis: Optional[str] = None,
+                                  kernel: str = "auto"):
+        """Compile (B, k, TW) uint32 words -> (B, R, TW) words over ``mesh``.
+
+        Words ARE the shard bytes (little-endian u32 view; 4 GF(2^8) or 2
+        GF(2^16) symbols per word) — the zero-relayout layout the Pallas
+        pipeline consumes; a host-side ``ndarray.view('<u4')`` is free.
+        Objects shard over ``batch_axis`` (DP). With ``row_axis``, rows of
+        ``M`` additionally shard over it (TP): the mask matrix rides as a
+        row-sharded *operand* (dense-mask kernel, one compiled program for
+        every device's slice) and row slices are assembled with an
+        all-gather over ICI. Unlike ``make_sharded_matmul`` this path runs
+        the delta-swap Pallas pack + matmul on TPU instead of the 32x
+        bitplane blow-up XLA pack.
+        """
+        from noise_ec_tpu.ops.dispatch import pad_words, pad_words16
+        from noise_ec_tpu.ops.pallas_pack import (
+            pack_words_pallas,
+            pack_words16_pallas,
+            unpack_words_pallas,
+            unpack_words16_pallas,
+        )
+
+        M = np.ascontiguousarray(np.asarray(M, dtype=self.gf.dtype))
+        m = self.gf.degree
+        masks = self._masks(M)  # (R*m, k*m)
+        if kernel == "auto":
+            kernel = "pallas" if jax.default_backend() == "tpu" else "xla"
+        interpret = kernel == "pallas_interpret"
+        quantize = pad_words if m == 8 else pad_words16
+        pack = pack_words_pallas if m == 8 else pack_words16_pallas
+        unpack = unpack_words_pallas if m == 8 else unpack_words16_pallas
+
+        if row_axis is not None:
+            rsz = mesh.shape[row_axis]
+            if M.shape[0] % rsz:
+                raise ValueError(
+                    f"matrix rows {M.shape[0]} not divisible by mesh axis "
+                    f"{row_axis!r} size {rsz}"
+                )
+            mask_spec = P(row_axis, None)
+        else:
+            mask_spec = P(None, None)
+
+        def local(masks_local, words_local):
+            Bl, k, TW = words_local.shape
+            # Fold batch into the lane axis (one transposing copy — cheap
+            # next to the 32x pack blow-up this path replaces).
+            folded = words_local.transpose(1, 0, 2).reshape(k, Bl * TW)
+            TWf = folded.shape[1]
+            TWp = quantize(TWf)
+            if TWp != TWf:
+                folded = jnp.pad(folded, ((0, 0), (0, TWp - TWf)))
+            Rl = masks_local.shape[0] // m
+            if kernel == "xla":
+                # Portable fallback: plane pack via masked shifts.
+                sym = lax.bitcast_convert_type(
+                    folded, jnp.uint8 if m == 8 else jnp.uint16
+                ).reshape(k, -1)
+                planes = pack_bitplanes_jax(sym, m)
+                out2d = gf2_matmul_jax(masks_local, planes)
+                sym_out = unpack_bitplanes_jax(out2d, Rl, sym.shape[1], m)
+                words_out = lax.bitcast_convert_type(
+                    sym_out.reshape(Rl, TWp, 4 // (m // 8)), jnp.uint32
+                )
+            else:
+                planes = pack(folded, interpret=interpret)  # (k, m, TWp/m)
+                planes2d = planes.reshape(k * m, TWp // m)
+                out2d = gf2_matmul_pallas(
+                    masks_local, planes2d, interpret=interpret
+                )
+                words_out = unpack(
+                    out2d.reshape(Rl, m, TWp // m), interpret=interpret
+                )
+            out = words_out[:, :TWf].reshape(Rl, Bl, TW).transpose(1, 0, 2)
+            if row_axis is not None:
+                # (Bl, R_local, TW) -> gather rows over ICI -> (Bl, R, TW)
+                out = jax.lax.all_gather(out, row_axis, axis=1, tiled=True)
+            return out
+
+        fn = _shard_map_compat(
+            local, mesh,
+            in_specs=(mask_spec, P(batch_axis, None, None)),
+            out_specs=P(batch_axis, None, None),
+        )
+        jfn = jax.jit(fn)
+        return functools.partial(jfn, jnp.asarray(masks))
+
+    def make_sharded_encoder_words(self, mesh: Mesh, *,
+                                   batch_axis: str = "batch",
+                                   row_axis: Optional[str] = None,
+                                   kernel: str = "auto"):
+        """Compiled batched parity encode on words: (B,k,TW) -> (B,r,TW)."""
+        return self.make_sharded_matmul_words(
+            mesh, self.parity_matrix, batch_axis=batch_axis,
+            row_axis=row_axis, kernel=kernel
         )
 
     @property
