@@ -1,0 +1,3 @@
+module rs_shim_example
+
+go 1.21

@@ -1,0 +1,76 @@
+// Minimal Go consumer of the rs_shim C ABI: proves the cgo boundary the
+// shim exists for (SURVEY.md §2.2/§7.1 — a Go noise plugin swapping
+// vivint/infectious, /root/reference/main.go:248-266, for this backend).
+//
+// Build & run (from this directory, with ../librs_shim.so built via
+// `make -C ..`):
+//
+//	CGO_ENABLED=1 go run .
+//
+// Expected output ends with "rs_shim cgo round-trip: OK".
+package main
+
+/*
+#cgo CFLAGS: -I..
+#cgo LDFLAGS: -L.. -lrs_shim -Wl,-rpath,${SRCDIR}/..
+#include <stdlib.h>
+#include "rs_shim.h"
+*/
+import "C"
+
+import (
+	"bytes"
+	"fmt"
+	"log"
+	"unsafe"
+)
+
+func main() {
+	fmt.Println(C.GoString(C.rs_shim_version()))
+
+	const (
+		k        = 4
+		r        = 2
+		shardLen = 1 << 10
+	)
+	enc := C.rs_encoder_new(k, r, 0 /* cauchy */)
+	if enc == nil {
+		log.Fatal("rs_encoder_new failed")
+	}
+	defer C.rs_encoder_free(enc)
+
+	// Contiguous (k+r) x shardLen buffer, data rows first.
+	shards := make([]byte, (k+r)*shardLen)
+	for i := 0; i < k*shardLen; i++ {
+		shards[i] = byte(i * 131)
+	}
+	p := (*C.uint8_t)(unsafe.Pointer(&shards[0]))
+
+	if rc := C.rs_encode(enc, p, shardLen); rc != 0 {
+		log.Fatalf("rs_encode rc=%d", rc)
+	}
+	if ok := C.rs_verify(enc, p, shardLen); ok != 1 {
+		log.Fatalf("rs_verify=%d, want 1", ok)
+	}
+
+	// Erase two rows (one data, one parity), reconstruct, compare.
+	want := append([]byte(nil), shards...)
+	present := make([]byte, k+r)
+	for i := range present {
+		present[i] = 1
+	}
+	for _, lost := range []int{1, k} {
+		present[lost] = 0
+		for b := 0; b < shardLen; b++ {
+			shards[lost*shardLen+b] = 0
+		}
+	}
+	pp := (*C.uint8_t)(unsafe.Pointer(&present[0]))
+	if rc := C.rs_reconstruct(enc, p, shardLen, pp, 0); rc != 0 {
+		log.Fatalf("rs_reconstruct rc=%d", rc)
+	}
+	if !bytes.Equal(shards, want) {
+		log.Fatal("reconstructed shards differ from originals")
+	}
+	fmt.Println("rs_shim cgo round-trip: OK")
+}

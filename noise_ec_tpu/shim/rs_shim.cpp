@@ -23,6 +23,8 @@
 #include <new>
 #include <vector>
 
+#include "rs_shim.h"  // keeps the exported ABI and the header in sync
+
 #if defined(__AVX2__) || defined(__SSSE3__)
 #include <immintrin.h>
 #endif

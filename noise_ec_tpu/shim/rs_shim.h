@@ -1,0 +1,55 @@
+/* rs_shim.h: C ABI of the native GF(2^8) Reed-Solomon erasure codec.
+ *
+ * The boundary a Go host cgo-links (see example/main.go) exactly where the
+ * reference links vivint/infectious (/root/reference/main.go:248-266), and
+ * the contract the Python ctypes binding (binding.py) consumes. Shaped
+ * after klauspost/reedsolomon's Encoder interface: Encode / Verify /
+ * Reconstruct over a contiguous (k + r) x shard_len buffer, data rows
+ * first.
+ *
+ * Bit-compatible with the TPU path: primitive polynomial 0x11D and the
+ * same systematic Cauchy / Vandermonde generators as
+ * noise_ec_tpu/{gf,matrix} — shards encoded here reconstruct there and
+ * vice versa.
+ */
+#ifndef NOISE_EC_TPU_RS_SHIM_H_
+#define NOISE_EC_TPU_RS_SHIM_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Version / field identification string (static storage, do not free). */
+const char* rs_shim_version(void);
+
+/* Create an encoder. matrix_kind: 0 = Cauchy (default), 1 = systematic
+ * Vandermonde. Returns NULL on invalid geometry (need k >= 1, r >= 0,
+ * k + r <= 256). */
+void* rs_encoder_new(int data_shards, int parity_shards, int matrix_kind);
+
+void rs_encoder_free(void* enc);
+
+/* shards: contiguous (k + r) x shard_len buffer, data rows first.
+ * Fills the parity rows from the data rows. Returns 0 on success. */
+int rs_encode(void* enc, uint8_t* shards, size_t shard_len);
+
+/* Returns 1 when the parity rows match the data rows, 0 on mismatch,
+ * < 0 on error. */
+int rs_verify(void* enc, const uint8_t* shards, size_t shard_len);
+
+/* present: k + r flags (nonzero = that shard row holds valid bytes).
+ * Missing rows of `shards` are overwritten with reconstructed bytes.
+ * data_only != 0 restores only the first k rows (ReconstructData).
+ * Returns 0 on success, -2 with fewer than k present shards, -3 on a
+ * singular submatrix. */
+int rs_reconstruct(void* enc, uint8_t* shards, size_t shard_len,
+                   const uint8_t* present, int data_only);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* NOISE_EC_TPU_RS_SHIM_H_ */

@@ -1,0 +1,77 @@
+"""C-ABI boundary test: compile a C consumer of rs_shim.h against the .so.
+
+The shim exists so an external (cgo-style) host can link it
+(SURVEY.md §2.2/§7.1); this proves that boundary with the toolchain the CI
+image has: a plain C program including ``rs_shim.h`` and dynamically
+linking ``librs_shim.so``, running the same encode -> verify -> erase ->
+reconstruct round-trip as ``shim/example/main.go``. Skips when no C
+compiler or prebuilt .so is available.
+"""
+
+import pathlib
+import shutil
+import subprocess
+
+import pytest
+
+SHIM_DIR = pathlib.Path(__file__).resolve().parent.parent / "noise_ec_tpu" / "shim"
+
+C_SRC = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "rs_shim.h"
+
+int main(void) {
+  enum { K = 4, R = 2, N = 6 };
+  const size_t len = 1024;
+  void* enc = rs_encoder_new(K, R, 0);
+  if (!enc) { fprintf(stderr, "new failed\n"); return 1; }
+
+  uint8_t* shards = calloc(N, len);
+  uint8_t* want = malloc(N * len);
+  for (size_t i = 0; i < K * len; ++i) shards[i] = (uint8_t)(i * 131u);
+
+  if (rs_encode(enc, shards, len) != 0) return 2;
+  if (rs_verify(enc, shards, len) != 1) return 3;
+  memcpy(want, shards, N * len);
+
+  uint8_t present[N] = {1, 0, 1, 1, 0, 1}; /* lose data row 1, parity row 4 */
+  memset(shards + 1 * len, 0, len);
+  memset(shards + 4 * len, 0, len);
+  if (rs_reconstruct(enc, shards, len, present, 0) != 0) return 4;
+  if (memcmp(shards, want, N * len) != 0) return 5;
+
+  rs_encoder_free(enc);
+  puts(rs_shim_version());
+  puts("c-abi round-trip: OK");
+  return 0;
+}
+"""
+
+
+@pytest.mark.skipif(
+    shutil.which("cc") is None and shutil.which("gcc") is None,
+    reason="no C compiler",
+)
+def test_c_consumer_links_and_round_trips(tmp_path):
+    so = SHIM_DIR / "librs_shim.so"
+    if not so.exists():
+        try:
+            subprocess.run(["make", "-C", str(SHIM_DIR)], check=True,
+                           capture_output=True, timeout=120)
+        except (subprocess.CalledProcessError, OSError) as exc:
+            pytest.skip(f"cannot build librs_shim.so: {exc}")
+    src = tmp_path / "consumer.c"
+    src.write_text(C_SRC)
+    exe = tmp_path / "consumer"
+    cc = shutil.which("cc") or shutil.which("gcc")
+    subprocess.run(
+        [cc, str(src), "-I", str(SHIM_DIR), "-L", str(SHIM_DIR),
+         "-lrs_shim", f"-Wl,-rpath,{SHIM_DIR}", "-o", str(exe)],
+        check=True, capture_output=True, timeout=120,
+    )
+    out = subprocess.run([str(exe)], check=True, capture_output=True,
+                         timeout=60, text=True)
+    assert "c-abi round-trip: OK" in out.stdout
+    assert "gf256" in out.stdout  # version string identifies the field
