@@ -411,11 +411,12 @@ class TCPNetwork:
         the newcomer to existing peers; learned addresses are dialed
         (deduped, capped at ``max_discovered_peers``).
         """
-        if protocol != "tcp":
+        if protocol not in ("tcp", "kcp"):
             raise ValueError(
-                f"protocol {protocol!r} not supported (the reference also "
-                "offers kcp; only tcp is implemented here)"
+                f"protocol {protocol!r} not supported (tcp or kcp, the "
+                "reference's option set — main.go:123)"
             )
+        self.protocol = protocol
         self.keys = keys or KeyPair.random()
         self.host = host
         self.port = port
@@ -471,10 +472,15 @@ class TCPNetwork:
         addr = self._server.sockets[0].getsockname()
         self.port = addr[1]
         self.id = PeerID.create(
-            format_address("tcp", self.host, self.port), self.keys.public_key
+            format_address(self.protocol, self.host, self.port),
+            self.keys.public_key,
         )
 
-    async def _start_server(self) -> asyncio.AbstractServer:
+    async def _start_server(self):
+        if self.protocol == "kcp":
+            from noise_ec_tpu.host.kcp import start_kcp_server
+
+            return await start_kcp_server(self._handle_conn, self.host, self.port)
         return await asyncio.start_server(self._handle_conn, self.host, self.port)
 
     def bootstrap(self, peer_addresses: list[str]) -> None:
@@ -664,8 +670,16 @@ class TCPNetwork:
     async def _dial(self, address: str) -> None:
         self._dialing.add(address)
         host, port = self._split(address)
+        if address.startswith("kcp://") or (
+            "://" not in address and self.protocol == "kcp"
+        ):
+            from noise_ec_tpu.host.kcp import open_kcp_connection as opener
+        else:
+            opener = asyncio.open_connection
+        # (For kcp the opener returns without any network round trip; the
+        # real unreachable-peer bound is conn.registered.wait below.)
         reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(host, port), timeout=self.connection_timeout
+            opener(host, port), timeout=self.connection_timeout
         )
         conn = _Conn()
         try:

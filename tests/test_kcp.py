@@ -1,0 +1,236 @@
+"""Reliable-UDP (kcp protocol option) tests: ARQ core under loss, and the
+full signed-handshake network stack over real UDP sockets."""
+
+import asyncio
+import struct
+import time
+
+import numpy as np
+
+from noise_ec_tpu.host.kcp import _HDR, KcpSession, KcpWriter
+from noise_ec_tpu.host.plugin import ShardPlugin
+from noise_ec_tpu.host.transport import TCPNetwork
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def _pair(loss_seed=None, drop=0.0, reorder=0.0):
+    """Two sessions wired back-to-back through a deterministic lossy link.
+
+    Returns (a, b, pump) where pump() delivers queued datagrams applying
+    drops/reorders from the seeded rng.
+    """
+    rng = np.random.default_rng(loss_seed)
+    queues = {"a": [], "b": []}  # datagrams TO that side
+
+    loop = asyncio.get_running_loop()
+    a = KcpSession(7, None, lambda d, _: queues["b"].append(d), loop)
+    b = KcpSession(7, None, lambda d, _: queues["a"].append(d), loop)
+
+    def pump():
+        for side, sess in (("a", a), ("b", b)):
+            pending, queues[side] = queues[side], []
+            if reorder and len(pending) > 1 and rng.random() < reorder:
+                rng.shuffle(pending)
+            for dgram in pending:
+                if drop and rng.random() < drop:
+                    continue
+                sess.input(dgram)
+
+    return a, b, pump
+
+
+def test_arq_lossless_roundtrip():
+    async def go():
+        a, b, pump = _pair()
+        payload = bytes(range(256)) * 300  # ~77 KB, crosses many segments
+        a.write(payload)
+        a.flush_partial()
+        for _ in range(200):
+            pump()
+            await asyncio.sleep(0)
+            if b.reader._buffer and len(b.reader._buffer) >= len(payload):
+                break
+        got = await asyncio.wait_for(b.reader.readexactly(len(payload)), 5)
+        assert got == payload
+        a.close(); b.close()
+
+    _run(go())
+
+
+def test_arq_survives_drop_and_reorder():
+    async def go():
+        a, b, pump = _pair(loss_seed=3, drop=0.25, reorder=0.5)
+        payload = np.random.default_rng(0).integers(
+            0, 256, 40_000).astype(np.uint8).tobytes()
+        a.write(payload)
+        a.flush_partial()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            pump()
+            await asyncio.sleep(0.005)  # let RTO timers fire
+            if len(b.reader._buffer) >= len(payload):
+                break
+        got = await asyncio.wait_for(b.reader.readexactly(len(payload)), 5)
+        assert got == payload
+        assert not a.closed and not b.closed
+        a.close(); b.close()
+
+    _run(go())
+
+
+def test_arq_dead_link_closes_with_error():
+    async def go():
+        loop = asyncio.get_running_loop()
+        sent = []
+        a = KcpSession(1, None, lambda d, _: sent.append(d), loop)
+        a._rto = 0.001  # fail fast: every RTO fires on the next update tick
+        a.write(b"x" * 100)
+        a.flush_partial()
+        deadline = time.monotonic() + 20
+        while not a.closed and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        assert a.closed
+        try:
+            await a.reader.readexactly(1)
+            raise AssertionError("expected ConnectionError")
+        except ConnectionError:
+            pass
+
+    _run(go())
+
+
+def test_arq_duplicate_push_acked_once_delivered_once():
+    async def go():
+        a, b, pump = _pair()
+        a.write(b"y" * 10)
+        a.flush_partial()
+        pump()
+        # replay the same PUSH at b: must re-ack but not re-deliver
+        dgram = _HDR.pack(7, 1, 0, 0, 10) + b"y" * 10
+        b.input(dgram)
+        await asyncio.sleep(0)
+        got = await asyncio.wait_for(b.reader.readexactly(10), 5)
+        assert got == b"y" * 10
+        assert len(b.reader._buffer) == 0  # no duplicate delivery
+        a.close(); b.close()
+
+    _run(go())
+
+
+def test_arq_beyond_window_push_not_acked():
+    """A PUSH beyond the reorder window must NOT be acked (acking would pop
+    it from the sender's flight buffer and lose the bytes forever)."""
+    async def go():
+        loop = asyncio.get_running_loop()
+        sent = []
+        b = KcpSession(9, None, lambda d, _: sent.append(d), loop)
+        from noise_ec_tpu.host.kcp import RCV_BUF_CAP
+        far = RCV_BUF_CAP + 10
+        b.input(_HDR.pack(9, 1, far, 0, 2) + b"zz")
+        assert sent == []  # dropped silently: sender will retransmit
+        b.input(_HDR.pack(9, 1, 0, 0, 2) + b"ok")  # in-window: acked
+        assert len(sent) == 1 and sent[0][4] == 2  # one ACK datagram
+        b.close()
+
+    _run(go())
+
+
+def test_arq_graceful_close_delivers_queued_tail():
+    """writer.close() right after a burst larger than the in-flight window:
+    the FIN covers queued segments and the tail still delivers."""
+    async def go():
+        from noise_ec_tpu.host.kcp import MSS, SND_WND
+        a, b, pump = _pair()
+        payload = np.random.default_rng(1).integers(
+            0, 256, (SND_WND + 50) * MSS).astype(np.uint8).tobytes()
+        w = KcpWriter(a)
+        w.write(payload)
+        w.close()  # FIN queued behind ~50 windows' worth of unsent segments
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            pump()
+            await asyncio.sleep(0.005)
+            if len(b.reader._buffer) >= len(payload) or b.closed:
+                break
+        got = await asyncio.wait_for(b.reader.readexactly(len(payload)), 5)
+        assert got == payload
+        tail = await asyncio.wait_for(b.reader.read(), 5)
+        assert tail == b""  # clean EOF after the FIN point
+
+    _run(go())
+
+
+def test_endpoint_ignores_stray_midstream_push_and_tombstones():
+    """Mid-stream retransmissions for a dead session must not resurrect a
+    zombie session; a closed (addr, conv) is tombstoned."""
+    async def go():
+        from noise_ec_tpu.host.kcp import _Endpoint
+        loop = asyncio.get_running_loop()
+        accepted = []
+
+        async def on_accept(reader, writer):
+            accepted.append((reader, writer))
+
+        ep = _Endpoint(loop, on_accept=on_accept)
+
+        class FakeTransport:
+            def is_closing(self): return False
+            def sendto(self, d, a): pass
+            def close(self): pass
+
+        ep.connection_made(FakeTransport())
+        addr = ("127.0.0.1", 9999)
+        ep.datagram_received(_HDR.pack(5, 1, 7, 0, 1) + b"x", addr)  # sn=7
+        assert ep.sessions == {} and accepted == []
+        ep.datagram_received(_HDR.pack(5, 1, 0, 0, 1) + b"x", addr)  # sn=0
+        await asyncio.sleep(0)
+        assert len(ep.sessions) == 1 and len(accepted) == 1
+        sess = next(iter(ep.sessions.values()))
+        sess.close()
+        assert ep.sessions == {}
+        ep.datagram_received(_HDR.pack(5, 1, 0, 0, 1) + b"x", addr)
+        await asyncio.sleep(0)
+        assert ep.sessions == {} and len(accepted) == 1  # tombstoned
+        ep.close()
+
+    _run(go())
+
+
+def test_kcp_two_node_end_to_end():
+    """The reference's -protocol kcp option: full signed handshake +
+    discovery + shard broadcast over real UDP sockets."""
+    inbox_a, inbox_b = [], []
+    a = TCPNetwork(host="127.0.0.1", port=0, protocol="kcp")
+    a.add_plugin(ShardPlugin(backend="numpy",
+                             on_message=lambda m, s: inbox_a.append(m)))
+    a.listen()
+    b = TCPNetwork(host="127.0.0.1", port=0, protocol="kcp")
+    b.add_plugin(ShardPlugin(backend="numpy",
+                             on_message=lambda m, s: inbox_b.append(m)))
+    b.listen()
+    try:
+        assert a.id.address.startswith("kcp://")
+        b.bootstrap([a.id.address])
+        deadline = time.time() + 10
+        while time.time() < deadline and (not b.peers or not a.peers):
+            time.sleep(0.02)
+        assert b.peers and a.peers, (a.errors, b.errors)
+
+        payload = b"kcp end to end!!"  # 16 bytes, k=4
+        b.plugins[0].shard_and_broadcast(b, payload)
+        deadline = time.time() + 10
+        while time.time() < deadline and not inbox_a:
+            time.sleep(0.02)
+        assert inbox_a == [payload], (a.errors, b.errors)
+
+        a.plugins[0].shard_and_broadcast(a, b"reply over udp!!")
+        deadline = time.time() + 10
+        while time.time() < deadline and not inbox_b:
+            time.sleep(0.02)
+        assert inbox_b == [b"reply over udp!!"], (a.errors, b.errors)
+    finally:
+        a.close()
+        b.close()
