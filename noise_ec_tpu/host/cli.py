@@ -21,6 +21,7 @@ from noise_ec_tpu.host.crypto import KeyPair, PeerID
 from noise_ec_tpu.host.plugin import ShardPlugin
 from noise_ec_tpu.host.transport import TCPNetwork
 from noise_ec_tpu.utils.logging import setup_logging
+from noise_ec_tpu.utils.profiling import device_trace, kernel_counters
 
 log = logging.getLogger("noise_ec_tpu.host.cli")
 
@@ -33,13 +34,23 @@ def build_parser() -> argparse.ArgumentParser:
     # single-dash long flags, like Go's flag package (main.go:121-124)
     p.add_argument("-port", type=int, default=3000, help="port to listen on")
     p.add_argument("-host", default="localhost", help="host to listen on")
-    p.add_argument("-protocol", default="tcp", help="protocol to use (tcp)")
+    p.add_argument(
+        "-protocol", default="tcp",
+        help="protocol to use: tcp or kcp (reliable UDP), main.go:123",
+    )
     p.add_argument("-peers", default="", help="comma-separated peer addresses")
     p.add_argument(
         "-backend",
         default="device",
         choices=["device", "numpy"],
         help="codec backend: device (TPU/JAX) or numpy (host)",
+    )
+    p.add_argument(
+        "-trace",
+        default="",
+        metavar="LOGDIR",
+        help="capture a JAX/XLA profiler trace of the session into LOGDIR "
+        "(view with tensorboard's profile plugin)",
     )
     return p
 
@@ -69,16 +80,21 @@ def main(argv: list[str] | None = None) -> int:
         net.bootstrap(peers)
 
     try:
-        for line in sys.stdin:  # blocking REPL, main.go:175-198
-            input_bytes = line.rstrip("\n").encode()
-            if not input_bytes:
-                continue  # skip blank lines, main.go:179-181
-            log.info("broadcasting message: %s", input_bytes.hex())
-            plugin.shard_and_broadcast(net, input_bytes)
+        with device_trace(args.trace):
+            for line in sys.stdin:  # blocking REPL, main.go:175-198
+                input_bytes = line.rstrip("\n").encode()
+                if not input_bytes:
+                    continue  # skip blank lines, main.go:179-181
+                log.info("broadcasting message: %s", input_bytes.hex())
+                plugin.shard_and_broadcast(net, input_bytes)
     except KeyboardInterrupt:
         pass
     finally:
         net.close()
+        stats = plugin.counters.snapshot()
+        stats.update(kernel_counters.snapshot())
+        if stats:
+            log.info("session stats: %s", stats)
     return 0
 
 

@@ -34,7 +34,7 @@ from noise_ec_tpu.host.crypto import (
 )
 from noise_ec_tpu.host.mempool import PoolLimitError, PoolTooLargeError, ShardPool
 from noise_ec_tpu.host.wire import Shard
-from noise_ec_tpu.utils.metrics import Counters
+from noise_ec_tpu.utils.metrics import Counters, Timer
 
 __all__ = [
     "ShardPlugin",
@@ -347,7 +347,9 @@ class ShardPlugin:
         # CASE C: enough distinct shares — decode + verify (main.go:72-99).
         fec = self._fec(k, n)
         try:
-            complete = fec.decode(snapshot)
+            with Timer(self.counters, "decode_s",
+                       nbytes=sum(len(s.data) for s in snapshot)):
+                complete = fec.decode(snapshot)
         except Exception as exc:
             # The reference logs decode errors and falls through to a
             # doomed Verify on nil (main.go:75-80, quirk 5); we log and

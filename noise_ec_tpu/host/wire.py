@@ -122,6 +122,29 @@ class Shard:
     total_shards: int = 0
     minimum_needed_shards: int = 0
 
+    def __str__(self) -> str:
+        """Log-friendly one-liner (the gogoproto String(), SURVEY.md C20):
+        byte fields as truncated hex, varints verbatim."""
+        sig = self.file_signature.hex()
+        data = self.shard_data
+        body = data[:16].hex() + ("…" if len(data) > 16 else "")
+        return (
+            f"shard {self.shard_number}/{self.total_shards}"
+            f"(min {self.minimum_needed_shards}) "
+            f"sig={sig[:16]}… data[{len(data)}]={body}"
+        )
+
+    def gostring(self) -> str:
+        """Evaluable constructor expression (the gogoproto GoString(),
+        SURVEY.md C20) — ``eval(s.gostring())`` reproduces the shard."""
+        return (
+            f"Shard(file_signature={self.file_signature!r}, "
+            f"shard_data={self.shard_data!r}, "
+            f"shard_number={self.shard_number!r}, "
+            f"total_shards={self.total_shards!r}, "
+            f"minimum_needed_shards={self.minimum_needed_shards!r})"
+        )
+
     def marshal(self) -> bytes:
         out = bytearray()
         if self.file_signature:

@@ -37,6 +37,7 @@ from noise_ec_tpu.ops.pallas_gf2mm import (
     planes_to_tiled,
     tiled_to_planes,
 )
+from noise_ec_tpu.utils.profiling import record_kernel
 
 _FIELDS = {"gf256": GF256, "gf65536": GF65536}
 
@@ -182,6 +183,7 @@ class DeviceCodec:
             raise ValueError(f"matrix cols {k} != stripe rows {D.shape[0]}")
         S = D.shape[1]
         m = self.gf.degree
+        record_kernel(f"matmul_stripes_{self.kernel}", D.nbytes)
         if self.kernel == "xla":
             fn = _fused_xla_fn(m, r, k, S)
             out = fn(jnp.asarray(self.masks_for(M)), jnp.asarray(D))
@@ -219,6 +221,7 @@ class DeviceCodec:
         """
         if self.kernel == "xla":
             raise ValueError("matmul_words requires a pallas kernel")
+        record_kernel("matmul_words", 4 * words.shape[0] * words.shape[1])
         mk = _fused_words_fn if self.gf.degree == 8 else _fused_words16_fn
         fn = mk(
             M.shape[0], self.bits_rows_for(M), self.kernel == "pallas_interpret"

@@ -1,6 +1,20 @@
-"""Shared host utilities: metrics counters and logging setup."""
+"""Shared host utilities: metrics counters, profiling, logging setup."""
 
-from noise_ec_tpu.utils.metrics import Counters, Timer
 from noise_ec_tpu.utils.logging import setup_logging
+from noise_ec_tpu.utils.metrics import Counters, Timer
+from noise_ec_tpu.utils.profiling import (
+    device_trace,
+    kernel_counters,
+    kernel_gbps,
+    timed_window,
+)
 
-__all__ = ["Counters", "Timer", "setup_logging"]
+__all__ = [
+    "Counters",
+    "Timer",
+    "device_trace",
+    "kernel_counters",
+    "kernel_gbps",
+    "setup_logging",
+    "timed_window",
+]

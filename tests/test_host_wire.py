@@ -149,3 +149,21 @@ def test_interop_with_protobuf_runtime():
         assert theirs.total_shards == ours.total_shards
         assert theirs.minimum_needed_shards == ours.minimum_needed_shards
         assert Shard.unmarshal(theirs.SerializeToString()) == ours
+
+
+def test_shard_str_stringer():
+    """C20 String() analogue: compact, log-friendly, mentions geometry."""
+    s = Shard(file_signature=b"\xaa" * 64, shard_data=b"\x01\x02" * 20,
+              shard_number=2, total_shards=6, minimum_needed_shards=4)
+    text = str(s)
+    assert "2/6" in text and "min 4" in text
+    assert "aaaaaaaa" in text  # hex of the signature prefix
+    assert "data[40]" in text
+
+
+def test_shard_gostring_evaluates_back():
+    """C20 GoString() analogue: eval of the output reproduces the value
+    (the property shardpb_test.go:154-166 asserts via go/parser)."""
+    s = Shard(file_signature=b"sig", shard_data=b"\x00\xffdata",
+              shard_number=3, total_shards=7, minimum_needed_shards=5)
+    assert eval(s.gostring(), {"Shard": Shard}) == s
