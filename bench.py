@@ -9,7 +9,8 @@ host-side metadata — see ops/dispatch.py on the u8 relayout cost).
 Timing: the axon tunnel adds multi-ms RPC jitter and block_until_ready does
 not reflect device completion, so each sample runs N dependent encodes
 inside one jitted fori_loop (data-chained so they serialize) and the
-per-encode time is the slope between N=10 and N=60 runs.
+per-encode time is the slope between a small-N and a payload-size-adaptive
+large-N run (window sized to ~25 ms so jitter cannot flip the slope).
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": "GB/s", "vs_baseline": ...}
@@ -29,18 +30,32 @@ import numpy as np
 NORTH_STAR_GBPS = 40.0
 
 
-def chained_seconds_per_iter(make_encode, x, n_lo=10, n_hi=60, reps=5):
-    """Median slope timing of one fused encode, chained inside fori_loop."""
+def chained_seconds_per_iter(make_encode, x, n_lo=10, n_hi=None, reps=5):
+    """Median slope timing of one fused encode, chained inside fori_loop.
+
+    The chain XORs 128 words of the output back into the input: iteration
+    i+1's input depends on iteration i's output, so the encodes serialize
+    (the pallas program is opaque — XLA must run it fully), while the
+    chain itself adds negligible traffic. This measures encode alone, the
+    same contract klauspost's Encode() benchmarks time.
+
+    n_hi is sized so the measured window is ~25 ms assuming ~250 GB/s —
+    multi-ms RPC jitter on the axon tunnel otherwise swamps fast configs
+    (small payloads ran "negative" slopes with a fixed n_hi).
+    """
     import jax
-    import jax.numpy as jnp
     from jax import lax
+
+    if n_hi is None:
+        n_hi = n_lo + max(50, min(2000, int(0.025 * 250e9 / max(x.nbytes, 1))))
 
     def mk(N):
         @jax.jit
         def run(s):
             def body(i, s):
-                p = make_encode(s)
-                return s.at[: p.shape[0]].set(s[: p.shape[0]] ^ p)
+                p = make_encode(s).reshape(-1)[:128]
+                idx = (0,) * (s.ndim - 1) + (slice(0, 128),)
+                return s.at[idx].set(s[idx] ^ p)
             return lax.fori_loop(0, N, body, s).sum()
         return run
 
@@ -111,27 +126,35 @@ def main() -> None:
             present = [i for i in range(k + r) if i not in erased][:k]
             R = reconstruction_matrix(gf, G, present, erased)
             t_rec = chained_seconds_per_iter(
-                lambda s, R=R: dev.matmul_words(R, s), surv, n_lo=10, n_hi=60
+                lambda s, R=R: dev.matmul_words(R, s), surv
             )
             stats[f"reconstruct{e}_1mib_p50_ms"] = round(t_rec * 1e3, 3)
 
         # --- config 3: high-rate RS(17,3) and wide RS(50,20) streaming
-        # encode (HBM-resident chunked stream, stripe axis folded).
+        # encode (HBM-resident chunked stream, stripe axis folded). Each
+        # geometry gets its own correctness smoke: wide codes exercise
+        # different kernel tile brackets than RS(10,4) (a pack/unpack tile
+        # mismatch once corrupted exactly these shapes).
         for (k3, r3) in ((17, 3), (50, 20)):
             G3 = generator_matrix(gf, k3, k3 + r3, "cauchy")
+            sm3 = rng.integers(0, 256, size=(k3, 8192)).astype(np.uint8)
+            assert np.array_equal(
+                dev.matmul_stripes(G3[k3:], sm3),
+                np.asarray(GoldenCodec(k3, k3 + r3).encode(sm3)),
+            ), f"TPU RS({k3},{r3}) encode != golden codec"
             S3 = ((8 << 20) // k3 // 2048) * 2048 // 4  # ~8 MiB object, words
             w3 = jnp.asarray(
                 rng.integers(0, 1 << 32, size=(k3, S3), dtype=np.uint64).astype(np.uint32)
             )
             t3 = chained_seconds_per_iter(
-                lambda s, M=G3[k3:]: dev.matmul_words(M, s), w3, n_lo=10, n_hi=60
+                lambda s, M=G3[k3:]: dev.matmul_words(M, s), w3
             )
             stats[f"rs{k3}_{r3}_encode_gbps"] = round(k3 * S3 * 4 / t3 / 1e9, 2)
 
         # --- config 4a: Cauchy vs PAR1-Vandermonde generator, RS(10,4).
         Gp = generator_matrix(gf, k, k + r, "par1")
         tp = chained_seconds_per_iter(
-            lambda s: dev.matmul_words(Gp[k:], s), words, n_lo=10, n_hi=60
+            lambda s: dev.matmul_words(Gp[k:], s), words
         )
         stats["rs10_4_par1_encode_gbps"] = round(data_bytes / tp / 1e9, 2)
 
@@ -154,7 +177,7 @@ def main() -> None:
                 rng.integers(0, 1 << 32, size=(k, TW16), dtype=np.uint64).astype(np.uint32)
             )
             t16 = chained_seconds_per_iter(
-                lambda s: dev16.matmul_words(G16[k:], s), w16, n_lo=10, n_hi=60
+                lambda s: dev16.matmul_words(G16[k:], s), w16
             )
             stats["rs10_4_gf65536_encode_gbps"] = round(
                 k * TW16 * 4 / t16 / 1e9, 2
@@ -177,13 +200,7 @@ def main() -> None:
                 rng.integers(0, 1 << 32, size=(B, k, TWb), dtype=np.uint64).astype(np.uint32)
             )
             enc_b = bc.make_sharded_encoder_words(mesh, row_axis="row")
-
-            def enc_chain(s):
-                # Pad parity rows (B, r, TW) up to (B, k, TW) so the timing
-                # chain's axis-0 XOR matches the input shape.
-                return jnp.pad(enc_b(s), ((0, 0), (0, k - r), (0, 0)))
-
-            tb = chained_seconds_per_iter(enc_chain, wb, n_lo=10, n_hi=60)
+            tb = chained_seconds_per_iter(enc_b, wb)
             stats["batch_mesh_encode_gbps"] = round(B * k * TWb * 4 / tb / 1e9, 2)
             stats["batch_mesh_devices"] = len(devs)
         except Exception as exc:  # noqa: BLE001

@@ -160,6 +160,67 @@ def _sparse_call(bits_rows: tuple[tuple[int, ...], ...], C: int, W8: int, TL: in
     )
 
 
+def _tiled_dense_kernel(maskT_ref, planes_ref, out_ref):
+    # Per-sublane 2D broadcasts: Mosaic's layout inference rejects the 3D
+    # (R,1,1)x(1,8,TL) broadcast, so unroll the 8 sublane rows statically.
+    C = planes_ref.shape[0]
+    R = maskT_ref.shape[1]
+    TL = planes_ref.shape[2]
+    for s in range(planes_ref.shape[1]):
+        def body(c, acc, s=s):
+            m = maskT_ref[c, :]  # (R,)
+            p = planes_ref[c, s, :]  # (TL,)
+            return acc ^ (m[:, None] & p[None, :])
+
+        out_ref[:, s, :] = jax.lax.fori_loop(
+            0, C, body, jnp.zeros((R, TL), dtype=jnp.uint32)
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("tile_lanes", "interpret"))
+def gf2_matmul_pallas_tiled(
+    masks: jnp.ndarray,
+    tiled_planes: jnp.ndarray,
+    *,
+    tile_lanes: int = DEFAULT_TILE_LANES,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Dense-mask GF(2) matmul on TILED (C, 8, W8) planes -> (R, 8, W8).
+
+    Unlike the geometry-baked sparse kernel, the mask matrix is an
+    OPERAND — use it when the matrix changes per call and a recompile per
+    geometry is unacceptable. NOT on any production hot path: the mesh TP
+    path (parallel/batch.py) instead selects per-device geometry-baked
+    sparse programs with lax.switch, which measured ~13x faster than
+    this kernel. Kept as the runtime-dynamic-matrix option, tested in
+    tests/test_pallas_pack.py.
+    """
+    R, C = masks.shape
+    Cp, sub, W8 = tiled_planes.shape
+    assert C == Cp and sub == 8, (masks.shape, tiled_planes.shape)
+    per_lane = (C + R) * sub * 4 * 2
+    cap = max(128, VMEM_BUDGET_BYTES // per_lane // 128 * 128)
+    TL = min(tile_lanes, cap, max(128, -(-W8 // 128) * 128))
+    W8p = -(-W8 // TL) * TL
+    if W8p != W8:
+        tiled_planes = jnp.pad(tiled_planes, ((0, 0), (0, 0), (0, W8p - W8)))
+    maskT = masks.T  # (C, R): dynamic row reads in the kernel
+
+    out = pl.pallas_call(
+        _tiled_dense_kernel,
+        grid=(W8p // TL,),
+        in_specs=[
+            pl.BlockSpec((C, R), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, 8, TL), lambda i: (0, 0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((R, 8, TL), lambda i: (0, 0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R, 8, W8p), jnp.uint32),
+        interpret=interpret,
+    )(maskT, tiled_planes)
+    return out[:, :, :W8] if W8p != W8 else out
+
+
 def bits_to_rows(bits) -> tuple[tuple[int, ...], ...]:
     """(R, C) 0/1 matrix -> hashable per-output-row term tuples."""
     import numpy as _np

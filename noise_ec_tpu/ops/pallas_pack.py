@@ -152,6 +152,163 @@ def unpack_words_pallas(planes: jnp.ndarray, *,
 
 
 # ---------------------------------------------------------------------------
+# Lane-axis pack: the zero-relayout fast path.
+#
+# The sublane-group kernels above need the words reshaped (k, TW) ->
+# (k, G8, 8, TL) BEFORE the pallas call — and that reshape is a physical
+# relayout of the whole buffer (measured ~0.5-0.8 ms for 80 MiB on v5e,
+# dominating the fused encode). These variants keep the group axis on
+# LANES: a group's m words sit in m TL-lane windows of one m*TL-lane
+# sub-slab, so
+#
+# - the input block is a native 2D (k, 8*m*TL) slice of (k, TW) — no
+#   XLA-level reshape, no relayout;
+# - the delta-swap rolls move by d*TL lanes (TL a multiple of 128), i.e.
+#   whole-vreg permutations instead of sublane shuffles;
+# - the output block (k, m, 8, TL) writes plane words DIRECTLY into the
+#   matmul's (k, m, 8, W8) tiled layout, so the downstream reshape to
+#   (k*m, 8, W8) is a metadata-only leading-dim merge.
+#
+# Tile-content bijection: one grid step c consumes input words
+# [8*m*TL*c, 8*m*TL*(c+1)) as 8 sub-slabs sigma of m*TL lanes; plane
+# (j, i)'s tile position (sigma, c*TL + l) holds plane word
+# c*8*TL + sigma*TL + l. Any fixed bijection works — the GF(2) matmul is
+# positionwise and pack/unpack share this one (pack_words_lanes and
+# unpack_words_lanes are inverses; the sublane kernels use a different,
+# equally valid bijection).
+#
+# Constraint: TW must be a multiple of 8*m*TL (TL >= 128 -> lane_quantum
+# = 1024*m words). Wrappers pad; zero symbols are positionwise-inert.
+
+
+def lane_delta_swap(V: jnp.ndarray, TL: int, rounds=_ROUNDS) -> jnp.ndarray:
+    """Bit transpose across TL-lane windows of a (rows, G*TL) slab.
+
+    Window u holds group member u; out window i bit (G*b + j) == in window
+    j bit (G*b + i) per lane (G = 8 for GF(2^8) rounds, 16 with
+    ``_ROUNDS16``). Involution.
+    """
+    win = lax.broadcasted_iota(jnp.uint32, V.shape, 1) // jnp.uint32(TL)
+    for d, m in rounds:
+        s = jnp.roll(V, -d * TL, axis=1)
+        t = ((V >> jnp.uint32(d)) ^ s) & jnp.uint32(m)
+        lo = V ^ (t << jnp.uint32(d))
+        hi = V ^ jnp.roll(t, d * TL, axis=1)
+        V = jnp.where((win & jnp.uint32(d)) == 0, lo, hi)
+    return V
+
+
+def _pack_lanes_kernel(m, TL, rounds, in_ref, out_ref):
+    for sigma in range(8):
+        V = lane_delta_swap(
+            in_ref[:, sigma * m * TL : (sigma + 1) * m * TL], TL, rounds
+        )
+        for i in range(m):
+            out_ref[:, i, sigma, :] = V[:, i * TL : (i + 1) * TL]
+
+
+def _unpack_lanes_kernel(m, TL, rounds, in_ref, out_ref):
+    for sigma in range(8):
+        V = jnp.concatenate(
+            [in_ref[:, i, sigma, :] for i in range(m)], axis=1
+        )
+        out_ref[:, sigma * m * TL : (sigma + 1) * m * TL] = lane_delta_swap(
+            V, TL, rounds
+        )
+
+
+_LANE_VMEM_BUDGET = 12 << 20
+
+
+def _lane_tl(TW: int, m: int, rows: int) -> int:
+    """Largest TL in {512, 256, 128} with TL | W8 that fits the in+out
+    blocks (double-buffered) in the scoped-VMEM budget."""
+    W8 = TW // (8 * m)
+    for TL in (512, 256, 128):
+        if W8 % TL == 0 and rows * 8 * m * TL * 4 * 4 <= _LANE_VMEM_BUDGET:
+            return TL
+    raise ValueError(
+        f"no lane tile for TW={TW}, m={m}, rows={rows} "
+        f"(need TW % {1024 * m} == 0 and a tile within VMEM)"
+    )
+
+
+def lane_quantum(m: int) -> int:
+    """Pad-to multiple for the lane kernels: 8*m*128 = 1024*m words."""
+    return 1024 * m
+
+
+@functools.lru_cache(maxsize=256)
+def _pack_lanes_call(k: int, TW: int, m: int, rows_budget: int, interpret: bool):
+    TL = _lane_tl(TW, m, rows_budget)
+    W8 = TW // (8 * m)
+    rounds = _ROUNDS if m == 8 else _ROUNDS16
+    return pl.pallas_call(
+        functools.partial(_pack_lanes_kernel, m, TL, rounds),
+        grid=(W8 // TL,),
+        in_specs=[
+            pl.BlockSpec((k, 8 * m * TL), lambda c: (0, c),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((k, m, 8, TL), lambda c: (0, 0, 0, c),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((k, m, 8, W8), jnp.uint32),
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _unpack_lanes_call(r: int, TW: int, m: int, rows_budget: int, interpret: bool):
+    TL = _lane_tl(TW, m, rows_budget)
+    W8 = TW // (8 * m)
+    rounds = _ROUNDS if m == 8 else _ROUNDS16
+    return pl.pallas_call(
+        functools.partial(_unpack_lanes_kernel, m, TL, rounds),
+        grid=(W8 // TL,),
+        in_specs=[
+            pl.BlockSpec((r, m, 8, TL), lambda c: (0, 0, 0, c),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((r, 8 * m * TL), lambda c: (0, c),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((r, TW), jnp.uint32),
+        interpret=interpret,
+    )
+
+
+def pack_words_lanes(xw: jnp.ndarray, m: int = 8, *,
+                     rows_budget: int = 0,
+                     interpret: bool = False) -> jnp.ndarray:
+    """(k, TW) uint32 words -> (k, m, 8, TW/(8m)) tiled bit-planes.
+
+    Reshape the result to (k*m, 8, W8) for the sparse GF(2) matmul
+    (leading-dim merge: metadata-only). TW must be a multiple of
+    ``lane_quantum(m)``. Inverse: :func:`unpack_words_lanes`.
+
+    The tile-content bijection depends on the lane tile TL, and TL shrinks
+    with the row count to fit VMEM — so a pack/unpack PAIR must agree on
+    TL. Pass ``rows_budget = max(rows of every kernel in the pipeline)``
+    to BOTH ends (DeviceCodec passes max(k, r)); geometries where k and r
+    straddle a VMEM row bracket silently corrupt otherwise.
+    """
+    k, TW = xw.shape
+    return _pack_lanes_call(k, TW, m, max(rows_budget, k), interpret)(xw)
+
+
+def unpack_words_lanes(tiled: jnp.ndarray, *,
+                       rows_budget: int = 0,
+                       interpret: bool = False) -> jnp.ndarray:
+    """(r, m, 8, W8) tiled bit-planes -> (r, m*8*W8) uint32 words.
+
+    ``rows_budget`` must match the value given to
+    :func:`pack_words_lanes` (see its docstring).
+    """
+    r, m, eight, W8 = tiled.shape
+    assert eight == 8, tiled.shape
+    return _unpack_lanes_call(r, 8 * m * W8, m, max(rows_budget, r), interpret)(tiled)
+
+
+# ---------------------------------------------------------------------------
 # GF(2^16): 16-plane variant. A group is 16 words = 32 little-endian uint16
 # symbols; after the 16x16 transpose, sublane i holds bit i of all 32 symbols
 # (bit position 16h + w of plane word <-> symbol (w, half h) — a fixed

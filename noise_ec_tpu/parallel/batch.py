@@ -40,7 +40,6 @@ from noise_ec_tpu.matrix.generators import generator_matrix
 from noise_ec_tpu.matrix.linalg import reconstruction_matrix
 from noise_ec_tpu.ops.bitops import pack_bitplanes_jax, unpack_bitplanes_jax
 from noise_ec_tpu.ops.gf2mm import gf2_matmul_jax
-from noise_ec_tpu.ops.pallas_gf2mm import gf2_matmul_pallas
 
 _FIELDS = {"gf256": GF256, "gf65536": GF65536}
 
@@ -204,86 +203,124 @@ class BatchCodec:
         Words ARE the shard bytes (little-endian u32 view; 4 GF(2^8) or 2
         GF(2^16) symbols per word) — the zero-relayout layout the Pallas
         pipeline consumes; a host-side ``ndarray.view('<u4')`` is free.
-        Objects shard over ``batch_axis`` (DP). With ``row_axis``, rows of
-        ``M`` additionally shard over it (TP): the mask matrix rides as a
-        row-sharded *operand* (dense-mask kernel, one compiled program for
-        every device's slice) and row slices are assembled with an
-        all-gather over ICI. Unlike ``make_sharded_matmul`` this path runs
-        the delta-swap Pallas pack + matmul on TPU instead of the 32x
-        bitplane blow-up XLA pack.
+        Objects shard over ``batch_axis`` (DP) with the fused lane
+        pipeline vmapped per object (a transpose-fold into one wide stripe
+        measured 17 GB/s against vmap's 267 on v5e). With ``row_axis``,
+        rows of ``M`` additionally shard over it (TP): shard_map is SPMD,
+        so each device selects its row-slice's geometry-baked sparse
+        program with ``lax.switch(axis_index)`` — full sparse-kernel speed,
+        no mask operand (the dense mask-operand kernel ran 13x slower) —
+        and row slices are assembled with an all-gather over ICI.
         """
+        from noise_ec_tpu.gf.bitmatrix import expand_generator_bits
         from noise_ec_tpu.ops.dispatch import pad_words, pad_words16
+        from noise_ec_tpu.ops.pallas_gf2mm import (
+            bits_to_rows,
+            gf2_matmul_pallas_sparse_rows,
+        )
         from noise_ec_tpu.ops.pallas_pack import (
-            pack_words_pallas,
-            pack_words16_pallas,
-            unpack_words_pallas,
-            unpack_words16_pallas,
+            pack_words_lanes,
+            unpack_words_lanes,
         )
 
         M = np.ascontiguousarray(np.asarray(M, dtype=self.gf.dtype))
         m = self.gf.degree
-        masks = self._masks(M)  # (R*m, k*m)
+        R = M.shape[0]
         if kernel == "auto":
             kernel = "pallas" if jax.default_backend() == "tpu" else "xla"
         interpret = kernel == "pallas_interpret"
         quantize = pad_words if m == 8 else pad_words16
-        pack = pack_words_pallas if m == 8 else pack_words16_pallas
-        unpack = unpack_words_pallas if m == 8 else unpack_words16_pallas
 
-        if row_axis is not None:
-            rsz = mesh.shape[row_axis]
-            if M.shape[0] % rsz:
-                raise ValueError(
-                    f"matrix rows {M.shape[0]} not divisible by mesh axis "
-                    f"{row_axis!r} size {rsz}"
-                )
-            mask_spec = P(row_axis, None)
+        rsz = 1 if row_axis is None else mesh.shape[row_axis]
+        if R % rsz:
+            raise ValueError(
+                f"matrix rows {R} not divisible by mesh axis "
+                f"{row_axis!r} size {rsz}"
+            )
+        Rl = R // rsz
+        if kernel == "xla":
+            masks = self._masks(M)  # (R*m, k*m)
+            mask_spec = (
+                P(None, None) if row_axis is None else P(row_axis, None)
+            )
         else:
-            mask_spec = P(None, None)
+            row_groups = [
+                bits_to_rows(
+                    expand_generator_bits(self.gf, M[d * Rl : (d + 1) * Rl])
+                )
+                for d in range(rsz)
+            ]
 
-        def local(masks_local, words_local):
+        def local_pallas(words_local):
             Bl, k, TW = words_local.shape
-            # Fold batch into the lane axis (one transposing copy — cheap
-            # next to the 32x pack blow-up this path replaces).
-            folded = words_local.transpose(1, 0, 2).reshape(k, Bl * TW)
-            TWf = folded.shape[1]
-            TWp = quantize(TWf)
-            if TWp != TWf:
-                folded = jnp.pad(folded, ((0, 0), (0, TWp - TWf)))
-            Rl = masks_local.shape[0] // m
-            if kernel == "xla":
-                # Portable fallback: plane pack via masked shifts.
-                sym = lax.bitcast_convert_type(
-                    folded, jnp.uint8 if m == 8 else jnp.uint16
-                ).reshape(k, -1)
-                planes = pack_bitplanes_jax(sym, m)
-                out2d = gf2_matmul_jax(masks_local, planes)
-                sym_out = unpack_bitplanes_jax(out2d, Rl, sym.shape[1], m)
-                words_out = lax.bitcast_convert_type(
-                    sym_out.reshape(Rl, TWp, 4 // (m // 8)), jnp.uint32
+            TWp = quantize(TW)
+            if TWp != TW:
+                words_local = jnp.pad(words_local, ((0, 0), (0, 0), (0, TWp - TW)))
+            W8 = TWp // (8 * m)
+
+            mr = max(k, Rl)  # one TL for pack AND unpack (bijection match)
+
+            def one(w):
+                tiled = pack_words_lanes(
+                    w, m, rows_budget=mr, interpret=interpret
                 )
-            else:
-                planes = pack(folded, interpret=interpret)  # (k, m, TWp/m)
-                planes2d = planes.reshape(k * m, TWp // m)
-                out2d = gf2_matmul_pallas(
-                    masks_local, planes2d, interpret=interpret
+                planes = tiled.reshape(k * m, 8, W8)
+                branches = [
+                    functools.partial(
+                        gf2_matmul_pallas_sparse_rows, rows,
+                        interpret=interpret,
+                    )
+                    for rows in row_groups
+                ]
+                if rsz == 1:
+                    prod = branches[0](planes)
+                else:
+                    idx = jax.lax.axis_index(row_axis)
+                    prod = jax.lax.switch(idx, branches, planes)
+                return unpack_words_lanes(
+                    prod.reshape(Rl, m, 8, W8), rows_budget=mr,
+                    interpret=interpret
                 )
-                words_out = unpack(
-                    out2d.reshape(Rl, m, TWp // m), interpret=interpret
-                )
-            out = words_out[:, :TWf].reshape(Rl, Bl, TW).transpose(1, 0, 2)
+
+            out = jax.vmap(one)(words_local)[:, :, :TW]
             if row_axis is not None:
                 # (Bl, R_local, TW) -> gather rows over ICI -> (Bl, R, TW)
                 out = jax.lax.all_gather(out, row_axis, axis=1, tiled=True)
             return out
 
+        def local_xla(masks_local, words_local):
+            # Portable fallback: fold the batch into the lane axis and
+            # pack planes via masked shifts (no tile constraint, so no
+            # quantum padding — the jnp pack handles any length).
+            Bl, k, TW = words_local.shape
+            folded = words_local.transpose(1, 0, 2).reshape(k, Bl * TW)
+            sym = lax.bitcast_convert_type(
+                folded, jnp.uint8 if m == 8 else jnp.uint16
+            ).reshape(k, -1)
+            planes = pack_bitplanes_jax(sym, m)
+            out2d = gf2_matmul_jax(masks_local, planes)
+            sym_out = unpack_bitplanes_jax(out2d, Rl, sym.shape[1], m)
+            words_out = lax.bitcast_convert_type(
+                sym_out.reshape(Rl, Bl * TW, 4 // (m // 8)), jnp.uint32
+            )
+            out = words_out.reshape(Rl, Bl, TW).transpose(1, 0, 2)
+            if row_axis is not None:
+                out = jax.lax.all_gather(out, row_axis, axis=1, tiled=True)
+            return out
+
+        if kernel == "xla":
+            fn = _shard_map_compat(
+                local_xla, mesh,
+                in_specs=(mask_spec, P(batch_axis, None, None)),
+                out_specs=P(batch_axis, None, None),
+            )
+            return functools.partial(jax.jit(fn), jnp.asarray(masks))
         fn = _shard_map_compat(
-            local, mesh,
-            in_specs=(mask_spec, P(batch_axis, None, None)),
+            local_pallas, mesh,
+            in_specs=(P(batch_axis, None, None),),
             out_specs=P(batch_axis, None, None),
         )
-        jfn = jax.jit(fn)
-        return functools.partial(jfn, jnp.asarray(masks))
+        return jax.jit(fn)
 
     def make_sharded_encoder_words(self, mesh: Mesh, *,
                                    batch_axis: str = "batch",

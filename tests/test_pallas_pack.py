@@ -110,6 +110,86 @@ def test_u16_words_bitcast_roundtrip(rng):
     np.testing.assert_array_equal(np.asarray(words_to_u16(u16_to_words(x))), np.asarray(x))
 
 
+@pytest.mark.parametrize("m,TW", [(8, 8192), (8, 16384), (16, 16384)])
+def test_lane_pack_unpack_roundtrip(rng, m, TW):
+    from noise_ec_tpu.ops.pallas_pack import (
+        pack_words_lanes,
+        unpack_words_lanes,
+    )
+
+    k = 3
+    xw = jnp.asarray(rng.integers(0, 1 << 32, size=(k, TW), dtype=np.uint64).astype(np.uint32))
+    tiled = pack_words_lanes(xw, m, interpret=True)
+    assert tiled.shape == (k, m, 8, TW // (8 * m))
+    back = unpack_words_lanes(tiled, interpret=True)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(xw))
+
+
+def test_lane_planes_hold_single_bits(rng):
+    """Lane-packed plane row (j, i) collects only bit i of shard j."""
+    from noise_ec_tpu.ops.pallas_pack import pack_words_lanes
+
+    k, TW = 2, 8192
+    x = rng.integers(0, 256, size=(k, 4 * TW)).astype(np.uint8)
+    tiled = np.asarray(
+        pack_words_lanes(bytes_to_words(jnp.asarray(x)), 8, interpret=True)
+    )
+    for j in range(k):
+        for i in range(8):
+            got = int(sum(bin(int(w)).count("1")
+                          for w in tiled[j, i].ravel().astype(np.uint64)))
+            want = int(((x[j] >> i) & 1).sum())
+            assert got == want, (j, i)
+
+
+def test_lane_pipeline_wide_geometry_matches_golden(rng):
+    """Regression: k and r straddling a VMEM row bracket must still agree
+    on the pack/unpack lane tile (RS(30,10): pack would pick TL=256 for 30
+    rows while unpack picked TL=512 for 10 — silently corrupt parity)."""
+    from noise_ec_tpu.gf.field import GF256
+    from noise_ec_tpu.golden.codec import GoldenCodec
+    from noise_ec_tpu.matrix.generators import generator_matrix
+    from noise_ec_tpu.ops.dispatch import DeviceCodec
+
+    k, r = 30, 10
+    TW = 32768  # W8 = 512: both 256 and 512 divide it
+    gf = GF256()
+    G = generator_matrix(gf, k, k + r, "cauchy")
+    dev = DeviceCodec(field="gf256", kernel="pallas_interpret")
+    words = rng.integers(0, 1 << 32, size=(k, TW), dtype=np.uint64).astype(np.uint32)
+    out = np.asarray(dev.matmul_words(G[k:], jnp.asarray(words)))
+    data = np.ascontiguousarray(words).view(np.uint8)
+    gold = np.asarray(GoldenCodec(k, k + r).encode(data))
+    np.testing.assert_array_equal(np.ascontiguousarray(out).view(np.uint8), gold)
+
+
+def test_tiled_dense_matmul_matches_sparse(rng):
+    """The mask-operand tiled matmul (mesh TP path) == sparse kernel."""
+    from noise_ec_tpu.gf.field import GF256
+    from noise_ec_tpu.gf.bitmatrix import (
+        expand_generator_bits,
+        expand_generator_masks,
+    )
+    from noise_ec_tpu.matrix.generators import generator_matrix
+    from noise_ec_tpu.ops.pallas_gf2mm import (
+        bits_to_rows,
+        gf2_matmul_pallas_sparse_rows,
+        gf2_matmul_pallas_tiled,
+    )
+
+    gf = GF256()
+    k, r = 5, 3
+    G = generator_matrix(gf, k, k + r, "cauchy")
+    tiled = jnp.asarray(
+        rng.integers(0, 1 << 32, size=(k * 8, 8, 256), dtype=np.uint64).astype(np.uint32)
+    )
+    masks = jnp.asarray(expand_generator_masks(gf, G[k:]))
+    rows = bits_to_rows(expand_generator_bits(gf, G[k:]))
+    dense = np.asarray(gf2_matmul_pallas_tiled(masks, tiled, interpret=True))
+    sparse = np.asarray(gf2_matmul_pallas_sparse_rows(rows, tiled, interpret=True))
+    np.testing.assert_array_equal(dense, sparse)
+
+
 def test_fused_gf65536_encode_matches_golden(rng):
     """GF(2^16) delta-swap Pallas pipeline end-to-end vs golden codec."""
     from noise_ec_tpu.golden.codec import GoldenCodec
