@@ -240,19 +240,32 @@ class DeviceCodec:
         pad/slice fuse into the program). This is the zero-relayout hot
         path used by bench and the parallel layer.
         """
+        return self.matmul_words_batch(M, words[None])[0]
+
+    def matmul_words_batch(self, M: np.ndarray, words: jnp.ndarray) -> jnp.ndarray:
+        """Batched words entry: (B, k, TW) uint32 -> (B, r, TW) uint32.
+
+        vmap of the fused lane pipeline per object (the same kernels the
+        single-object path runs; vmap adds a grid dimension).
+        ``matmul_words`` delegates here with B=1; the streaming encoder
+        uses it directly for many same-geometry device-resident objects.
+        """
         if self.kernel == "xla":
-            raise ValueError("matmul_words requires a pallas kernel")
-        record_kernel("matmul_words", 4 * words.shape[0] * words.shape[1])
+            raise ValueError(
+                "matmul_words/matmul_words_batch require a pallas kernel; "
+                "use matmul_stripes (or BatchCodec.encode_batch) on the XLA path"
+            )
+        record_kernel("matmul_words", 4 * int(np.prod(words.shape)))
         mk = _fused_words_fn if self.gf.degree == 8 else _fused_words16_fn
         fn = mk(
             M.shape[0], self.bits_rows_for(M), self.kernel == "pallas_interpret"
         )
-        TW = words.shape[1]
+        TW = words.shape[2]
         TWp = pad_words(TW) if self.gf.degree == 8 else pad_words16(TW)
         if TWp != TW:
-            out = fn(jnp.pad(words, ((0, 0), (0, TWp - TW))))
-            return out[:, :TW]
-        return fn(words)
+            out = jax.vmap(fn)(jnp.pad(words, ((0, 0), (0, 0), (0, TWp - TW))))
+            return out[:, :, :TW]
+        return jax.vmap(fn)(words)
 
     def matmul_planes(self, M: np.ndarray, planes: jnp.ndarray) -> jnp.ndarray:
         """Device-level entry on packed (C, W) planes (HBM-resident path).

@@ -82,6 +82,8 @@ class BatchCodec:
         if field not in _FIELDS:
             raise ValueError(f"unknown field {field!r}")
         self.gf: GF = _FIELDS[field]()
+        self.field_name = field
+        self._dev = None  # lazy DeviceCodec for the words hot path
         self.k = data_shards
         self.r = parity_shards
         self.n = data_shards + parity_shards
@@ -113,6 +115,33 @@ class BatchCodec:
         """(B, k, S) data shards -> (B, n, S) full codewords."""
         parity = self.matmul_batch(self.parity_matrix, batch)
         return jnp.concatenate([jnp.asarray(batch, self._jdtype), parity], axis=1)
+
+    def encode_batch_words(self, words: jnp.ndarray, *,
+                           kernel: str = "auto") -> jnp.ndarray:
+        """(B, k, TW) uint32 words -> (B, n, TW) full codewords as words.
+
+        The single-device TPU hot path for many same-geometry objects
+        (streaming chunks): the fused lane pipeline vmapped per object.
+        ``kernel`` reaches the underlying DeviceCodec (tests inject
+        ``pallas_interpret`` to run this chain on CPU). On backends where
+        ``auto`` resolves to the XLA kernel (no Pallas words pipeline) the
+        call falls back to ``encode_batch`` on the symbol view, so the API
+        is total everywhere at the cost of a host relayout.
+        """
+        from noise_ec_tpu.ops.dispatch import DeviceCodec, _resolve_kernel
+
+        resolved = _resolve_kernel(kernel)
+        if resolved == "xla":
+            B, k, TW = words.shape
+            sym = np.ascontiguousarray(np.asarray(words)).view(
+                self.gf.dtype).reshape(B, k, -1)
+            full = np.asarray(self.encode_batch(jnp.asarray(sym)))
+            return jnp.asarray(
+                np.ascontiguousarray(full).view("<u4").reshape(B, self.n, TW))
+        if self._dev is None or self._dev.kernel != resolved:
+            self._dev = DeviceCodec(field=self.field_name, kernel=resolved)
+        parity = self._dev.matmul_words_batch(self.parity_matrix, words)
+        return jnp.concatenate([jnp.asarray(words, jnp.uint32), parity], axis=1)
 
     def reconstruct_batch(self, batch_present: jnp.ndarray,
                           present: list[int]) -> jnp.ndarray:

@@ -44,21 +44,37 @@ class StreamingEncoder:
 
     def __init__(self, data_shards: int, parity_shards: int, *,
                  chunk_bytes: int = 1 << 20, field: str = "gf256",
-                 matrix: str = "cauchy"):
+                 matrix: str = "cauchy", kernel: str = "auto"):
         self.codec = BatchCodec(data_shards, parity_shards, field=field,
                                 matrix=matrix)
         self.k = data_shards
         self.n = data_shards + parity_shards
         sym = self.codec.gf.degree // 8
-        # Round the chunk so each stripe is whole symbols.
+        from noise_ec_tpu.ops.dispatch import _resolve_kernel
+
+        self._kernel = kernel
+        # Words branch iff a Pallas kernel will actually run it; an explicit
+        # kernel="xla" (even on TPU) keeps the async symbol path.
+        self._use_words = _resolve_kernel(kernel) != "xla"
+        # Round the chunk so each stripe is whole symbols — the caller-visible
+        # contract, identical on every backend. The TPU words path needs
+        # whole uint32 words per stripe; rather than shrink chunk_bytes
+        # (which would reject caller-prechunked streams that were valid on
+        # other backends), each chunk is zero-padded up to _padded_bytes
+        # before striping. Padding sits at the tail of the flat buffer, so
+        # decode_stream's reshape(-1)[:data_len] slice drops it for free.
         quantum = data_shards * sym
         self.chunk_bytes = max(quantum, chunk_bytes - chunk_bytes % quantum)
+        wq = data_shards * max(sym, 4)
+        self._padded_bytes = (
+            -(-self.chunk_bytes // wq) * wq if self._use_words else self.chunk_bytes
+        )
 
     def _to_stripes(self, chunk: bytes) -> np.ndarray:
         buf = np.frombuffer(chunk, dtype=np.uint8)
-        stride = self.chunk_bytes // self.k
-        if buf.size < self.chunk_bytes:
-            pad = np.zeros(self.chunk_bytes, dtype=np.uint8)
+        stride = self._padded_bytes // self.k
+        if buf.size < self._padded_bytes:
+            pad = np.zeros(self._padded_bytes, dtype=np.uint8)
             pad[: buf.size] = buf
             buf = pad
         stripes = buf.reshape(self.k, stride)
@@ -78,8 +94,15 @@ class StreamingEncoder:
                     f"{self.chunk_bytes}"
                 )
             stripes = self._to_stripes(chunk)
-            # encode_batch with B=1; async dispatch returns immediately.
-            full = self.codec.encode_batch(jnp.asarray(stripes)[None])[0]
+            # B=1 batch; async dispatch returns immediately. On TPU the
+            # chunk rides as uint32 words through the fused lane pipeline
+            # (host view is free); elsewhere the portable symbol path.
+            if self._use_words:
+                words = np.ascontiguousarray(stripes).view("<u4")
+                full = self.codec.encode_batch_words(
+                    jnp.asarray(words)[None], kernel=self._kernel)[0]
+            else:
+                full = self.codec.encode_batch(jnp.asarray(stripes)[None])[0]
             inflight.append((idx, len(chunk), full))
             idx += 1
             if len(inflight) >= depth:

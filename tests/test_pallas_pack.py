@@ -142,6 +142,27 @@ def test_lane_planes_hold_single_bits(rng):
             assert got == want, (j, i)
 
 
+def test_matmul_words_batch_matches_golden(rng):
+    """vmapped fused batch entry (streaming hot path) vs golden."""
+    from noise_ec_tpu.gf.field import GF256
+    from noise_ec_tpu.golden.codec import GoldenCodec
+    from noise_ec_tpu.matrix.generators import generator_matrix
+    from noise_ec_tpu.ops.dispatch import DeviceCodec
+
+    k, r, B, TW = 4, 2, 3, 2048  # non-quantum TW: exercises batch padding
+    gf = GF256()
+    G = generator_matrix(gf, k, k + r, "cauchy")
+    dev = DeviceCodec(field="gf256", kernel="pallas_interpret")
+    words = rng.integers(0, 1 << 32, size=(B, k, TW), dtype=np.uint64).astype(np.uint32)
+    out = np.asarray(dev.matmul_words_batch(G[k:], jnp.asarray(words)))
+    g = GoldenCodec(k, k + r)
+    for b in range(B):
+        data = np.ascontiguousarray(words[b]).view(np.uint8)
+        np.testing.assert_array_equal(
+            np.ascontiguousarray(out[b]).view(np.uint8), np.asarray(g.encode(data))
+        )
+
+
 def test_lane_pipeline_wide_geometry_matches_golden(rng):
     """Regression: k and r straddling a VMEM row bracket must still agree
     on the pack/unpack lane tile (RS(30,10): pack would pick TL=256 for 30
