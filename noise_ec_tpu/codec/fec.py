@@ -15,8 +15,10 @@ Contract reproduced from the reference's call sites (SURVEY.md §2.3 D1;
   never required for correctness;
 - ``decode(shares)`` needs >= required distinct share numbers and performs
   error detection/correction when extra shares are present (infectious runs
-  Berlekamp-Welch; we use the consistent-subset search with the same
-  unique-decoding radius — see golden.codec.decode_shares);
+  Berlekamp-Welch; so do we, per byte column — matrix/bw.py — for the MDS
+  GRS constructions; par1 falls back to the golden consistent-subset
+  search, which has the same unique-decoding radius for shard-level
+  corruption);
 - ``rebuild(shares, output)`` regenerates the missing shares (erasure-only).
 """
 
@@ -29,6 +31,7 @@ import numpy as np
 
 from noise_ec_tpu.codec.rs import ReedSolomon
 from noise_ec_tpu.golden.codec import GoldenCodec, NotEnoughShardsError, TooManyErrorsError
+from noise_ec_tpu.matrix.bw import bw_decode_stripes, grs_normalizers
 from noise_ec_tpu.matrix.linalg import gf_inv
 
 __all__ = ["FEC", "Share", "NotEnoughShardsError", "TooManyErrorsError"]
@@ -75,8 +78,16 @@ class FEC:
         self._golden = GoldenCodec(required, total, field=field, matrix=matrix)
         # Decode-path instrumentation: "fast" = submatrix-inverse multiply on
         # the configured backend (the main.go:77 hot loop on the device
-        # codec); "subset" = golden consistent-subset search fallback.
-        self.stats = {"fast_decodes": 0, "subset_decodes": 0}
+        # codec); "bw" = Berlekamp-Welch error correction; "subset" = golden
+        # consistent-subset search (par1's only option).
+        self.stats = {"fast_decodes": 0, "bw_decodes": 0, "subset_decodes": 0}
+        # One source of truth for which constructions BW can decode:
+        # grs_normalizers raises for kinds with no GRS representation.
+        try:
+            grs_normalizers(self._golden.gf, matrix, required, total)
+            self._mds_grs = True
+        except ValueError:
+            self._mds_grs = False
 
     @property
     def required(self) -> int:
@@ -147,9 +158,26 @@ class FEC:
         if fast is not None:
             self.stats["fast_decodes"] += 1
             return np.ascontiguousarray(fast).tobytes()
-        self.stats["subset_decodes"] += 1
-        pairs = [(i, dedup[i]) for i in nums]
-        data = self._golden.decode_shares(pairs)  # (k, S) symbol rows
+        if self._mds_grs:
+            # Inconsistent shares on an MDS construction: polynomial-time
+            # per-column Berlekamp-Welch (what infectious runs, main.go:77).
+            # ``dedup`` is already validated, so call the stripes-level
+            # entry directly rather than re-deduping via decode_shares_bw.
+            self.stats["bw_decodes"] += 1
+            data = bw_decode_stripes(
+                self._golden.gf, self._golden.matrix_kind, self.k, self.n,
+                nums, np.stack([dedup[i] for i in nums]),
+            )
+            if data is None:
+                m = len(nums)
+                raise TooManyErrorsError(
+                    f"some column has more than {(m - self.k) // 2} errors "
+                    f"(m={m}, k={self.k})"
+                )
+        else:
+            self.stats["subset_decodes"] += 1
+            pairs = [(i, dedup[i]) for i in nums]
+            data = self._golden.decode_shares(pairs)  # (k, S) symbol rows
         return np.ascontiguousarray(data).tobytes()
 
     def _decode_fast(

@@ -136,6 +136,52 @@ class GoldenCodec:
             shards[i] = filled[row]
         return shards
 
+    def decode_shares_bw(
+        self, shares: Sequence[tuple[int, np.ndarray]]
+    ) -> np.ndarray:
+        """(number, stripe) pairs -> (k, S) data via Berlekamp-Welch.
+
+        The polynomial-time error-correcting decode (the algorithm
+        infectious actually runs at the reference's main.go:77 call site):
+        corrects up to floor((m - k)/2) wrong symbols *per byte column* —
+        strictly stronger than the subset search, which models whole-share
+        corruption only. MDS GRS constructions only (cauchy, vandermonde,
+        vandermonde_raw); par1 has no GRS representation and must use
+        :meth:`decode_shares`.
+        """
+        from noise_ec_tpu.matrix.bw import bw_decode_stripes
+
+        nums, stripes = self._dedup_shares(shares)
+        data = bw_decode_stripes(
+            self.gf, self.matrix_kind, self.k, self.n, nums,
+            np.stack([stripes[i] for i in nums]),
+        )
+        if data is None:
+            m = len(nums)
+            raise TooManyErrorsError(
+                f"some column has more than {(m - self.k) // 2} errors "
+                f"(m={m}, k={self.k})"
+            )
+        return data
+
+    def _dedup_shares(
+        self, shares: Sequence[tuple[int, np.ndarray]]
+    ) -> tuple[list[int], dict[int, np.ndarray]]:
+        dedup: dict[int, np.ndarray] = {}
+        for num, data in shares:
+            num = int(num)
+            if not 0 <= num < self.n:
+                raise ValueError(f"share number {num} out of range [0, {self.n})")
+            arr = np.asarray(data, dtype=self.gf.dtype)
+            if num in dedup:
+                if not np.array_equal(dedup[num], arr):
+                    raise ValueError(f"conflicting copies of share {num}")
+                continue
+            dedup[num] = arr
+        if len(dedup) < self.k:
+            raise NotEnoughShardsError(f"have {len(dedup)} shares, need {self.k}")
+        return sorted(dedup), dedup
+
     def decode_shares(
         self,
         shares: Sequence[tuple[int, np.ndarray]],
@@ -151,21 +197,7 @@ class GoldenCodec:
         ``Decode`` implements; SURVEY.md §2.3 D1). Raises TooManyErrorsError
         if no such decoding exists within ``max_subsets`` candidate subsets.
         """
-        dedup: dict[int, np.ndarray] = {}
-        for num, data in shares:
-            num = int(num)
-            if not 0 <= num < self.n:
-                raise ValueError(f"share number {num} out of range [0, {self.n})")
-            arr = np.asarray(data, dtype=self.gf.dtype)
-            if num in dedup:
-                if not np.array_equal(dedup[num], arr):
-                    raise ValueError(f"conflicting copies of share {num}")
-                continue
-            dedup[num] = arr
-        if len(dedup) < self.k:
-            raise NotEnoughShardsError(f"have {len(dedup)} shares, need {self.k}")
-        nums = sorted(dedup)
-        stripes = {i: dedup[i] for i in nums}
+        nums, stripes = self._dedup_shares(shares)
         m = len(nums)
 
         def try_basis(basis: tuple[int, ...]) -> tuple[Optional[np.ndarray], int]:

@@ -1,0 +1,240 @@
+"""Berlekamp-Welch error-correcting decode for the GRS constructions.
+
+The reference's codec (``vivint/infectious``, called at
+/root/reference/main.go:77) does not just fill erasures: with more than k
+shares its ``Decode`` runs Berlekamp-Welch per byte offset, correcting up to
+floor((m - k) / 2) corrupted shares *per column*. The golden codec's
+consistent-subset search has the same unique-decoding radius for shard-level
+corruption but is exponential in the worst case and only models whole-share
+corruption. This module is the faithful polynomial-time algorithm.
+
+It works because every MDS construction in :mod:`matrix.generators` is a
+generalized Reed-Solomon (GRS) evaluation code whose evaluation point for
+shard ``pos`` is ``pos`` itself:
+
+- ``vandermonde_raw``: codeword row p is f(p) where f's coefficients are the
+  data — the evaluation code itself, multipliers 1.
+- ``vandermonde`` (systematic): right-multiplying by inv(V[:k]) is a change
+  of basis on the message, not on the code: codeword row p is still f(p),
+  now with f interpolating the data at points 0..k-1.
+- ``cauchy``: with w_j = prod_{l<k, l!=j} (j ^ l) and Z_p = prod_{l<k} (p ^ l),
+  the degree-<k polynomial f interpolating f(j) = d_j * w_j satisfies
+  f(p) = Z_p * parity_p for every parity position p >= k (Lagrange expansion;
+  the w_j cancels the interpolation denominator). So the codeword is the GRS
+  code with column multipliers 1/w_j (data) and 1/Z_p (parity).
+
+``par1`` is not MDS (singular generalized-Vandermonde minors) and has no
+GRS representation; callers must keep the subset search for it.
+
+Given the normalizers N_pos (w or Z above, ones for Vandermonde), the
+received word normalizes to R_pos = N_pos * r_pos = f(pos) + error, and
+classic Berlekamp-Welch applies: solve the linear system
+
+    Q(x_i) = R_i * E(x_i)        deg Q < k + e,  E = x^e + ...,  e = (m-k)//2
+
+for each received position x_i; then f = Q / E exactly, or the column is
+beyond the unique-decoding radius.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from noise_ec_tpu.gf.field import GF
+from noise_ec_tpu.matrix.linalg import gf_inv
+
+
+def gf_solve_any(gf: GF, A: np.ndarray, b: np.ndarray) -> Optional[np.ndarray]:
+    """One solution x of A @ x = b over GF, or None if inconsistent.
+
+    Plain Gauss elimination with free variables pinned to zero; A may be
+    rectangular or rank-deficient (Berlekamp-Welch systems are both when
+    fewer than e errors occurred).
+    """
+    A = np.asarray(A, dtype=np.int64)
+    b = np.asarray(b, dtype=np.int64)
+    rows, cols = A.shape
+    aug = np.concatenate([A, b[:, None]], axis=1)
+    pivot_col_of_row: list[int] = []
+    row = 0
+    for col in range(cols):
+        pivot = None
+        for rr in range(row, rows):
+            if aug[rr, col] != 0:
+                pivot = rr
+                break
+        if pivot is None:
+            continue
+        if pivot != row:
+            aug[[row, pivot]] = aug[[pivot, row]]
+        aug[row] = gf.div(aug[row], aug[row, col]).astype(np.int64)
+        factors = aug[:, col].copy()
+        factors[row] = 0
+        aug ^= gf.mul(factors[:, None], aug[row][None, :]).astype(np.int64)
+        pivot_col_of_row.append(col)
+        row += 1
+        if row == rows:
+            break
+    # Inconsistent iff a zero row has nonzero RHS.
+    if np.any((aug[row:, :cols] == 0).all(axis=1) & (aug[row:, cols] != 0)):
+        return None
+    x = np.zeros(cols, dtype=np.int64)
+    for r, c in enumerate(pivot_col_of_row):
+        x[c] = aug[r, cols]
+    return x.astype(gf.dtype)
+
+
+def poly_eval(gf: GF, coeffs: np.ndarray, xs: np.ndarray) -> np.ndarray:
+    """Evaluate sum_j coeffs[j] x^j at each x (Horner, vectorized over xs)."""
+    xs = np.asarray(xs, dtype=np.int64)
+    out = np.zeros_like(xs)
+    for c in np.asarray(coeffs, dtype=np.int64)[::-1]:
+        out = (gf.mul(out, xs).astype(np.int64)) ^ c
+    return out.astype(gf.dtype)
+
+
+def poly_divmod(
+    gf: GF, num: np.ndarray, den: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Polynomial division over GF; coefficient order is ascending."""
+    num = list(np.asarray(num, dtype=np.int64))
+    den = np.asarray(den, dtype=np.int64)
+    dlen = len(den)
+    while dlen > 1 and den[dlen - 1] == 0:
+        dlen -= 1
+    if dlen == 0 or den[dlen - 1] == 0:
+        raise ZeroDivisionError("division by zero polynomial")
+    lead_inv = int(gf.inv(den[dlen - 1]))
+    qlen = max(len(num) - dlen + 1, 0)
+    quot = np.zeros(max(qlen, 1), dtype=np.int64)
+    for i in range(qlen - 1, -1, -1):
+        c = int(gf.mul(num[i + dlen - 1], lead_inv))
+        quot[i] = c
+        if c:
+            for j in range(dlen):
+                num[i + j] ^= int(gf.mul(c, den[j]))
+    rem = np.asarray(num[: dlen - 1] if dlen > 1 else [0], dtype=np.int64)
+    return quot.astype(gf.dtype), rem.astype(gf.dtype)
+
+
+def grs_normalizers(gf: GF, kind: str, k: int, n: int) -> np.ndarray:
+    """(n,) multipliers N with N[pos] * codeword[pos] == f(pos).
+
+    Raises ValueError for constructions with no GRS representation (par1).
+    """
+    if kind in ("vandermonde", "vandermonde_raw"):
+        return np.ones(n, dtype=gf.dtype)
+    if kind != "cauchy":
+        raise ValueError(f"no GRS representation for generator kind {kind!r}")
+    pts = np.arange(n, dtype=np.int64)
+    N = np.ones(n, dtype=np.int64)
+    for l in range(k):
+        term = pts ^ l
+        term[l] = 1  # skip the l == pos factor inside the data block
+        N = gf.mul(N, term).astype(np.int64)
+    return N.astype(gf.dtype)
+
+
+def bw_correct_column(
+    gf: GF, xs: np.ndarray, R: np.ndarray, k: int
+) -> Optional[np.ndarray]:
+    """Berlekamp-Welch on one normalized column; returns f's k coefficients.
+
+    ``xs``: m distinct evaluation points; ``R``: the m received (normalized)
+    values, at most floor((m - k)/2) of them wrong. None if the column is
+    beyond the unique-decoding radius.
+    """
+    m = len(xs)
+    e = (m - k) // 2
+    xs = np.asarray(xs, dtype=np.int64)
+    R = np.asarray(R, dtype=np.int64)
+    # Power basis columns x^0 .. x^{k+e-1} (Q), then R*x^0 .. R*x^{e-1} (E).
+    powers = np.ones((m, k + e), dtype=np.int64)
+    for j in range(1, k + e):
+        powers[:, j] = gf.mul(powers[:, j - 1], xs)
+    if e:
+        epows = gf.mul(R[:, None], powers[:, :e]).astype(np.int64)
+        A = np.concatenate([powers, epows], axis=1)
+        xe = gf.mul(powers[:, e - 1], xs).astype(np.int64)  # x^e
+        rhs = gf.mul(R, xe).astype(np.int64)
+    else:
+        A = powers
+        rhs = R
+    sol = gf_solve_any(gf, A, rhs)
+    if sol is None:
+        return None
+    Q = sol[: k + e]
+    E = np.concatenate([sol[k + e :], np.array([1], dtype=gf.dtype)])  # monic
+    f, rem = poly_divmod(gf, Q, E)
+    if np.any(rem):
+        return None
+    out = np.zeros(k, dtype=gf.dtype)
+    out[: min(len(f), k)] = f[:k]
+    if np.any(f[k:]):
+        return None  # degree overflow: not a valid message polynomial
+    # Q/E exact does not by itself guarantee the radius: re-check agreement.
+    agree = int(np.sum(poly_eval(gf, out, xs).astype(np.int64) == R))
+    if agree < m - e:
+        return None
+    return out
+
+
+def bw_decode_stripes(
+    gf: GF,
+    kind: str,
+    k: int,
+    n: int,
+    nums: list[int],
+    stripes: np.ndarray,
+) -> Optional[np.ndarray]:
+    """Decode (m, S) received stripes at share numbers ``nums`` -> (k, S) data.
+
+    Error-correcting within the per-column unique-decoding radius
+    floor((m - k)/2), exactly the guarantee infectious's Decode gives the
+    reference (SURVEY.md §2.3 D1). Vectorized fast path: interpolate f from
+    the first k received rows for every column at once, re-evaluate at all
+    received points, and run per-column Berlekamp-Welch only on columns with
+    a disagreement. Returns None if any column is beyond the radius.
+
+    For ``vandermonde_raw`` the returned rows are f's coefficients (the
+    code's message is the coefficient vector); for the systematic kinds they
+    are the data shards.
+    """
+    m, S = stripes.shape
+    if m < k:
+        raise ValueError(f"need >= {k} rows, got {m}")
+    N = grs_normalizers(gf, kind, k, n)
+    xs = np.asarray(nums, dtype=np.int64)
+    R = gf.mul(N[xs][:, None], stripes).astype(np.int64)  # (m, S) f(x_i) + err
+
+    # Shared interpolation from the first k received rows: coeffs = inv(V) @ R.
+    Vk = np.ones((k, k), dtype=np.int64)
+    for j in range(1, k):
+        Vk[:, j] = gf.mul(Vk[:, j - 1], xs[:k])
+    # matvec_stripes (not matmul) keeps the (rows, k, S) product intermediate
+    # row-blocked — S can be millions of symbols on the FEC fallback path.
+    coeffs = gf.matvec_stripes(gf_inv(gf, Vk), R[:k])  # (k, S)
+
+    Vm = np.ones((m, k), dtype=np.int64)
+    for j in range(1, k):
+        Vm[:, j] = gf.mul(Vm[:, j - 1], xs)
+    predicted = gf.matvec_stripes(Vm, coeffs).astype(np.int64)
+    bad = np.nonzero(np.any(predicted != R, axis=0))[0]
+    coeffs = coeffs.astype(gf.dtype)
+    for col in bad:
+        fixed = bw_correct_column(gf, xs, R[:, col], k)
+        if fixed is None:
+            return None
+        coeffs[:, col] = fixed
+
+    if kind == "vandermonde_raw":
+        return coeffs
+    # Systematic kinds: d_j = f(j) / N_j for data positions 0..k-1.
+    Vd = np.ones((k, k), dtype=np.int64)
+    pts = np.arange(k, dtype=np.int64)
+    for j in range(1, k):
+        Vd[:, j] = gf.mul(Vd[:, j - 1], pts)
+    vals = gf.matvec_stripes(Vd, coeffs)  # (k, S) f(j)
+    return gf.div(vals, N[:k][:, None])

@@ -1,0 +1,250 @@
+"""Berlekamp-Welch decoder (matrix/bw.py) vs the golden subset search.
+
+The reference's codec corrects errors per byte offset (infectious's Decode,
+called at /root/reference/main.go:77): up to floor((m - k)/2) corrupted
+shares *per column*, where the corrupt set may differ column to column.
+These tests pin that guarantee on every MDS GRS construction and both
+fields, including the scattered-corruption cases the golden subset search
+(whole-share corruption model) cannot express.
+"""
+
+import numpy as np
+import pytest
+
+from noise_ec_tpu.gf.field import GF256, GF65536
+from noise_ec_tpu.golden.codec import GoldenCodec, TooManyErrorsError
+from noise_ec_tpu.matrix.bw import (
+    bw_correct_column,
+    bw_decode_stripes,
+    gf_solve_any,
+    grs_normalizers,
+    poly_divmod,
+    poly_eval,
+)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0x5EED)
+
+
+# -- primitive helpers ------------------------------------------------------
+
+
+def test_gf_solve_any_square_and_rank_deficient(rng):
+    gf = GF256()
+    A = rng.integers(1, 256, size=(5, 5), dtype=np.int64)
+    x = rng.integers(0, 256, size=5, dtype=np.int64)
+    b = gf.matmul(A, x[:, None])[:, 0]
+    got = gf_solve_any(gf, A, b)
+    assert got is not None
+    np.testing.assert_array_equal(gf.matmul(A, got[:, None])[:, 0], b)
+    # Duplicate a row: still consistent, rank-deficient.
+    A2 = np.concatenate([A, A[:1]], axis=0)
+    b2 = np.concatenate([b, b[:1]])
+    got2 = gf_solve_any(gf, A2, b2)
+    assert got2 is not None
+    np.testing.assert_array_equal(gf.matmul(A2, got2[:, None])[:, 0], b2)
+    # Contradictory duplicate: inconsistent.
+    b3 = b2.copy()
+    b3[-1] ^= 1
+    assert gf_solve_any(gf, A2, b3) is None
+
+
+def test_poly_divmod_and_eval_roundtrip(rng):
+    gf = GF256()
+    f = rng.integers(0, 256, size=4, dtype=np.int64)
+    E = np.array([7, 1, 1], dtype=np.int64)  # monic quadratic
+    # num = f * E via evaluation-free schoolbook convolution over GF.
+    num = np.zeros(len(f) + len(E) - 1, dtype=np.int64)
+    for i, fi in enumerate(f):
+        for j, ej in enumerate(E):
+            num[i + j] ^= int(gf.mul(fi, ej))
+    q, r = poly_divmod(gf, num, E)
+    assert not np.any(r)
+    np.testing.assert_array_equal(q[: len(f)], f.astype(gf.dtype))
+    xs = np.arange(10, dtype=np.int64)
+    lhs = poly_eval(gf, num, xs)
+    rhs = gf.mul(poly_eval(gf, f, xs), poly_eval(gf, E, xs))
+    np.testing.assert_array_equal(lhs, rhs)
+
+
+@pytest.mark.parametrize("kind", ["cauchy", "vandermonde", "vandermonde_raw"])
+def test_grs_normalizers_linearize_the_code(rng, kind):
+    """N[pos] * codeword[pos] must equal f(pos) for one common f: check that
+    the normalized codeword of random data lies on a degree-<k polynomial by
+    interpolating from the first k positions and re-evaluating everywhere."""
+    gf = GF256()
+    k, n = 5, 11
+    c = GoldenCodec(k, n, matrix=kind)
+    data = rng.integers(0, 256, size=(k, 3), dtype=np.int64).astype(np.uint8)
+    cw = c.encode_all(data)
+    N = grs_normalizers(gf, kind, k, n)
+    R = gf.mul(N[:, None], cw).astype(np.int64)
+    out = bw_decode_stripes(gf, kind, k, n, list(range(n)), cw)
+    np.testing.assert_array_equal(out, data)
+    # Direct polynomial check on column 0.
+    from noise_ec_tpu.matrix.linalg import gf_inv
+
+    Vk = np.ones((k, k), dtype=np.int64)
+    for j in range(1, k):
+        Vk[:, j] = gf.mul(Vk[:, j - 1], np.arange(k, dtype=np.int64))
+    coeffs = gf.matmul(gf_inv(gf, Vk), R[:k, :1])[:, 0]
+    np.testing.assert_array_equal(
+        poly_eval(gf, coeffs, np.arange(n, dtype=np.int64)), R[:, 0].astype(gf.dtype)
+    )
+
+
+def test_grs_normalizers_reject_par1():
+    with pytest.raises(ValueError, match="no GRS representation"):
+        grs_normalizers(GF256(), "par1", 4, 6)
+
+
+# -- column-level BW --------------------------------------------------------
+
+
+@pytest.mark.parametrize("m,k", [(6, 4), (10, 4), (14, 10), (7, 3)])
+def test_bw_column_corrects_up_to_radius(rng, m, k):
+    gf = GF256()
+    e = (m - k) // 2
+    xs = rng.permutation(np.arange(256, dtype=np.int64))[:m]
+    f = rng.integers(0, 256, size=k, dtype=np.int64)
+    R = poly_eval(gf, f, xs).astype(np.int64)
+    for t in range(e + 1):
+        Rt = R.copy()
+        for pos in rng.permutation(m)[:t]:
+            Rt[pos] ^= int(rng.integers(1, 256))
+        got = bw_correct_column(gf, xs, Rt, k)
+        assert got is not None, (m, k, t)
+        np.testing.assert_array_equal(got, f.astype(gf.dtype))
+
+
+def test_bw_column_rejects_beyond_radius(rng):
+    gf = GF256()
+    m, k = 10, 4
+    e = (m - k) // 2
+    xs = np.arange(m, dtype=np.int64)
+    f = rng.integers(0, 256, size=k, dtype=np.int64)
+    R = poly_eval(gf, f, xs).astype(np.int64)
+    bad = rng.permutation(m)[: e + 1]
+    for pos in bad:
+        R[pos] ^= int(rng.integers(1, 256))
+    got = bw_correct_column(gf, xs, R, k)
+    # Beyond the unique-decoding radius: either rejected, or (if the noise
+    # happened to land near another codeword) NOT silently wrong about f —
+    # it must disagree with <= e of the received values.
+    if got is not None:
+        agree = int(np.sum(poly_eval(gf, got, xs).astype(np.int64) == R))
+        assert agree >= m - e
+
+
+# -- stripes-level decode ---------------------------------------------------
+
+
+@pytest.mark.parametrize("kind", ["cauchy", "vandermonde"])
+@pytest.mark.parametrize("field", ["gf256", "gf65536"])
+def test_bw_scattered_corruption_recovers(rng, kind, field):
+    """Per-column radius: a different corrupted share per column — more total
+    corrupt shares than floor((m-k)/2) — still decodes (the subset search
+    cannot: no single k-subset of shares is clean on every column)."""
+    gf = GF256() if field == "gf256" else GF65536()
+    k, n, S = 4, 8, 32
+    c = GoldenCodec(k, n, field=field, matrix=kind)
+    data = rng.integers(0, gf.order, size=(k, S), dtype=np.int64).astype(gf.dtype)
+    cw = c.encode_all(data).astype(np.int64)
+    # Corrupt 2 symbols per column (radius (8-4)//2 = 2), rotating rows.
+    for col in range(S):
+        for j in range(2):
+            row = (col + j * 3) % n
+            cw[row, col] ^= int(rng.integers(1, gf.order))
+    out = bw_decode_stripes(gf, kind, k, n, list(range(n)), cw.astype(gf.dtype))
+    np.testing.assert_array_equal(out, data)
+
+
+def test_bw_matches_subset_search_on_share_level_corruption(rng):
+    gf = GF256()
+    k, n, S = 4, 9, 16
+    c = GoldenCodec(k, n)
+    data = rng.integers(0, 256, size=(k, S), dtype=np.int64).astype(np.uint8)
+    cw = c.encode_all(data)
+    cw_bad = cw.astype(np.int64)
+    cw_bad[2] ^= rng.integers(1, 256, size=S)  # whole-share corruption
+    cw_bad[6] ^= rng.integers(1, 256, size=S)
+    pairs = [(i, cw_bad[i].astype(np.uint8)) for i in range(n)]
+    via_subset = c.decode_shares(pairs)
+    via_bw = c.decode_shares_bw(pairs)
+    np.testing.assert_array_equal(via_subset, data)
+    np.testing.assert_array_equal(via_bw, data)
+
+
+def test_bw_raises_beyond_radius(rng):
+    k, n, S = 4, 6, 8
+    c = GoldenCodec(k, n)
+    data = rng.integers(0, 256, size=(k, S), dtype=np.int64).astype(np.uint8)
+    cw = c.encode_all(data).astype(np.int64)
+    for row in (0, 2, 4):  # 3 errors > radius (6-4)//2 = 1
+        cw[row] ^= rng.integers(1, 256, size=S)
+    with pytest.raises(TooManyErrorsError):
+        c.decode_shares_bw([(i, cw[i].astype(np.uint8)) for i in range(n)])
+
+
+def test_bw_vandermonde_raw_returns_coefficients(rng):
+    gf = GF256()
+    k, n, S = 3, 7, 5
+    c = GoldenCodec(k, n, matrix="vandermonde_raw")
+    coeffs = rng.integers(0, 256, size=(k, S), dtype=np.int64).astype(np.uint8)
+    cw = c.encode_all(coeffs).astype(np.int64)
+    cw[1] ^= rng.integers(1, 256, size=S)  # one corrupt share, radius 2
+    out = bw_decode_stripes(
+        gf, "vandermonde_raw", k, n, list(range(n)), cw.astype(np.uint8)
+    )
+    np.testing.assert_array_equal(out, coeffs)
+
+
+def test_bw_exact_k_no_redundancy(rng):
+    """m == k: plain interpolation, nothing to correct."""
+    k, n, S = 4, 6, 8
+    c = GoldenCodec(k, n)
+    data = rng.integers(0, 256, size=(k, S), dtype=np.int64).astype(np.uint8)
+    cw = c.encode_all(data)
+    out = c.decode_shares_bw([(i, cw[i]) for i in (0, 2, 4, 5)])
+    np.testing.assert_array_equal(out, data)
+
+
+# -- FEC integration --------------------------------------------------------
+
+
+def test_fec_decode_routes_inconsistent_shares_to_bw(rng):
+    from noise_ec_tpu.codec.fec import FEC, Share
+
+    fec = FEC(4, 8, backend="numpy")
+    data = bytes(rng.integers(0, 256, size=64).astype(np.uint8))
+    shares = fec.encode_shares(data)
+    # Corrupt two whole shares (radius (8-4)//2 = 2).
+    bad = []
+    for s in shares:
+        if s.number in (1, 5):
+            flipped = bytes(b ^ 0xA5 for b in s.data)
+            bad.append(Share(s.number, flipped))
+        else:
+            bad.append(s)
+    assert fec.decode(bad) == data
+    assert fec.stats["bw_decodes"] == 1
+    assert fec.stats["subset_decodes"] == 0
+
+
+def test_fec_par1_still_uses_subset_search(rng):
+    from noise_ec_tpu.codec.fec import FEC
+
+    fec = FEC(4, 8, matrix="par1", backend="numpy")
+    data = bytes(rng.integers(0, 256, size=64).astype(np.uint8))
+    shares = fec.encode_shares(data)
+    from noise_ec_tpu.codec.fec import Share
+
+    bad = [
+        Share(s.number, bytes(b ^ 0x3C for b in s.data)) if s.number == 2 else s
+        for s in shares
+    ]
+    assert fec.decode(bad) == data
+    assert fec.stats["subset_decodes"] == 1
+    assert fec.stats["bw_decodes"] == 0
