@@ -31,7 +31,7 @@ import numpy as np
 
 from noise_ec_tpu.codec.rs import ReedSolomon
 from noise_ec_tpu.golden.codec import GoldenCodec, NotEnoughShardsError, TooManyErrorsError
-from noise_ec_tpu.matrix.bw import bw_decode_stripes, grs_normalizers
+from noise_ec_tpu.matrix.bw import grs_normalizers
 from noise_ec_tpu.matrix.linalg import gf_inv
 
 __all__ = ["FEC", "Share", "NotEnoughShardsError", "TooManyErrorsError"]
@@ -133,8 +133,9 @@ class FEC:
         agree — runs on the configured backend: the k x k submatrix inverse
         is computed on the host (tiny, O(k^3)) and the inverse x survivors
         multiply plus the consistency re-encode run on the device codec.
-        Only inconsistent share sets (corruption within the decoding
-        radius) drop to the golden consistent-subset search.
+        Inconsistent share sets (corruption within the decoding radius)
+        drop to per-column Berlekamp-Welch (matrix/bw.py) on the MDS GRS
+        constructions; only par1 uses the golden consistent-subset search.
         """
         dedup: dict[int, np.ndarray] = {}
         for s in shares:
@@ -158,25 +159,14 @@ class FEC:
         if fast is not None:
             self.stats["fast_decodes"] += 1
             return np.ascontiguousarray(fast).tobytes()
+        pairs = [(i, dedup[i]) for i in nums]
         if self._mds_grs:
             # Inconsistent shares on an MDS construction: polynomial-time
             # per-column Berlekamp-Welch (what infectious runs, main.go:77).
-            # ``dedup`` is already validated, so call the stripes-level
-            # entry directly rather than re-deduping via decode_shares_bw.
             self.stats["bw_decodes"] += 1
-            data = bw_decode_stripes(
-                self._golden.gf, self._golden.matrix_kind, self.k, self.n,
-                nums, np.stack([dedup[i] for i in nums]),
-            )
-            if data is None:
-                m = len(nums)
-                raise TooManyErrorsError(
-                    f"some column has more than {(m - self.k) // 2} errors "
-                    f"(m={m}, k={self.k})"
-                )
+            data = self._golden.decode_shares_bw(pairs)
         else:
             self.stats["subset_decodes"] += 1
-            pairs = [(i, dedup[i]) for i in nums]
             data = self._golden.decode_shares(pairs)  # (k, S) symbol rows
         return np.ascontiguousarray(data).tobytes()
 
@@ -185,8 +175,9 @@ class FEC:
     ) -> Optional[np.ndarray]:
         """Backend-accelerated decode of the first k distinct shares,
         accepted only if every received share agrees with the result.
-        Returns None (caller falls back to subset search) on a singular
-        basis (non-MDS matrices) or any disagreement."""
+        Returns None (caller falls back to Berlekamp-Welch, or subset
+        search for par1) on a singular basis (non-MDS matrices) or any
+        disagreement."""
         G = self._golden.G
         basis = nums[: self.k]
         try:

@@ -205,29 +205,67 @@ def bw_decode_stripes(
     m, S = stripes.shape
     if m < k:
         raise ValueError(f"need >= {k} rows, got {m}")
+    e = (m - k) // 2
     N = grs_normalizers(gf, kind, k, n)
     xs = np.asarray(nums, dtype=np.int64)
     R = gf.mul(N[xs][:, None], stripes).astype(np.int64)  # (m, S) f(x_i) + err
 
-    # Shared interpolation from the first k received rows: coeffs = inv(V) @ R.
-    Vk = np.ones((k, k), dtype=np.int64)
-    for j in range(1, k):
-        Vk[:, j] = gf.mul(Vk[:, j - 1], xs[:k])
-    # matvec_stripes (not matmul) keeps the (rows, k, S) product intermediate
-    # row-blocked — S can be millions of symbols on the FEC fallback path.
-    coeffs = gf.matvec_stripes(gf_inv(gf, Vk), R[:k])  # (k, S)
-
     Vm = np.ones((m, k), dtype=np.int64)
     for j in range(1, k):
         Vm[:, j] = gf.mul(Vm[:, j - 1], xs)
-    predicted = gf.matvec_stripes(Vm, coeffs).astype(np.int64)
-    bad = np.nonzero(np.any(predicted != R, axis=0))[0]
+
+    def interpolate_from(basis: list[int], cols=None) -> np.ndarray:
+        """Vectorized degree-<k fit through ``basis`` rows.
+
+        ``cols`` restricts the fit to a column subset (pass 2 touches only
+        the columns pass 1 rejected, not all S of them)."""
+        Vb = np.ones((k, k), dtype=np.int64)
+        for j in range(1, k):
+            Vb[:, j] = gf.mul(Vb[:, j - 1], xs[basis])
+        src = R[basis] if cols is None else R[np.ix_(basis, cols)]
+        # matvec_stripes (not matmul) keeps the (rows, k, S) intermediate
+        # row-blocked — S can be millions of symbols on the FEC fallback.
+        return gf.matvec_stripes(gf_inv(gf, Vb), src)  # (k, len(cols) or S)
+
+    def disagreements(cand: np.ndarray, cols=None) -> np.ndarray:
+        """Per-column count of received rows the candidate disagrees with."""
+        predicted = gf.matvec_stripes(Vm, cand).astype(np.int64)
+        ref = R if cols is None else R[:, cols]
+        return np.sum(predicted != ref, axis=0)
+
+    # Pass 1 — interpolate from the first k rows. Any degree-<k polynomial
+    # is a codeword, and distinct codewords differ in >= m-k+1 > 2e rows,
+    # so a candidate within Hamming distance e of a column IS that column's
+    # unique decode: accept every column with <= e disagreements.
+    coeffs = interpolate_from(list(range(k)))
+    bad = np.nonzero(disagreements(coeffs) > e)[0]
     coeffs = coeffs.astype(gf.dtype)
-    for col in bad:
-        fixed = bw_correct_column(gf, xs, R[:, col], k)
-        if fixed is None:
+
+    if len(bad):
+        # Pass 2 — the basis itself was poisoned. Under whole-share
+        # corruption (the common case: a peer ships garbage) the same rows
+        # are wrong in every column, so ONE per-column solve identifies
+        # them; re-fit without those rows and re-apply the distance test.
+        # Only genuinely scattered corruption pays the per-column loop.
+        f0 = bw_correct_column(gf, xs, R[:, bad[0]], k)
+        if f0 is None:
             return None
-        coeffs[:, col] = fixed
+        suspect = set(
+            np.nonzero(poly_eval(gf, f0, xs).astype(np.int64) != R[:, bad[0]])[0].tolist()
+        )
+        coeffs[:, bad[0]] = f0
+        bad = bad[1:]
+        clean = [i for i in range(m) if i not in suspect]
+        if len(bad) and suspect and len(clean) >= k:
+            refit = interpolate_from(clean[:k], cols=bad)
+            ok = disagreements(refit, cols=bad) <= e
+            coeffs[:, bad[ok]] = refit[:, ok].astype(gf.dtype)
+            bad = bad[~ok]
+        for col in bad:
+            fixed = bw_correct_column(gf, xs, R[:, col], k)
+            if fixed is None:
+                return None
+            coeffs[:, col] = fixed
 
     if kind == "vandermonde_raw":
         return coeffs

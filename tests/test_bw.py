@@ -161,6 +161,45 @@ def test_bw_scattered_corruption_recovers(rng, kind, field):
     np.testing.assert_array_equal(out, data)
 
 
+def test_bw_whole_share_corruption_large_stripes_fast_path(rng):
+    """Whole-share corruption on wide stripes must take the sample-column +
+    refit path (one Python solve), not a per-column Gauss loop: 200k columns
+    with two fully corrupt shares — one inside the interpolation basis —
+    decodes in vectorized time."""
+    import time
+
+    gf = GF256()
+    k, n, S = 4, 8, 200_000
+    c = GoldenCodec(k, n)
+    data = rng.integers(0, 256, size=(k, S), dtype=np.int64).astype(np.uint8)
+    cw = c.encode_all(data).astype(np.int64)
+    cw[1] ^= rng.integers(1, 256, size=S)  # poisons the first-k basis
+    cw[6] ^= rng.integers(1, 256, size=S)
+    t0 = time.monotonic()
+    out = bw_decode_stripes(gf, "cauchy", k, n, list(range(n)), cw.astype(np.uint8))
+    elapsed = time.monotonic() - t0
+    np.testing.assert_array_equal(out, data)
+    # Per-column BW would take minutes here; the vectorized path takes well
+    # under a second. Generous bound to stay unflaky on slow CI.
+    assert elapsed < 10.0, f"whole-share fast path regressed: {elapsed:.1f}s"
+
+
+def test_bw_mixed_whole_share_and_scattered(rng):
+    """Pass-2 refit plus residual per-column BW: one share corrupt
+    everywhere, a second share corrupt only on some columns."""
+    gf = GF256()
+    k, n, S = 4, 8, 64
+    c = GoldenCodec(k, n)
+    data = rng.integers(0, 256, size=(k, S), dtype=np.int64).astype(np.uint8)
+    cw = c.encode_all(data).astype(np.int64)
+    cw[0] ^= rng.integers(1, 256, size=S)  # whole-share
+    scatter = rng.permutation(S)[: S // 3]
+    for col in scatter:  # second error on a rotating row per column
+        cw[2 + (col % 5), col] ^= int(rng.integers(1, 256))
+    out = bw_decode_stripes(gf, "cauchy", k, n, list(range(n)), cw.astype(np.uint8))
+    np.testing.assert_array_equal(out, data)
+
+
 def test_bw_matches_subset_search_on_share_level_corruption(rng):
     gf = GF256()
     k, n, S = 4, 9, 16
