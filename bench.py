@@ -30,7 +30,7 @@ import numpy as np
 NORTH_STAR_GBPS = 40.0
 
 
-def chained_seconds_per_iter(make_encode, x, n_lo=10, n_hi=None, reps=5):
+def chained_seconds_per_iter(make_encode, x, n_lo=10, n_hi=None, reps=7):
     """Median slope timing of one fused encode, chained inside fori_loop.
 
     The chain XORs 128 words of the output back into the input: iteration
@@ -39,15 +39,16 @@ def chained_seconds_per_iter(make_encode, x, n_lo=10, n_hi=None, reps=5):
     chain itself adds negligible traffic. This measures encode alone, the
     same contract klauspost's Encode() benchmarks time.
 
-    n_hi is sized so the measured window is ~25 ms assuming ~250 GB/s —
-    multi-ms RPC jitter on the axon tunnel otherwise swamps fast configs
-    (small payloads ran "negative" slopes with a fixed n_hi).
+    n_hi is sized so the measured window is ~40 ms assuming ~600 GB/s
+    (the fused+factored kernel's ballpark) — multi-ms RPC jitter on the
+    axon tunnel otherwise swamps fast configs (small payloads ran
+    "negative" slopes with a fixed n_hi).
     """
     import jax
     from jax import lax
 
     if n_hi is None:
-        n_hi = n_lo + max(50, min(2000, int(0.025 * 250e9 / max(x.nbytes, 1))))
+        n_hi = n_lo + max(50, min(4000, int(0.040 * 600e9 / max(x.nbytes, 1))))
 
     def mk(N):
         @jax.jit

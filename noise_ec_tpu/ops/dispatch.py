@@ -88,8 +88,21 @@ def _fused_words_pipeline(r: int, m: int, bits_rows: tuple, interpret: bool):
     )
 
     def f(words):
+        from noise_ec_tpu.ops.pallas_fused import fused_encode_words, fused_lane_tl
+
         k, TW = words.shape
         W8 = TW // (8 * m)
+        # Tier 1: single fused kernel (pack -> matmul -> unpack in VMEM,
+        # no HBM intermediates — 1.4D total traffic instead of 4.2D). Only
+        # the tile-fit probe is guarded: a ValueError out of the kernel
+        # build itself is a real bug and must surface.
+        try:
+            fused_lane_tl(TW, m, k, r)
+        except ValueError:
+            pass
+        else:
+            return fused_encode_words(bits_rows, words, r, m, interpret=interpret)
+        # Tier 2: three-kernel lane pipeline (packed planes round-trip HBM).
         mr = max(k, r)  # ONE rows budget -> ONE TL for pack AND unpack
         try:
             _lane_tl(TW, m, mr)

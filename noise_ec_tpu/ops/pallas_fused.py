@@ -1,0 +1,124 @@
+"""Single-kernel fused encode: pack -> GF(2) matmul -> unpack, one launch.
+
+The three-kernel words pipeline (ops/dispatch.py) round-trips both packed
+operands through HBM: for RS(10,4) on D data bytes it moves D (pack read)
++ D (pack write) + D (matmul read) + 0.4D (matmul write) + 0.4D (unpack
+read) + 0.4D (unpack write) = 4.2D of HBM traffic to produce 0.4D of
+parity. This kernel keeps the packed planes in VMEM scratch and moves
+exactly D + 0.4D: per grid step it
+
+1. packs the (k, 8*m*TL) input slab with the lane-axis delta-swap
+   (pallas_pack.lane_delta_swap — same bijection as the standalone
+   kernels),
+2. runs the geometry-baked XOR chains of the sparse matmul on the
+   scratch-resident (k*m, 8, TL) plane tiles,
+3. applies the inverse delta-swap (an involution) to the (r, m, 8, TL)
+   parity planes and writes parity WORDS straight to the output block.
+
+The layout contract is identical to the three-kernel path (the hot-path
+unit tests compare both against the golden codec), so DeviceCodec can pick
+whichever fits VMEM: the fused kernel needs in + out blocks (double-
+buffered) plus both plane scratches resident at once, so very wide codes
+fall back to the pipeline. Reference hot loop: /root/reference/main.go:262.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from noise_ec_tpu.ops.pallas_pack import (
+    _ROUNDS,
+    _ROUNDS16,
+    _pack_lanes_kernel,
+    _unpack_lanes_kernel,
+)
+from noise_ec_tpu.ops.xor_factor import eval_bits_rows
+
+# The accounted working set (in/out blocks double-buffered + both plane
+# scratches) understates Mosaic's true scoped-vmem stack by ~60%: the
+# delta-swap rounds and XOR network keep (rows, m*TL) temporaries live.
+# 8 MiB accounted leaves headroom under the 16 MiB hardware limit
+# (GF(2^16) RS(10,4) at TL=512 OOMed with a 12 MiB budget: 17.97M scoped).
+_FUSED_VMEM_BUDGET = 8 << 20
+
+
+def fused_lane_tl(TW: int, m: int, k: int, r: int) -> int:
+    """Largest TL in {512, 256, 128} whose fused working set fits VMEM.
+
+    Working set per lane of tile: in block (k rows) and out block (r rows)
+    are double-buffered by the grid pipeline; the two plane scratches
+    (k and r rows) are single-buffered.
+    """
+    W8 = TW // (8 * m)
+    per_lane = 4 * 8 * m * (2 * k + 2 * r + k + r)
+    for TL in (512, 256, 128):
+        if W8 % TL == 0 and per_lane * TL <= _FUSED_VMEM_BUDGET:
+            return TL
+    raise ValueError(
+        f"no fused tile for TW={TW}, m={m}, k={k}, r={r} "
+        f"(need TW % {1024 * m} == 0 and a tile within VMEM)"
+    )
+
+
+def _fused_kernel(m, TL, rounds, bits_rows, in_ref, out_ref, pk_ref, po_ref):
+    k = in_ref.shape[0]
+    # 1. pack into VMEM scratch — the standalone lane-pack kernel body,
+    # pointed at the scratch ref instead of an HBM-backed output block.
+    _pack_lanes_kernel(m, TL, rounds, in_ref, pk_ref)
+    # 2. geometry-baked sparse GF(2) matmul on (8, TL) plane tiles, with
+    # Paar common-subexpression factoring (~2-3x fewer XORs).
+    outs = eval_bits_rows(
+        bits_rows, k * m,
+        lambda c: pk_ref[c // m, c % m, :, :],
+        lambda: jnp.zeros((8, TL), dtype=jnp.uint32),
+    )
+    for row, val in enumerate(outs):
+        po_ref[row // m, row % m, :, :] = val
+    # 3. unpack scratch parity planes -> output words (same sharing).
+    _unpack_lanes_kernel(m, TL, rounds, po_ref, out_ref)
+
+
+@functools.lru_cache(maxsize=512)
+def _fused_call(bits_rows: tuple, k: int, r: int, TW: int, m: int,
+                interpret: bool):
+    TL = fused_lane_tl(TW, m, k, r)
+    rounds = _ROUNDS if m == 8 else _ROUNDS16
+    return pl.pallas_call(
+        functools.partial(_fused_kernel, m, TL, rounds, bits_rows),
+        grid=(TW // (8 * m * TL),),
+        in_specs=[
+            pl.BlockSpec((k, 8 * m * TL), lambda c: (0, c),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((r, 8 * m * TL), lambda c: (0, c),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((r, TW), jnp.uint32),
+        scratch_shapes=[
+            pltpu.VMEM((k, m, 8, TL), jnp.uint32),
+            pltpu.VMEM((r, m, 8, TL), jnp.uint32),
+        ],
+        interpret=interpret,
+    )
+
+
+def fused_encode_words(
+    bits_rows: tuple,  # STATIC (r*m)-row term tuples over k*m plane rows
+    words: jnp.ndarray,  # (k, TW) uint32
+    r: int,
+    m: int = 8,
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """(k, TW) uint32 data words -> (r, TW) uint32 parity words, one launch.
+
+    TW must be a multiple of ``lane_quantum(m)`` = 1024*m (callers pad).
+    Raises ValueError when no tile fits VMEM — callers fall back to the
+    three-kernel pipeline.
+    """
+    k, TW = words.shape
+    return _fused_call(bits_rows, k, r, TW, m, interpret)(words)

@@ -117,27 +117,27 @@ def tiled_to_planes(tiled: jnp.ndarray, num_words: int) -> jnp.ndarray:
     return tiled.reshape(C, -1)[:, :num_words]
 
 
-def _make_sparse_kernel(bits_rows: tuple[tuple[int, ...], ...], sublanes: int, TL: int):
+def _make_sparse_kernel(bits_rows: tuple[tuple[int, ...], ...], C: int,
+                        sublanes: int, TL: int):
     """bits_rows[r] = tuple of input-row indices feeding output row r.
 
     Measured-on-v5e structure (see git history for the experiment): hoist ONE
-    VMEM read per input plane per grid step, then serial XOR chains per
-    output row. Per-row reads (C*density loads instead of C) cost 4x; tree
-    reduction instead of chains costs ~25%. This shape runs at the HBM
-    roofline (~650 GB/s data-in for RS(10,4)).
+    VMEM read per input plane per grid step, then XOR evaluation per output
+    row through the Paar-factored network (ops/xor_factor.py, ~2-3x fewer
+    XORs than the raw chains). Per-row reads (C*density loads instead of C)
+    cost 4x; tree reduction instead of chains costs ~25%. This shape runs at
+    the HBM roofline (~650 GB/s data-in for RS(10,4)).
     """
-    used = sorted({c for terms in bits_rows for c in terms})
+    from noise_ec_tpu.ops.xor_factor import eval_bits_rows
 
     def kernel(planes_ref, out_ref):
-        vs = {c: planes_ref[c, :, :] for c in used}
-        for r, terms in enumerate(bits_rows):
-            if not terms:
-                out_ref[r, :, :] = jnp.zeros((sublanes, TL), dtype=jnp.uint32)
-                continue
-            acc = vs[terms[0]]
-            for c in terms[1:]:
-                acc = acc ^ vs[c]
-            out_ref[r, :, :] = acc
+        outs = eval_bits_rows(
+            bits_rows, C,
+            lambda c: planes_ref[c, :, :],
+            lambda: jnp.zeros((sublanes, TL), dtype=jnp.uint32),
+        )
+        for r, val in enumerate(outs):
+            out_ref[r, :, :] = val
 
     return kernel
 
@@ -146,7 +146,7 @@ def _make_sparse_kernel(bits_rows: tuple[tuple[int, ...], ...], sublanes: int, T
 def _sparse_call(bits_rows: tuple[tuple[int, ...], ...], C: int, W8: int, TL: int,
                  interpret: bool):
     R = len(bits_rows)
-    kernel = _make_sparse_kernel(bits_rows, 8, TL)
+    kernel = _make_sparse_kernel(bits_rows, C, 8, TL)
     grid = (W8 // TL,)
     return pl.pallas_call(
         kernel,

@@ -1,0 +1,161 @@
+"""Greedy common-subexpression factoring for GF(2) XOR networks.
+
+The geometry-baked kernels evaluate each output bit-plane as an XOR chain
+over the input planes with a set bit in the expanded generator matrix
+(gf/bitmatrix.py). For RS(10,4)/GF(2^8) that is a (32, 80) 0/1 matrix at
+~50% density: ~1,230 two-input XORs evaluated straight off the rows.
+Because generator rows are algebraically related, many column pairs
+co-occur in several rows; Paar's greedy algorithm (Paar 1997, "Optimized
+arithmetic for Reed-Solomon encoders") repeatedly materializes the most
+frequent pair as a shared temporary, typically cutting the XOR count by
+30-45% for these matrices. The factoring runs once per geometry at trace
+time (host side, tiny matrices) and is baked into the compiled program.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import Counter
+
+
+@functools.lru_cache(maxsize=512)
+def paar_factor(
+    bits_rows: tuple[tuple[int, ...], ...],
+    num_inputs: int,
+    min_freq: int = 2,
+    max_temps: int = 100_000,
+) -> tuple[tuple[tuple[int, int, int], ...], tuple[tuple[int, ...], ...]]:
+    """Factor shared pairs out of XOR rows.
+
+    Returns ``(ops, rows)``: ``ops`` is an ordered tuple of
+    ``(temp_id, a, b)`` meaning ``t[temp_id] = t[a] ^ t[b]`` (ids >=
+    ``num_inputs`` are temporaries, evaluated in order); ``rows[r]`` is the
+    remaining term tuple for output row r over inputs and temporaries.
+    Total two-input XORs = ``len(ops) + sum(max(len(row)-1, 0))``.
+
+    Incremental implementation: the pair-frequency table is built once and
+    updated only for the rows a factoring touches, with a lazy max-heap
+    over (frequency, pair); distinct pairs are bounded by the (small)
+    column count squared, not by rows x terms^2. ``min_freq`` stops the
+    factoring when the best pair saves fewer than ``min_freq - 1`` XORs
+    per round; ``max_temps`` bounds temporary count (VMEM pressure in the
+    baked kernels).
+    """
+    import heapq
+
+    import numpy as np
+
+    rows = [set(t) for t in bits_rows]
+    where: dict[int, set[int]] = {}  # column id -> set of row indices
+    for ri, s in enumerate(rows):
+        for c in s:
+            where.setdefault(c, set()).add(ri)
+    # Initial pair table via one co-occurrence matmul: distinct pairs are
+    # bounded by num_inputs^2, far below rows x terms^2 Counter updates.
+    M = np.zeros((len(rows), num_inputs), dtype=np.int32)
+    for ri, s in enumerate(rows):
+        M[ri, list(s)] = 1
+    P = M.T @ M
+    iu = np.triu_indices(num_inputs, k=1)
+    nz = P[iu] > 0
+    cnt: Counter = Counter(
+        {
+            (int(a), int(b)): int(f)
+            for a, b, f in zip(iu[0][nz], iu[1][nz], P[iu][nz])
+        }
+    )
+    heap = [(-f, p) for p, f in cnt.items()]
+    heapq.heapify(heap)
+
+    def bump(pair: tuple[int, int]) -> None:
+        cnt[pair] += 1
+        heapq.heappush(heap, (-cnt[pair], pair))
+
+    ops: list[tuple[int, int, int]] = []
+    next_id = num_inputs
+    while heap and len(ops) < max_temps:
+        negf, (a, b) = heapq.heappop(heap)
+        cur = cnt.get((a, b), 0)
+        if cur != -negf:  # stale lazy-heap entry
+            # Decrements don't push, so a pair whose only entry went stale
+            # would otherwise vanish from the heap while still profitable:
+            # re-enqueue it at its current count.
+            if cur >= min_freq:
+                heapq.heappush(heap, (-cur, (a, b)))
+            continue
+        if -negf < min_freq:
+            break
+        t = next_id
+        next_id += 1
+        ops.append((t, a, b))
+        affected = where[a] & where[b]
+        del cnt[(a, b)]
+        where[t] = set()
+        for ri in affected:
+            s = rows[ri]
+            s.discard(a)
+            s.discard(b)
+            for x in s:
+                pa = (min(a, x), max(a, x))
+                pb = (min(b, x), max(b, x))
+                cnt[pa] -= 1
+                cnt[pb] -= 1
+                bump((x, t))  # x < t always: temps get the largest ids
+            s.add(t)
+            where[a].discard(ri)
+            where[b].discard(ri)
+            where[t].add(ri)
+    return tuple(ops), tuple(tuple(sorted(s)) for s in rows)
+
+
+def eval_factored(ops, rows, get_input, make_zero):
+    """Evaluate a factored XOR network during kernel tracing.
+
+    ``get_input(c)`` fetches input plane c; ``make_zero()`` builds an
+    all-zero tile for empty rows. Returns the list of output-row values.
+    Temps live in the traced SSA graph — the dict here only spans tracing,
+    so compiled liveness is last-use, not whole-program.
+    """
+    vals: dict[int, object] = {}
+
+    def get(c):
+        return vals[c] if c in vals else get_input(c)
+
+    for t, a, b in ops:
+        vals[t] = get(a) ^ get(b)
+    outs = []
+    for terms in rows:
+        if not terms:
+            outs.append(make_zero())
+            continue
+        acc = get(terms[0])
+        for c in terms[1:]:
+            acc = acc ^ get(c)
+        outs.append(acc)
+    return outs
+
+
+def eval_bits_rows(bits_rows, C: int, get_plane, make_zero):
+    """Factor ``bits_rows`` and evaluate it over input planes 0..C-1.
+
+    The one entry point both baked kernels (the fused single-kernel encode
+    and the standalone sparse matmul) trace through: hoists each used input
+    plane once via ``get_plane``, then runs the factored network. Returns
+    the list of output-row values.
+    """
+    ops, rows = paar_factor(bits_rows, C)
+    used = {c for terms in rows for c in terms if c < C}
+    used |= {c for _, a, b in ops for c in (a, b) if c < C}
+    vs = {c: get_plane(c) for c in sorted(used)}
+    return eval_factored(ops, rows, vs.__getitem__, make_zero)
+
+
+def xor_cost(bits_rows: tuple[tuple[int, ...], ...]) -> int:
+    """Two-input XOR count of the unfactored row evaluation."""
+    return sum(max(len(t) - 1, 0) for t in bits_rows)
+
+
+def factored_cost(
+    ops: tuple[tuple[int, int, int], ...], rows: tuple[tuple[int, ...], ...]
+) -> int:
+    return len(ops) + sum(max(len(t) - 1, 0) for t in rows)

@@ -1,0 +1,97 @@
+"""Paar XOR-network factoring (ops/xor_factor.py).
+
+The baked Pallas kernels evaluate generator rows through the factored
+network; these tests pin its equivalence to the raw rows independently of
+any kernel (the kernel tests then cover the integration vs the golden
+codec).
+"""
+
+import numpy as np
+import pytest
+
+from noise_ec_tpu.ops.xor_factor import (
+    eval_factored,
+    factored_cost,
+    paar_factor,
+    xor_cost,
+)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0xFAC7)
+
+
+def _eval_rows(rows, inputs):
+    out = []
+    for terms in rows:
+        acc = np.zeros_like(inputs[0])
+        for c in terms:
+            acc = acc ^ inputs[c]
+        out.append(acc)
+    return out
+
+
+@pytest.mark.parametrize("R,C,density", [(8, 16, 0.5), (32, 80, 0.5), (16, 40, 0.15)])
+def test_factored_network_equivalent(rng, R, C, density):
+    bits = (rng.random((R, C)) < density).astype(np.uint8)
+    rows = tuple(tuple(int(c) for c in np.nonzero(bits[r])[0]) for r in range(R))
+    ops, rem = paar_factor(rows, C)
+    inputs = list(rng.integers(0, 1 << 32, size=(C, 64), dtype=np.uint64).astype(np.uint32))
+    want = _eval_rows(rows, inputs)
+    got = eval_factored(
+        ops, rem, lambda c: inputs[c], lambda: np.zeros(64, dtype=np.uint32)
+    )
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+    assert factored_cost(ops, rem) <= xor_cost(rows)
+
+
+def test_factoring_reduces_real_generator(rng):
+    """The RS(10,4)/GF(2^8) expansion must factor well below its raw cost
+    (the perf bet behind the baked kernels)."""
+    from noise_ec_tpu.gf.field import GF256
+    from noise_ec_tpu.gf.bitmatrix import expand_generator_bits
+    from noise_ec_tpu.matrix.generators import generator_matrix
+    from noise_ec_tpu.ops.pallas_gf2mm import bits_to_rows
+
+    gf = GF256()
+    G = generator_matrix(gf, 10, 14, "cauchy")
+    rows = bits_to_rows(expand_generator_bits(gf, G[10:]))
+    ops, rem = paar_factor(rows, 80)
+    assert factored_cost(ops, rem) < 0.6 * xor_cost(rows)
+    # Equivalence on the real matrix too.
+    inputs = list(rng.integers(0, 1 << 32, size=(80, 32), dtype=np.uint64).astype(np.uint32))
+    want = _eval_rows(rows, inputs)
+    got = eval_factored(
+        ops, rem, lambda c: inputs[c], lambda: np.zeros(32, dtype=np.uint32)
+    )
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
+
+
+def test_empty_and_singleton_rows(rng):
+    rows = ((), (3,), (1, 2), (1, 2, 3))
+    ops, rem = paar_factor(rows, 4)
+    inputs = list(rng.integers(0, 1 << 32, size=(4, 8), dtype=np.uint64).astype(np.uint32))
+    got = eval_factored(
+        ops, rem, lambda c: inputs[c], lambda: np.zeros(8, dtype=np.uint32)
+    )
+    np.testing.assert_array_equal(got[0], np.zeros(8, dtype=np.uint32))
+    np.testing.assert_array_equal(got[1], inputs[3])
+    np.testing.assert_array_equal(got[2], inputs[1] ^ inputs[2])
+    np.testing.assert_array_equal(got[3], inputs[1] ^ inputs[2] ^ inputs[3])
+
+
+def test_max_temps_bound(rng):
+    bits = (rng.random((32, 80)) < 0.5).astype(np.uint8)
+    rows = tuple(tuple(int(c) for c in np.nonzero(bits[r])[0]) for r in range(32))
+    ops, rem = paar_factor(rows, 80, 2, 10)  # max_temps=10
+    assert len(ops) <= 10
+    inputs = list(rng.integers(0, 1 << 32, size=(80 + 10, 16), dtype=np.uint64).astype(np.uint32))
+    want = _eval_rows(rows, inputs)
+    got = eval_factored(
+        ops, rem, lambda c: inputs[c], lambda: np.zeros(16, dtype=np.uint32)
+    )
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(w, g)
