@@ -281,35 +281,50 @@ class BatchCodec:
             ]
 
         def local_pallas(words_local):
+            from noise_ec_tpu.ops.pallas_fused import (
+                fused_encode_words,
+                fused_lane_tl,
+            )
+
             Bl, k, TW = words_local.shape
             TWp = quantize(TW)
             if TWp != TW:
                 words_local = jnp.pad(words_local, ((0, 0), (0, 0), (0, TWp - TW)))
             W8 = TWp // (8 * m)
 
-            mr = max(k, Rl)  # one TL for pack AND unpack (bijection match)
+            # Tier 1: the single fused kernel per row slice (pack -> matmul
+            # -> unpack in VMEM scratch; see ops/pallas_fused.py). Tier 2:
+            # the three-kernel lane pipeline when the fused tile cannot fit
+            # VMEM. Either way each device's row slice is its own baked
+            # program, selected with lax.switch (SPMD).
+            try:
+                fused_lane_tl(TWp, m, k, Rl)
+            except ValueError:
+                mr = max(k, Rl)  # one TL for pack AND unpack (bijection)
+
+                def encode_slice(w, rows):
+                    tiled = pack_words_lanes(
+                        w, m, rows_budget=mr, interpret=interpret
+                    )
+                    prod = gf2_matmul_pallas_sparse_rows(
+                        rows, tiled.reshape(k * m, 8, W8), interpret=interpret
+                    )
+                    return unpack_words_lanes(
+                        prod.reshape(Rl, m, 8, W8), rows_budget=mr,
+                        interpret=interpret
+                    )
+            else:
+                def encode_slice(w, rows):
+                    return fused_encode_words(rows, w, Rl, m, interpret=interpret)
 
             def one(w):
-                tiled = pack_words_lanes(
-                    w, m, rows_budget=mr, interpret=interpret
-                )
-                planes = tiled.reshape(k * m, 8, W8)
                 branches = [
-                    functools.partial(
-                        gf2_matmul_pallas_sparse_rows, rows,
-                        interpret=interpret,
-                    )
+                    (lambda w, rows=rows: encode_slice(w, rows))
                     for rows in row_groups
                 ]
                 if rsz == 1:
-                    prod = branches[0](planes)
-                else:
-                    idx = jax.lax.axis_index(row_axis)
-                    prod = jax.lax.switch(idx, branches, planes)
-                return unpack_words_lanes(
-                    prod.reshape(Rl, m, 8, W8), rows_budget=mr,
-                    interpret=interpret
-                )
+                    return branches[0](w)
+                return jax.lax.switch(jax.lax.axis_index(row_axis), branches, w)
 
             out = jax.vmap(one)(words_local)[:, :, :TW]
             if row_axis is not None:
