@@ -107,50 +107,6 @@ def test_varint_overflow_rejected():
         Shard.unmarshal(b"\x18" + b"\xff" * 11)
 
 
-def test_interop_with_protobuf_runtime():
-    """Cross-check against an independent proto3 implementation when
-    google.protobuf is importable: our bytes must parse there and re-serialize
-    to a message it round-trips (field numbers/types are the contract,
-    SURVEY.md §2.3 D4)."""
-    pytest.importorskip("google.protobuf")
-    from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
-
-    pool = descriptor_pool.DescriptorPool()
-    fd = descriptor_pb2.FileDescriptorProto()
-    fd.name = "shard_interop.proto"
-    fd.package = "erasurecode"
-    fd.syntax = "proto3"
-    m = fd.message_type.add()
-    m.name = "Shard"
-    for i, (name, ftype) in enumerate(
-        [
-            ("file_signature", descriptor_pb2.FieldDescriptorProto.TYPE_BYTES),
-            ("shard_data", descriptor_pb2.FieldDescriptorProto.TYPE_BYTES),
-            ("shard_number", descriptor_pb2.FieldDescriptorProto.TYPE_UINT64),
-            ("total_shards", descriptor_pb2.FieldDescriptorProto.TYPE_UINT64),
-            ("minimum_needed_shards", descriptor_pb2.FieldDescriptorProto.TYPE_UINT64),
-        ]
-    ):
-        f = m.field.add()
-        f.name = name
-        f.number = i + 1
-        f.type = ftype
-        f.label = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
-    pool.Add(fd)
-    cls = message_factory.GetMessageClass(pool.FindMessageTypeByName("erasurecode.Shard"))
-
-    rng = np.random.default_rng(99)
-    for _ in range(25):
-        ours = Shard.populate(rng)
-        theirs = cls.FromString(ours.marshal())
-        assert theirs.file_signature == ours.file_signature
-        assert theirs.shard_data == ours.shard_data
-        assert theirs.shard_number == ours.shard_number
-        assert theirs.total_shards == ours.total_shards
-        assert theirs.minimum_needed_shards == ours.minimum_needed_shards
-        assert Shard.unmarshal(theirs.SerializeToString()) == ours
-
-
 def test_shard_str_stringer():
     """C20 String() analogue: compact, log-friendly, mentions geometry."""
     s = Shard(file_signature=b"\xaa" * 64, shard_data=b"\x01\x02" * 20,
