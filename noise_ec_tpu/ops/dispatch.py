@@ -97,7 +97,7 @@ def _fused_words_pipeline(r: int, m: int, bits_rows: tuple, interpret: bool):
         # the tile-fit probe is guarded: a ValueError out of the kernel
         # build itself is a real bug and must surface.
         try:
-            fused_lane_tl(TW, m, k, r)
+            fused_lane_tl(TW, m, k, r, bits_rows)
         except ValueError:
             pass
         else:

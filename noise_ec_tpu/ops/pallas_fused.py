@@ -39,23 +39,29 @@ from noise_ec_tpu.ops.pallas_pack import (
 )
 from noise_ec_tpu.ops.xor_factor import eval_bits_rows
 
-# The accounted working set (in/out blocks double-buffered + both plane
-# scratches) understates Mosaic's true scoped-vmem stack by ~60%: the
-# delta-swap rounds and XOR network keep (rows, m*TL) temporaries live.
-# 8 MiB accounted leaves headroom under the 16 MiB hardware limit
-# (GF(2^16) RS(10,4) at TL=512 OOMed with a 12 MiB budget: 17.97M scoped).
-_FUSED_VMEM_BUDGET = 8 << 20
+# 1 MiB tighter than pallas_gf2mm's VMEM_BUDGET_BYTES: the fused kernel
+# additionally keeps delta-swap pack/unpack temporaries on the Mosaic stack,
+# which the shared Paar-temp estimate does not cover. Calibration anchors:
+# GF(2^16) RS(10,4) at TL=512 OOMed at 17.97M scoped and must be REJECTED
+# (accounted 14.44M > 13M); GF(2^8) RS(50,20) at TL=128 compiled and must be
+# ACCEPTED (accounted 12.75M <= 13M).
+_FUSED_VMEM_BUDGET = 13 << 20
 
 
-def fused_lane_tl(TW: int, m: int, k: int, r: int) -> int:
+def fused_lane_tl(TW: int, m: int, k: int, r: int, bits_rows: tuple) -> int:
     """Largest TL in {512, 256, 128} whose fused working set fits VMEM.
 
     Working set per lane of tile: in block (k rows) and out block (r rows)
     are double-buffered by the grid pipeline; the two plane scratches
-    (k and r rows) are single-buffered.
+    (k and r rows) are single-buffered; the Paar network's temporaries are
+    charged via the shared calibrated estimate (see pallas_gf2mm).
     """
+    from noise_ec_tpu.ops.pallas_gf2mm import xor_temp_bytes_per_lane
+
     W8 = TW // (8 * m)
-    per_lane = 4 * 8 * m * (2 * k + 2 * r + k + r)
+    per_lane = 4 * 8 * m * (2 * k + 2 * r + k + r) + xor_temp_bytes_per_lane(
+        bits_rows, k * m
+    )
     for TL in (512, 256, 128):
         if W8 % TL == 0 and per_lane * TL <= _FUSED_VMEM_BUDGET:
             return TL
@@ -86,7 +92,7 @@ def _fused_kernel(m, TL, rounds, bits_rows, in_ref, out_ref, pk_ref, po_ref):
 @functools.lru_cache(maxsize=512)
 def _fused_call(bits_rows: tuple, k: int, r: int, TW: int, m: int,
                 interpret: bool):
-    TL = fused_lane_tl(TW, m, k, r)
+    TL = fused_lane_tl(TW, m, k, r, bits_rows)
     rounds = _ROUNDS if m == 8 else _ROUNDS16
     return pl.pallas_call(
         functools.partial(_fused_kernel, m, TL, rounds, bits_rows),

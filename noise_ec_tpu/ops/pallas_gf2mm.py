@@ -32,7 +32,21 @@ DEFAULT_TILE_LANES = 512
 # 16 MiB; Pallas double-buffers grid inputs/outputs, so wide codes (e.g.
 # RS(50,20): 400 input + 160 output plane-rows) must shrink the lane tile
 # or the launch OOMs at compile time.
-VMEM_BUDGET_BYTES = 12 << 20
+VMEM_BUDGET_BYTES = 14 << 20
+# Paar temporaries ((8, TL) uint32 each) also live on the Mosaic stack.
+# Counting every temp at full size over-estimates (the allocator reuses
+# slots as liveness ends); 0.4 is calibrated against observed compiles:
+# RS(50,20) sparse at TL=256 OOMed at 24.7M scoped (must reject), the
+# fused RS(50,20) kernel at TL=128 compiled (must accept).
+TEMP_ALIVE_FRACTION = 0.4
+
+
+def xor_temp_bytes_per_lane(bits_rows: tuple, C: int) -> int:
+    """Estimated per-lane stack bytes of the factored network's temps."""
+    from noise_ec_tpu.ops.xor_factor import paar_factor
+
+    ops, _ = paar_factor(bits_rows, C)
+    return int(len(ops) * 8 * 4 * TEMP_ALIVE_FRACTION)
 
 
 def _kernel(maskT_ref, planes_ref, out_ref):
@@ -244,8 +258,11 @@ def gf2_matmul_pallas_sparse_rows(
     """
     C, sub, W8 = tiled_planes.shape
     assert sub == 8, tiled_planes.shape
-    # Double-buffered in+out bytes per lane of tile; cap TL to the budget.
-    per_lane = (C + len(bits_rows)) * sub * 4 * 2
+    # Double-buffered in+out bytes per lane of tile, plus the factored
+    # network's temporaries; cap TL to the budget.
+    per_lane = (C + len(bits_rows)) * sub * 4 * 2 + xor_temp_bytes_per_lane(
+        bits_rows, C
+    )
     cap = max(128, VMEM_BUDGET_BYTES // per_lane // 128 * 128)
     TL = min(tile_lanes, cap, max(128, -(-W8 // 128) * 128))
     W8p = -(-W8 // TL) * TL

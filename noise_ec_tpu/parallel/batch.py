@@ -298,7 +298,10 @@ class BatchCodec:
             # VMEM. Either way each device's row slice is its own baked
             # program, selected with lax.switch (SPMD).
             try:
-                fused_lane_tl(TWp, m, k, Rl)
+                # Every row slice must fit (slices bake separate programs
+                # with their own Paar temp pressure).
+                for rows in row_groups:
+                    fused_lane_tl(TWp, m, k, Rl, rows)
             except ValueError:
                 mr = max(k, Rl)  # one TL for pack AND unpack (bijection)
 
