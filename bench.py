@@ -232,6 +232,51 @@ def main() -> None:
         t_enc = (time.perf_counter() - t0) / 3
         gbps = data_bytes / t_enc / 1e9
 
+    # --- host-runtime story: full node round trip on the in-process
+    # loopback peer set (sign -> shard -> proto marshal -> dispatch ->
+    # reassemble -> Ed25519 verify), the reference's actual workload
+    # (main.go:175-198 send side, main.go:52-107 receive side).
+    try:
+        from noise_ec_tpu.host.plugin import ShardPlugin
+        from noise_ec_tpu.host.transport import LoopbackHub, LoopbackNetwork, format_address
+
+        # numpy codec backend: this stat isolates the HOST runtime overhead
+        # (signing, proto, mempool, dispatch). Small single messages over
+        # the axon tunnel are RTT-bound (~5 msg/s at 64 KiB), which says
+        # nothing about either the host code or the kernels — the device
+        # throughput stats above cover the codec.
+        hub = LoopbackHub()
+        recv_count = [0]
+        nodes = []
+        for i in range(2):
+            node = LoopbackNetwork(hub, format_address("tcp", "localhost", 3000 + i))
+            node.add_plugin(ShardPlugin(
+                backend="numpy",
+                on_message=lambda m, s: recv_count.__setitem__(0, recv_count[0] + 1),
+            ))
+            nodes.append(node)
+        # Distinct payloads: identical bytes share a file signature and the
+        # receiver's replay protection would (correctly) drop the repeats.
+        base = rng.integers(0, 256, size=64 << 10).astype(np.uint8)  # 64 KiB
+        n_msgs = 20
+        payloads = []
+        for i in range(n_msgs + 1):
+            b = base.copy()
+            b[:8] = np.frombuffer(i.to_bytes(8, "little"), dtype=np.uint8)
+            payloads.append(bytes(b))
+        send = nodes[0].plugins[0]
+        send.shard_and_broadcast(nodes[0], payloads[0])  # warm (jit, pools)
+        t0 = time.perf_counter()
+        for p in payloads[1:]:
+            send.shard_and_broadcast(nodes[0], p)
+        t_host = (time.perf_counter() - t0) / n_msgs
+        assert recv_count[0] == n_msgs + 1, recv_count
+        payload = payloads[0]
+        stats["host_node_roundtrip_msgs_per_s"] = round(1.0 / t_host, 1)
+        stats["host_node_roundtrip_mb_per_s"] = round(len(payload) / t_host / 1e6, 1)
+    except Exception as exc:  # noqa: BLE001 — secondary stat only
+        stats["host_node_error"] = str(exc)[:80]
+
     stats["encode_s"] = t_enc
     print(
         json.dumps(
