@@ -250,6 +250,71 @@ def test_bw_exact_k_no_redundancy(rng):
     np.testing.assert_array_equal(out, data)
 
 
+# -- property tests ---------------------------------------------------------
+
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+
+@settings(max_examples=30, deadline=None)
+@given(
+    k=st.integers(2, 12),
+    extra=st.integers(0, 8),
+    S=st.integers(1, 24),
+    kind=st.sampled_from(["cauchy", "vandermonde"]),
+    seed=st.integers(0, 2**31),
+)
+def test_bw_property_recovers_within_radius(k, extra, S, kind, seed):
+    """Any geometry, any per-column corruption pattern of weight <= e:
+    bit-exact recovery. Corruption weight varies per column and the corrupt
+    rows rotate, so most draws are patterns the whole-share fast path alone
+    cannot finish."""
+    prng = np.random.default_rng(seed)
+    n = k + extra
+    m = n  # receive all shares
+    e = (m - k) // 2
+    c = GoldenCodec(k, n, matrix=kind)
+    gf = c.gf
+    data = prng.integers(0, gf.order, size=(k, S), dtype=np.int64).astype(gf.dtype)
+    cw = c.encode_all(data).astype(np.int64)
+    for col in range(S):
+        t = int(prng.integers(0, e + 1))
+        for row in prng.permutation(n)[:t]:
+            cw[row, col] ^= int(prng.integers(1, gf.order))
+    out = bw_decode_stripes(gf, kind, k, n, list(range(n)), cw.astype(gf.dtype))
+    assert out is not None
+    np.testing.assert_array_equal(out, data)
+
+
+@settings(max_examples=20, deadline=None)
+@given(
+    k=st.integers(2, 10),
+    extra=st.integers(1, 6),
+    seed=st.integers(0, 2**31),
+)
+def test_bw_property_partial_share_sets(k, extra, seed):
+    """Receive only a subset of shares (>= k), corrupt within the subset's
+    own radius, recover. Exercises non-contiguous evaluation points."""
+    prng = np.random.default_rng(seed)
+    n = k + extra
+    c = GoldenCodec(k, n)
+    gf = c.gf
+    S = 8
+    data = prng.integers(0, 256, size=(k, S), dtype=np.int64).astype(np.uint8)
+    cw = c.encode_all(data).astype(np.int64)
+    m = int(prng.integers(k, n + 1))
+    nums = sorted(prng.permutation(n)[:m].tolist())
+    e = (m - k) // 2
+    stripes = cw[nums]
+    for col in range(S):
+        t = int(prng.integers(0, e + 1))
+        for row in prng.permutation(m)[:t]:
+            stripes[row, col] ^= int(prng.integers(1, 256))
+    out = bw_decode_stripes(gf, "cauchy", k, n, nums, stripes.astype(np.uint8))
+    assert out is not None
+    np.testing.assert_array_equal(out, data)
+
+
 # -- FEC integration --------------------------------------------------------
 
 
