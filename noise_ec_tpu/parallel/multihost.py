@@ -1,0 +1,81 @@
+"""Multi-host (DCN-tier) mesh helpers.
+
+The reference scales across machines with its P2P transport
+(/root/reference/main.go:137-173, one noise node per host). The TPU build
+has two distribution tiers (SURVEY.md §2.4 comm-backend row):
+
+- the host tier keeps those semantics (host/transport.py — TCP/KCP peers,
+  discovery, signed frames), and
+- the device tier runs SPMD over a global `jax.sharding.Mesh` that may span
+  hosts: JAX's distributed runtime (a coordinator service + one process per
+  host) makes every host's chips visible as one device list, and XLA routes
+  collectives over ICI within a pod slice and DCN across hosts. The SAME
+  `shard_map` programs from parallel/batch.py work unchanged — an
+  all-gather over a mesh axis whose devices live on two hosts IS the
+  cross-host parity assembly.
+
+Nothing here is TPU-specific: tests/test_multihost.py runs two real
+processes with virtual CPU devices and a localhost coordinator, shards the
+parity `row` axis ACROSS the processes, and checks the cross-host
+all-gathered codeword bit-exactly against the golden codec.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec  # noqa: F401 (Mesh in signatures)
+
+
+def initialize(coordinator_address: str, num_processes: int,
+               process_id: int) -> None:
+    """Join this process to the global JAX distributed runtime.
+
+    Call ONCE per process before any other JAX API touches devices.
+    ``coordinator_address`` is ``host:port`` of process 0 (the coordinator
+    binds it; everyone else dials it) — the moral analogue of the
+    reference's ``-peers`` bootstrap list (main.go:171-173), except
+    membership is fixed up front rather than gossiped.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(axis_names: Sequence[str],
+                shape: Optional[Sequence[int]] = None) -> Mesh:
+    """Mesh over ALL devices of every process, row-major over ``shape``.
+
+    Under the distributed runtime ``jax.devices()`` IS the global device
+    list (process-major order), so this is :func:`parallel.mesh.make_mesh`
+    unchanged: an axis larger than the per-process device count spans
+    hosts and its collectives ride DCN.
+    """
+    from noise_ec_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(axis_names, shape)
+
+
+def replicate_to_global(arr: np.ndarray, mesh: Mesh) -> jax.Array:
+    """Host-identical ndarray -> fully-replicated global jax.Array.
+
+    Every process must pass the same bytes (same seed / same file); the
+    result can feed any jitted program over ``mesh`` regardless of its
+    input specs (jit reshards).
+    """
+    from jax.experimental import multihost_utils
+
+    spec = PartitionSpec(*(None,) * arr.ndim)
+    return multihost_utils.host_local_array_to_global_array(arr, mesh, spec)
+
+
+def fetch_to_every_host(arr: jax.Array) -> np.ndarray:
+    """Global (possibly cross-host-sharded) array -> full ndarray on every
+    process (an all-gather over DCN for the remote shards)."""
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))

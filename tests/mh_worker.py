@@ -1,0 +1,58 @@
+"""Worker process for tests/test_multihost.py — NOT collected by pytest.
+
+Joins a 2-process JAX distributed runtime over a localhost coordinator,
+builds a global ("batch", "row") mesh whose ROW axis spans both processes,
+encodes a words batch with the parity rows sharded across the hosts
+(cross-host all-gather assembles the codeword), and checks the result
+bit-exactly against the golden codec. Prints one MULTIHOST-OK line.
+"""
+
+import os
+import sys
+
+port, proc_id, nprocs = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+import jax  # noqa: E402
+
+# A PJRT plugin loaded by sitecustomize can prepend itself to the
+# jax_platforms CONFIG (not just the env var) — override both, exactly as
+# tests/conftest.py does, before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
+from noise_ec_tpu.parallel import multihost  # noqa: E402
+
+multihost.initialize(f"127.0.0.1:{port}", nprocs, proc_id)
+
+import numpy as np  # noqa: E402
+
+assert jax.device_count() == 4 * nprocs, jax.device_count()
+
+from noise_ec_tpu.golden.codec import GoldenCodec  # noqa: E402
+from noise_ec_tpu.parallel.batch import BatchCodec  # noqa: E402
+
+k, r = 10, 8  # r divisible by the 8-way row axis -> one parity row per device
+bc = BatchCodec(k, r)
+# Row axis size 8 over 2 processes x 4 devices: devices 0-3 live on process
+# 0 and 4-7 on process 1, so parity rows 4-7 are computed on the OTHER host
+# and the tiled all_gather that assembles them crosses the process boundary.
+mesh = multihost.global_mesh(("batch", "row"), (1, 8))
+enc = bc.make_sharded_encoder_words(mesh, row_axis="row")
+
+rng = np.random.default_rng(0xD15)  # same seed on both hosts
+B, TW = 2, 2560
+words = rng.integers(0, 1 << 32, size=(B, k, TW), dtype=np.uint64).astype(np.uint32)
+gwords = multihost.replicate_to_global(words, mesh)
+parity = multihost.fetch_to_every_host(enc(gwords))
+
+g = GoldenCodec(k, k + r)
+for b in range(B):
+    want = np.asarray(g.encode(np.ascontiguousarray(words[b]).view(np.uint8)))
+    got = np.ascontiguousarray(parity[b]).view(np.uint8)
+    np.testing.assert_array_equal(got, want)
+print(f"MULTIHOST-OK proc={proc_id} checksum={int(parity.sum())}", flush=True)
