@@ -198,23 +198,69 @@ def lane_delta_swap(V: jnp.ndarray, TL: int, rounds=_ROUNDS) -> jnp.ndarray:
     return V
 
 
+def transpose_windows(ws: list, rounds) -> list:
+    """Bit transpose across a list of window arrays (delta-swap, pairwise).
+
+    Same math as :func:`lane_delta_swap` with each TL-lane window as its
+    own array, but the classic two-word swap form: per round only the
+    i & d == 0 half does work (5 vector ops per PAIR), with no cross-lane
+    rolls and no iota/select over the full slab — ~2.8x fewer vector ops,
+    and the kernels' window slices map to it directly. Involution.
+
+    Measured on v5e the win only materializes for WIDE windows (TL >= 256):
+    RS(10,4) at TL=512 gains ~16%, but RS(50,20) at TL=128 loses ~24% (the
+    narrow per-window ops vectorize worse than full-slab rolls), so the
+    kernels pick per TL — see ``_use_pairwise``.
+    """
+    for d, mask in rounds:
+        nxt = list(ws)
+        for i in range(len(ws)):
+            if i & d == 0:
+                t = ((ws[i] >> jnp.uint32(d)) ^ ws[i + d]) & jnp.uint32(mask)
+                nxt[i] = ws[i] ^ (t << jnp.uint32(d))
+                nxt[i + d] = ws[i + d] ^ t
+        ws = nxt
+    return ws
+
+
+def _use_pairwise(TL: int) -> bool:
+    return TL >= 256
+
+
 def _pack_lanes_kernel(m, TL, rounds, in_ref, out_ref):
     for sigma in range(8):
-        V = lane_delta_swap(
-            in_ref[:, sigma * m * TL : (sigma + 1) * m * TL], TL, rounds
-        )
+        if _use_pairwise(TL):
+            ws = transpose_windows(
+                [
+                    in_ref[:, (sigma * m + i) * TL : (sigma * m + i + 1) * TL]
+                    for i in range(m)
+                ],
+                rounds,
+            )
+        else:
+            V = lane_delta_swap(
+                in_ref[:, sigma * m * TL : (sigma + 1) * m * TL], TL, rounds
+            )
+            ws = [V[:, i * TL : (i + 1) * TL] for i in range(m)]
         for i in range(m):
-            out_ref[:, i, sigma, :] = V[:, i * TL : (i + 1) * TL]
+            out_ref[:, i, sigma, :] = ws[i]
 
 
 def _unpack_lanes_kernel(m, TL, rounds, in_ref, out_ref):
     for sigma in range(8):
-        V = jnp.concatenate(
-            [in_ref[:, i, sigma, :] for i in range(m)], axis=1
-        )
-        out_ref[:, sigma * m * TL : (sigma + 1) * m * TL] = lane_delta_swap(
-            V, TL, rounds
-        )
+        if _use_pairwise(TL):
+            ws = transpose_windows(
+                [in_ref[:, i, sigma, :] for i in range(m)], rounds
+            )
+            for i in range(m):
+                out_ref[:, (sigma * m + i) * TL : (sigma * m + i + 1) * TL] = ws[i]
+        else:
+            V = jnp.concatenate(
+                [in_ref[:, i, sigma, :] for i in range(m)], axis=1
+            )
+            out_ref[:, sigma * m * TL : (sigma + 1) * m * TL] = lane_delta_swap(
+                V, TL, rounds
+            )
 
 
 _LANE_VMEM_BUDGET = 12 << 20
