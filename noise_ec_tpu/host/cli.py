@@ -71,6 +71,7 @@ def main(argv: list[str] | None = None) -> int:
         log.info("message from %s: %s", sender.address, message.hex())
 
     plugin = ShardPlugin(backend=args.backend, on_message=on_message)
+    plugin.prewarm()  # compile the default geometry before traffic arrives
     net.add_plugin(plugin)
 
     net.listen()  # background accept loop (go net.Listen(), main.go:169)

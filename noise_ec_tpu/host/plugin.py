@@ -179,6 +179,22 @@ class ShardPlugin:
                 self._fec_cache.popitem(last=False)
             return self._fec_cache[(k, n)]
 
+    def prewarm(self, geometries=None, stripe_len: int = 64) -> None:
+        """Build (and jit-warm) codecs for ``geometries`` before traffic.
+
+        First use of a novel (k, n) constructs the FEC and, on the device
+        backend, compiles its kernels — seconds of latency that would
+        otherwise land on the dispatch path of whichever peer sends that
+        geometry first (round-1 ADVICE finding 3). Call at startup with the
+        geometries you expect; defaults to this plugin's own (k, n).
+        """
+        if geometries is None:  # explicit [] means: warm nothing
+            geometries = [(self.minimum_needed_shards, self.total_shards)]
+        for k, n in geometries:
+            fec = self._fec(k, n)
+            shares = fec.encode_shares(bytes(k * stripe_len))  # content is irrelevant
+            fec.decode(shares[:k])
+
     def _recently_completed(self, key: str) -> bool:
         """True iff ``key`` completed within the dedup window. Lazily drops
         expired entries."""

@@ -17,8 +17,8 @@ import time
 
 import pytest
 
-NODE_START_TIMEOUT = 20.0
-MESSAGE_TIMEOUT = 25.0
+NODE_START_TIMEOUT = 30.0
+MESSAGE_TIMEOUT = 45.0
 
 
 def _free_ports(count: int) -> list[int]:
@@ -144,7 +144,7 @@ def test_three_process_discovery_transitive(nodes):
     while True:
         a.send_line(msg)
         try:
-            got_c = c.wait_for(needle, 3.0)
+            got_c = c.wait_for(needle, 4.0)
             break
         except AssertionError:
             if time.monotonic() > deadline:

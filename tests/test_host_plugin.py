@@ -474,3 +474,17 @@ def test_send_over_field_geometry_does_not_brick_plugin():
         plugin.prepare_shards(pid, keys, b"p" * 509)  # prime > 256
     assert (plugin.minimum_needed_shards, plugin.total_shards) == (4, 6)
     assert len(plugin.prepare_shards(pid, keys, b"p" * 16)) == 6
+
+
+def test_prewarm_builds_codecs():
+    """prewarm compiles codecs ahead of traffic (ADVICE finding 3): the
+    requested geometries are in the cache and a subsequent receive of that
+    geometry does not construct a new FEC."""
+    from noise_ec_tpu.host.plugin import ShardPlugin
+
+    p = ShardPlugin(backend="numpy")
+    p.prewarm([(4, 6), (10, 14)])
+    assert set(p._fec_cache) >= {(4, 6), (10, 14)}
+    before = p._fec_cache[(4, 6)]
+    p.prewarm()  # default geometry == (4, 6): reuses the cached codec
+    assert p._fec_cache[(4, 6)] is before
