@@ -336,6 +336,7 @@ class _SerialDispatcher:
 class _Peer:
     pid: PeerID
     writer: asyncio.StreamWriter
+    is_dialer: bool = False  # we initiated the registered connection
 
 
 class _Conn:
@@ -348,10 +349,11 @@ class _Conn:
     connection verifies as a signature but never matches the new nonce and
     never binds the victim's identity to the attacker's socket."""
 
-    def __init__(self):
+    def __init__(self, is_dialer: bool = False):
         self.nonce = os.urandom(_NONCE_LEN)
         self.peer: Optional[PeerID] = None
         self.registered = asyncio.Event()
+        self.is_dialer = is_dialer  # we initiated this connection
 
 
 class TCPNetwork:
@@ -389,6 +391,7 @@ class TCPNetwork:
         write_timeout: float = 3.0,
         discovery: bool = True,
         max_discovered_peers: int = 64,
+        discovery_interval: float = 2.0,
     ):
         """Tuning knobs default to the reference's builder options
         (/root/reference/main.go:27-33): connection timeout 60s, recv/send
@@ -409,7 +412,13 @@ class TCPNetwork:
         from noise's discovery plugin (main.go:151): on every registration
         the node sends the newcomer its known peer addresses and announces
         the newcomer to existing peers; learned addresses are dialed
-        (deduped, capped at ``max_discovered_peers``).
+        (deduped, capped at ``max_discovered_peers``). Every
+        ``discovery_interval`` seconds the full peer list is re-gossiped to
+        every registered peer — registration-time gossip alone cannot heal
+        a lost introduction (a failed discovered dial, or simultaneous
+        mutual dials where each side keeps a different connection and
+        closes the other's survivor, leaves a pair partitioned with no new
+        registration event to retry on).
         """
         if protocol not in ("tcp", "kcp"):
             raise ValueError(
@@ -430,6 +439,7 @@ class TCPNetwork:
         self.write_timeout = write_timeout
         self.discovery = discovery
         self.max_discovered_peers = max_discovered_peers
+        self.discovery_interval = discovery_interval
         # Keyed by PUBLIC KEY, not the self-claimed address: an address is
         # just a claim inside a signed frame, so keying by it would let any
         # handshake-completing attacker evict a legitimate peer by claiming
@@ -461,6 +471,11 @@ class TCPNetwork:
         # budget). Entries are removed on dial failure and on disconnect of
         # the dialed peer, so churned peers can be re-learned from gossip.
         self._dialing: set[str] = set()
+        # Failed-dial cooldown: addr -> (next-allowed monotonic time, delay).
+        # Without it, periodic re-gossip would re-dial an unreachable
+        # claimed address every interval forever, flooding self.errors.
+        self._dial_backoff: dict[str, tuple[float, float]] = {}
+        self._gossip_task: Optional[asyncio.Task] = None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -475,6 +490,34 @@ class TCPNetwork:
             format_address(self.protocol, self.host, self.port),
             self.keys.public_key,
         )
+        if self.discovery and self.discovery_interval > 0:
+            def _start_gossip():
+                self._gossip_task = self._loop.create_task(self._gossip_loop())
+            self._loop.call_soon_threadsafe(_start_gossip)
+
+    async def _gossip_loop(self) -> None:
+        """Periodic full-peer-list re-gossip (see ``discovery_interval``).
+
+        One shared frame per tick: receivers already skip their own
+        address (and known peers), so per-recipient exclusion would only
+        multiply the Ed25519 signing work by the peer count.
+        """
+        while True:
+            await asyncio.sleep(self.discovery_interval)
+            try:
+                with self._lock:
+                    peers = list(self.peers.values())
+                if len(peers) < 2:
+                    continue
+                frame = self._frame(
+                    _OP_PEERS, _encode_peer_list([p.pid.address for p in peers])
+                )
+                for p in peers:
+                    self._write_safe(p.writer, frame)
+            except Exception as exc:  # noqa: BLE001 — a bad tick must not
+                # kill the loop: losing it silently re-creates the very
+                # unhealable-partition state this mechanism exists to fix.
+                self._record_error(exc)
 
     async def _start_server(self):
         if self.protocol == "kcp":
@@ -501,6 +544,9 @@ class TCPNetwork:
         async def _shutdown():
             if self._server is not None:
                 self._server.close()
+            if self._gossip_task is not None:
+                self._gossip_task.cancel()
+                self._gossip_task = None
             for h in self._flush_handles.values():
                 h.cancel()
             self._flush_handles.clear()
@@ -681,7 +727,7 @@ class TCPNetwork:
         reader, writer = await asyncio.wait_for(
             opener(host, port), timeout=self.connection_timeout
         )
-        conn = _Conn()
+        conn = _Conn(is_dialer=True)
         try:
             writer.write(self._frame(_OP_HELLO, conn.nonce))
             task = asyncio.create_task(self._read_loop(reader, writer, conn))
@@ -700,13 +746,24 @@ class TCPNetwork:
     async def _dial_discovered(self, address: str) -> None:
         """Dial an address learned from peer gossip (best-effort). A failed
         dial refunds its budget and dedup slot so later gossip can retry
-        (a crashed-and-restarted peer must not stay partitioned forever)."""
+        (a crashed-and-restarted peer must not stay partitioned forever),
+        but enters exponential backoff so periodic re-gossip does not
+        hammer an unreachable claimed address every interval."""
         try:
             await self._dial(address)
         except Exception as exc:  # noqa: BLE001
             self._dialing.discard(address)
+            loop_t = self._loop.time()
+            delay = min(
+                self._dial_backoff.get(address, (0.0, self.discovery_interval))[1] * 2,
+                60.0,
+            )
+            self._dial_backoff[address] = (loop_t + delay, delay)
             self._record_error(exc)
-            log.info("discovery dial %s failed: %s", address, exc)
+            log.info("discovery dial %s failed: %s (retry in %.1fs)",
+                     address, exc, delay)
+        else:
+            self._dial_backoff.pop(address, None)
 
     @staticmethod
     def _split(address: str) -> tuple[str, int]:
@@ -743,27 +800,46 @@ class TCPNetwork:
 
     def _register(self, pid: PeerID, writer: asyncio.StreamWriter, conn: _Conn) -> None:
         conn.peer = pid
+        # Simultaneous mutual dials (common under gossip) produce two
+        # connections per peer pair, and each side must close the SAME one:
+        # "keep the newest" is not symmetric (registration order can differ
+        # per side), and if A keeps conn1 while C keeps conn2, each closes
+        # the other's survivor and the pair partitions until re-gossip.
+        # Deterministic tie-break both sides agree on — applied only when
+        # the two connections have OPPOSITE directions (the mutual-dial
+        # shape): the connection DIALED by the lexicographically smaller
+        # public key survives. Same-direction conflicts (a peer crashed
+        # without FIN and reconnected the same way) keep the newest: the
+        # old socket is dead and the remote only knows the new one.
         with self._lock:
             others = [
                 p for key, p in self.peers.items() if key != pid.public_key
             ]
             prev = self.peers.get(pid.public_key)
-            self.peers[pid.public_key] = _Peer(pid, writer)
+            keep_new = True
+            if prev is not None and prev.writer is not writer:
+                if prev.is_dialer != conn.is_dialer:
+                    keep_new = conn.is_dialer == (
+                        self.keys.public_key < pid.public_key
+                    )
+            if keep_new:
+                self.peers[pid.public_key] = _Peer(pid, writer, conn.is_dialer)
         if prev is not None and prev.writer is not writer:
-            # Simultaneous mutual dials (common under gossip) produce two
-            # connections per peer pair; keep the newest and close the old
-            # socket. Its read-loop teardown calls _drop_writer, which only
-            # removes entries whose writer matches — the new entry survives.
+            # Close the loser; its read-loop teardown calls _drop_writer,
+            # which only removes entries whose writer matches — the
+            # surviving entry is never evicted by the teardown.
             try:
-                prev.writer.close()
+                (prev.writer if keep_new else writer).close()
             except Exception:  # noqa: BLE001
                 pass
         conn.registered.set()
-        if self.discovery and others:
+        if self.discovery and others and keep_new:
             # Peer exchange (the reference's discovery.Plugin, main.go:151):
             # tell the newcomer who we know, and announce the newcomer to
             # everyone else, so broadcast reach is transitive rather than
-            # limited to the bootstrap list.
+            # limited to the bootstrap list. (A connection that lost the
+            # mutual-dial tie-break is closing; its peer was already
+            # gossiped when the surviving connection registered.)
             self._write_safe(
                 writer,
                 self._frame(
@@ -834,11 +910,24 @@ class TCPNetwork:
                 return
             with self._lock:
                 known = {p.pid.address for p in self.peers.values()}
+            now = self._loop.time()
+            # Prune expired cooldowns so the dict stays bounded (gossiped
+            # addresses are attacker-supplied; without pruning a hostile
+            # peer grows it by a batch per tick forever). The cap below
+            # bounds even the pathological all-unexpired case.
+            self._dial_backoff = {
+                a: v for a, v in self._dial_backoff.items()
+                if now < v[0] + v[1]
+            }
+            while len(self._dial_backoff) > 4 * self.max_discovered_peers:
+                self._dial_backoff.pop(next(iter(self._dial_backoff)))
             for addr in addresses:
+                backoff = self._dial_backoff.get(addr)
                 if (
                     addr == self.id.address
                     or addr in known
                     or addr in self._dialing
+                    or (backoff is not None and now < backoff[0])
                     or len(self._dialing) >= self.max_discovered_peers
                 ):
                     continue

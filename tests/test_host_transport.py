@@ -509,6 +509,68 @@ def test_tcp_discovery_transitive_broadcast():
             net.close()
 
 
+def test_tcp_discovery_regossip_heals_partition():
+    """Registration-time gossip alone cannot recover a lost introduction
+    (failed discovered dial, or mutual-dial close races): the periodic
+    re-gossip must re-introduce the pair. Kill the A<->C connections on
+    BOTH ends, then expect a later broadcast from A to reach C again."""
+    nets, inboxes = [], []
+    try:
+        for _ in range(3):
+            inbox = []
+            net = TCPNetwork(host="127.0.0.1", port=0, discovery_interval=0.2)
+            net.add_plugin(
+                ShardPlugin(backend="numpy",
+                            on_message=lambda m, s, inbox=inbox: inbox.append(m))
+            )
+            net.listen()
+            nets.append(net)
+            inboxes.append(inbox)
+        a, b, c = nets
+        a.bootstrap([b.id.address])
+        c.bootstrap([b.id.address])
+        deadline = time.time() + 10
+        while time.time() < deadline and (len(a.peers) < 2 or len(c.peers) < 2):
+            time.sleep(0.02)
+        assert len(a.peers) == 2 and len(c.peers) == 2
+
+        # Partition A<->C: close the connection at both ends at once (the
+        # worst mutual-dial outcome, where each side killed the other's
+        # surviving socket).
+        with a._lock:
+            ac = a.peers[c.keys.public_key].writer
+        with c._lock:
+            ca = c.peers[a.keys.public_key].writer
+        a._loop.call_soon_threadsafe(ac.close)
+        c._loop.call_soon_threadsafe(ca.close)
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+            c.keys.public_key in a.peers or a.keys.public_key in c.peers
+        ):
+            time.sleep(0.02)
+        assert c.keys.public_key not in a.peers  # truly partitioned
+
+        # Re-gossip from B re-introduces them; broadcast reaches C again.
+        deadline = time.time() + 10
+        while time.time() < deadline and (
+            c.keys.public_key not in a.peers or a.keys.public_key not in c.peers
+        ):
+            time.sleep(0.05)
+        # Pin the heal stage separately so a heal timeout does not surface
+        # as a misleading broadcast-lost failure below.
+        assert c.keys.public_key in a.peers and a.keys.public_key in c.peers, (
+            a.errors, b.errors, c.errors
+        )
+        a.plugins[0].shard_and_broadcast(a, b"healed reach!!!!")
+        deadline = time.time() + 10
+        while time.time() < deadline and not inboxes[2]:
+            time.sleep(0.02)
+        assert inboxes[2] == [b"healed reach!!!!"], (a.errors, b.errors, c.errors)
+    finally:
+        for net in nets:
+            net.close()
+
+
 def test_tcp_discovery_disabled_stays_bootstrap_only():
     nets = []
     try:
@@ -543,3 +605,51 @@ def test_cli_parser_defaults():
     )
     assert args.port == 3001
     assert args.peers.split(",") == ["tcp://localhost:3000", "tcp://localhost:3002"]
+
+
+def test_mutual_dial_tiebreak_deterministic():
+    """On a writer conflict both sides must keep the SAME connection: the
+    one dialed by the lexicographically smaller public key. Checked for
+    both registration orders and both key orderings."""
+    from noise_ec_tpu.host.crypto import PeerID
+    from noise_ec_tpu.host.transport import _Conn
+
+    for peer_key, our_dial_wins in ((b"\x00" * 32, False), (b"\xff" * 32, True)):
+        pid = PeerID.create("tcp://peer:1", peer_key)
+        for first_is_dialer in (True, False):
+            net = TCPNetwork(host="127.0.0.1", port=0, discovery=False)
+            try:
+                w_dialed, w_accepted = FakeWriter(), FakeWriter()
+                regs = [(w_dialed, _Conn(is_dialer=True)), (w_accepted, _Conn())]
+                if not first_is_dialer:
+                    regs.reverse()
+                for w, conn in regs:
+                    net._register(pid, w, conn)
+                survivor = net.peers[pid.public_key].writer
+                want = w_dialed if our_dial_wins else w_accepted
+                assert survivor is want, (peer_key[:1], first_is_dialer)
+            finally:
+                net.close()
+
+
+def test_same_direction_reconnect_keeps_newest():
+    """A peer that crashed without FIN and re-dialed arrives on a SAME-
+    direction conflict (both accepted here): the fresh socket must win
+    regardless of key order — the old one is dead and the remote only
+    knows the new one."""
+    from noise_ec_tpu.host.crypto import PeerID
+    from noise_ec_tpu.host.transport import _Conn
+
+    for peer_key in (b"\x00" * 32, b"\xff" * 32):
+        pid = PeerID.create("tcp://peer:1", peer_key)
+        for direction in (True, False):
+            net = TCPNetwork(host="127.0.0.1", port=0, discovery=False)
+            try:
+                old, fresh = FakeWriter(), FakeWriter()
+                net._register(pid, old, _Conn(is_dialer=direction))
+                net._register(pid, fresh, _Conn(is_dialer=direction))
+                assert net.peers[pid.public_key].writer is fresh, (
+                    peer_key[:1], direction
+                )
+            finally:
+                net.close()
