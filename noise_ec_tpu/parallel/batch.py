@@ -125,8 +125,19 @@ class BatchCodec:
         ``kernel`` reaches the underlying DeviceCodec (tests inject
         ``pallas_interpret`` to run this chain on CPU). On backends where
         ``auto`` resolves to the XLA kernel (no Pallas words pipeline) the
-        call falls back to ``encode_batch`` on the symbol view, so the API
-        is total everywhere at the cost of a host relayout.
+        call falls back to the symbol path on a host relayout, so the API
+        is total everywhere.
+        """
+        parity = self._matmul_words(self.parity_matrix, words, kernel)
+        return jnp.concatenate([jnp.asarray(words, jnp.uint32), parity], axis=1)
+
+    def _matmul_words(self, M: np.ndarray, words: jnp.ndarray,
+                      kernel: str) -> jnp.ndarray:
+        """(R, k) GF matrix x (B, k, TW) words -> (B, R, TW) words.
+
+        The one dispatch point for the words-path batch entries: the fused
+        Pallas pipeline when a pallas kernel resolves, else the symbol-path
+        fallback via a host relayout (free views, one device call).
         """
         from noise_ec_tpu.ops.dispatch import DeviceCodec, _resolve_kernel
 
@@ -135,13 +146,12 @@ class BatchCodec:
             B, k, TW = words.shape
             sym = np.ascontiguousarray(np.asarray(words)).view(
                 self.gf.dtype).reshape(B, k, -1)
-            full = np.asarray(self.encode_batch(jnp.asarray(sym)))
+            out = np.asarray(self.matmul_batch(M, jnp.asarray(sym)))
             return jnp.asarray(
-                np.ascontiguousarray(full).view("<u4").reshape(B, self.n, TW))
+                np.ascontiguousarray(out).view("<u4").reshape(B, M.shape[0], TW))
         if self._dev is None or self._dev.kernel != resolved:
             self._dev = DeviceCodec(field=self.field_name, kernel=resolved)
-        parity = self._dev.matmul_words_batch(self.parity_matrix, words)
-        return jnp.concatenate([jnp.asarray(words, jnp.uint32), parity], axis=1)
+        return self._dev.matmul_words_batch(M, words)
 
     def reconstruct_batch(self, batch_present: jnp.ndarray,
                           present: list[int]) -> jnp.ndarray:
@@ -170,6 +180,45 @@ class BatchCodec:
             for row, i in enumerate(missing):
                 out_rows[i] = filled[:, row, :]
         return jnp.stack(out_rows, axis=1)
+
+    def reconstruct_batch_words(self, words_present: jnp.ndarray,
+                                present: list[int], *,
+                                kernel: str = "auto") -> jnp.ndarray:
+        """Words-path batch rebuild: (B, len(present), TW) -> (B, n, TW).
+
+        The reconstruct hot loop (inverted-submatrix multiply, reference
+        main.go:77) on the same fused Pallas pipeline as
+        :meth:`encode_batch_words`; one baked program per (basis, missing)
+        erasure pattern, cached like every other geometry. Row semantics
+        match :meth:`reconstruct_batch` (first k of sorted ``present`` form
+        the basis; present rows pass through).
+        """
+        from noise_ec_tpu.ops.dispatch import _resolve_kernel
+
+        if len(present) < self.k:
+            raise ValueError(f"need >= {self.k} present shards, got {len(present)}")
+        pos = {p: i for i, p in enumerate(present)}
+        basis = sorted(present)[: self.k]
+        missing = [i for i in range(self.n) if i not in pos]
+        # On the XLA fallback the matmul runs off a host relayout anyway:
+        # gather the basis with numpy to skip a pointless H2D+D2H pair.
+        if _resolve_kernel(kernel) == "xla":
+            wp = np.asarray(words_present)
+        else:
+            wp = jnp.asarray(words_present, jnp.uint32)
+        sub = wp[:, [pos[i] for i in basis], :]
+        out_rows: list = [None] * self.n
+        for row, i in enumerate(basis):
+            out_rows[i] = sub[:, row, :]
+        for j in present:
+            if out_rows[j] is None:
+                out_rows[j] = wp[:, pos[j], :]
+        if missing:
+            R = reconstruction_matrix(self.gf, self.G, basis, missing)
+            filled = self._matmul_words(R, sub, kernel)  # np or jnp sub both fine
+            for row, i in enumerate(missing):
+                out_rows[i] = filled[:, row, :]
+        return jnp.stack([jnp.asarray(r, jnp.uint32) for r in out_rows], axis=1)
 
     # -- mesh-sharded ops --------------------------------------------------
 
