@@ -234,3 +234,36 @@ def test_kcp_two_node_end_to_end():
     finally:
         a.close()
         b.close()
+
+
+def test_kcp_three_node_discovery_transitive():
+    """Peer-exchange gossip carries kcp:// addresses and discovered dials
+    open KCP streams: C bootstraps only to B yet receives A's broadcast."""
+    nets, inboxes = [], []
+    try:
+        for _ in range(3):
+            inbox = []
+            net = TCPNetwork(host="127.0.0.1", port=0, protocol="kcp",
+                             discovery_interval=0.3)
+            net.add_plugin(ShardPlugin(backend="numpy",
+                                       on_message=lambda m, s, inbox=inbox: inbox.append(m)))
+            net.listen()
+            nets.append(net)
+            inboxes.append(inbox)
+        a, b, c = nets
+        a.bootstrap([b.id.address])
+        c.bootstrap([b.id.address])
+        deadline = time.time() + 15
+        while time.time() < deadline and (len(a.peers) < 2 or len(c.peers) < 2):
+            time.sleep(0.02)
+        assert len(a.peers) == 2 and len(c.peers) == 2, (
+            a.errors, b.errors, c.errors
+        )
+        a.plugins[0].shard_and_broadcast(a, b"kcp transitive!!")
+        deadline = time.time() + 10
+        while time.time() < deadline and not inboxes[2]:
+            time.sleep(0.02)
+        assert inboxes[2] == [b"kcp transitive!!"], (c.errors,)
+    finally:
+        for net in nets:
+            net.close()
