@@ -193,6 +193,49 @@ class ReedSolomon:
                 arrs[i] = filled[row]
         return [self._as_bytes_arr(a) if a is not None else None for a in arrs]
 
+    def update(
+        self,
+        shards: Sequence[Buffer],
+        new_data: Sequence[Optional[Buffer]],
+    ) -> list[np.ndarray]:
+        """Incrementally recompute parity after changing some data shards
+        (klauspost ``Update``). ``shards``: all n current shards;
+        ``new_data``: length-k, None for unchanged entries. Returns the new
+        full shard list.
+
+        Linearity of the code makes this exact: for changed shard j with
+        delta = new_j ^ old_j, parity ^= G[k:, j] x delta — O(c*r*S) for c
+        changed shards instead of the full O(k*r*S) re-encode. The delta
+        multiply runs on the configured backend like every other hot loop.
+        """
+        arrs, size = self._gather(shards, need_all=True)
+        if len(new_data) != self.k:
+            raise ValueError(
+                f"new_data must list all {self.k} data shards (None = unchanged), "
+                f"got {len(new_data)}"
+            )
+        changed: list[tuple[int, np.ndarray]] = []
+        for j, nd in enumerate(new_data):
+            if nd is None:
+                continue
+            arr = self._to_sym(nd, f"new data shard {j}")
+            if arr.size != size:
+                raise ValueError(
+                    f"new data shard {j} length {arr.size} != {size}"
+                )
+            changed.append((j, arr))
+        if changed and self.r:
+            cols = [j for j, _ in changed]
+            deltas = np.stack([arrs[j] ^ arr for j, arr in changed])
+            parity = np.stack(arrs[self.k:])
+            # Fancy indexing already yields a fresh contiguous submatrix.
+            parity ^= self._mul(self.G[self.k:, cols], deltas)
+            for row, i in enumerate(range(self.k, self.n)):
+                arrs[i] = parity[row]
+        for j, arr in changed:
+            arrs[j] = arr
+        return [self._as_bytes_arr(a) for a in arrs]
+
     def reconstruct_data(self, shards: Sequence[Optional[Buffer]]) -> list[np.ndarray]:
         """Like reconstruct, but only guarantees the k data shards."""
         return self.reconstruct(shards, data_only=True)

@@ -254,3 +254,31 @@ def test_fec_property_roundtrip(k, extra, blocks, seed):
     shares = f.encode_shares(data)
     keep = sorted(rng.choice(k + extra, size=k, replace=False))
     assert f.decode([shares[i] for i in keep]) == data
+
+
+def test_update_incremental_parity_matches_reencode(backend, rng):
+    """klauspost Update: change a subset of data shards, parity corrected
+    via the delta product only — identical to a full re-encode."""
+    rs = ReedSolomon(6, 3, backend=backend)
+    data = [bytes(rng.integers(0, 256, 128).astype(np.uint8)) for _ in range(6)]
+    full = rs.encode(data)
+    new2 = bytes(rng.integers(0, 256, 128).astype(np.uint8))
+    new5 = bytes(rng.integers(0, 256, 128).astype(np.uint8))
+    updated = rs.update(full, [None, None, new2, None, None, new5])
+    want = rs.encode([data[0], data[1], new2, data[3], data[4], new5])
+    for a, b in zip(updated, want):
+        np.testing.assert_array_equal(a, b)
+    assert rs.verify(updated)
+    # No-op update changes nothing.
+    same = rs.update(full, [None] * 6)
+    for a, b in zip(same, full):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_update_validates_inputs(rng):
+    rs = ReedSolomon(4, 2, backend="numpy")
+    full = rs.encode([bytes(16)] * 4)
+    with pytest.raises(ValueError):
+        rs.update(full, [None] * 3)  # wrong list length
+    with pytest.raises(ValueError):
+        rs.update(full, [bytes(8), None, None, None])  # wrong shard length
