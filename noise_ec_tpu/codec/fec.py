@@ -97,11 +97,14 @@ class FEC:
     def total(self) -> int:
         return self.n
 
-    def encode(self, data: bytes, output: Callable[[Share], None]) -> None:
-        """Systematically encode ``data`` into ``total`` shares.
+    def _stripes(self, data: bytes) -> np.ndarray:
+        """Validate ``data`` and split it into (k, S) symbol stripes.
 
-        ``len(data)`` must be a multiple of ``required`` (infectious
-        contract; reference comment main.go:260-261).
+        One owner for the encode-side contract: non-empty, length a
+        multiple of ``required`` (infectious contract; reference comment
+        main.go:260-261), and whole symbols per stripe (gf65536 needs an
+        even stride — enforced by _to_sym for EVERY path, so no share can
+        be emitted that decode() would later choke on).
         """
         if len(data) == 0:
             raise ValueError("cannot encode empty data")
@@ -111,7 +114,11 @@ class FEC:
             )
         stride = len(data) // self.k
         arr = np.frombuffer(data, dtype=np.uint8).reshape(self.k, stride)
-        full = self._rs.encode(list(arr))
+        return np.stack([self._rs._to_sym(r, "data stripe") for r in arr])
+
+    def encode(self, data: bytes, output: Callable[[Share], None]) -> None:
+        """Systematically encode ``data`` into ``total`` shares."""
+        full = self._rs.encode(list(self._stripes(data)))
         for i, row in enumerate(full):
             output(Share(i, row.tobytes()))
 
@@ -120,6 +127,19 @@ class FEC:
         out: list[Share] = []
         self.encode(data, out.append)
         return out
+
+    def encode_single(self, data: bytes, num: int) -> Share:
+        """Produce only share ``num`` (infectious ``EncodeSingle``): a data
+        share is a slice of the input; a parity share is one generator row
+        times the data stripes — O(k*S) instead of the full O(n*k*S)."""
+        if not 0 <= num < self.n:
+            raise ValueError(f"share number {num} out of range [0, {self.n})")
+        D = self._stripes(data)
+        stride = len(data) // self.k
+        if num < self.k:
+            return Share(num, data[num * stride : (num + 1) * stride])
+        row = self._rs._mul(self._rs.G[num : num + 1], D)
+        return Share(num, self._rs._as_bytes_arr(row[0]).tobytes())
 
     def decode(self, shares: Iterable[Share]) -> bytes:
         """Reassemble the original data from >= required shares.

@@ -161,14 +161,33 @@ class ReedSolomon:
         reference's corruption story is the signature check one layer up
         (main.go:82-99).
         """
+        limit = self.k if data_only else self.n
+        return self._reconstruct(shards, range(limit))
+
+    def reconstruct_some(
+        self, shards: Sequence[Optional[Buffer]], required: Sequence[bool]
+    ) -> list[np.ndarray]:
+        """Rebuild only the shards flagged in ``required`` (klauspost
+        ``ReconstructSome``): missing shards not flagged stay None, and the
+        inverse-submatrix multiply computes only the requested rows."""
+        if len(required) != self.n:
+            raise ValueError(
+                f"required must flag all {self.n} shards, got {len(required)}"
+            )
+        return self._reconstruct(
+            shards, [i for i, want in enumerate(required) if want]
+        )
+
+    def _reconstruct(
+        self, shards: Sequence[Optional[Buffer]], wanted
+    ) -> list[np.ndarray]:
         arrs, _ = self._gather(shards, need_all=False)
         present = [i for i, a in enumerate(arrs) if a is not None]
         if len(present) < self.k:
             raise ValueError(
                 f"too few shards to reconstruct: have {len(present)}, need {self.k}"
             )
-        limit = self.k if data_only else self.n
-        missing = [i for i in range(limit) if arrs[i] is None]
+        missing = [i for i in wanted if arrs[i] is None]
         if missing:
             # Prefer the first k present rows; fall back over other subsets
             # for non-MDS constructions (par1) with singular submatrices.

@@ -282,3 +282,47 @@ def test_update_validates_inputs(rng):
         rs.update(full, [None] * 3)  # wrong list length
     with pytest.raises(ValueError):
         rs.update(full, [bytes(8), None, None, None])  # wrong shard length
+
+
+def test_reconstruct_some_rebuilds_only_requested(backend, rng):
+    """klauspost ReconstructSome: unrequested missing shards stay None."""
+    rs = ReedSolomon(4, 3, backend=backend)
+    data = [bytes(rng.integers(0, 256, 64).astype(np.uint8)) for _ in range(4)]
+    full = rs.encode(data)
+    holes = [None if i in (1, 2, 5) else full[i] for i in range(7)]
+    required = [False, True, False, False, False, False, False]
+    out = rs.reconstruct_some(holes, required)
+    np.testing.assert_array_equal(out[1], full[1])
+    assert out[2] is None and out[5] is None  # not requested, left missing
+    with pytest.raises(ValueError):
+        rs.reconstruct_some(holes, [True] * 3)  # wrong flag count
+
+
+def test_fec_encode_single_matches_full_encode(rng):
+    from noise_ec_tpu.codec.fec import FEC
+
+    for field in ("gf256", "gf65536"):
+        fec = FEC(4, 7, field=field, backend="numpy")
+        data = bytes(rng.integers(0, 256, 4 * 32).astype(np.uint8))
+        full = fec.encode_shares(data)
+        for num in range(7):
+            single = fec.encode_single(data, num)
+            assert single.number == num
+            assert single.data == full[num].data, (field, num)
+    with pytest.raises(ValueError):
+        fec.encode_single(data, 7)
+    with pytest.raises(ValueError):
+        fec.encode_single(b"xyz", 0)  # not a multiple of k
+
+
+def test_encode_single_rejects_odd_gf65536_stride(rng):
+    """The gf65536 whole-symbol contract holds on EVERY encode_single path,
+    including data shares: an odd stride must raise, never emit a share
+    decode() cannot consume."""
+    from noise_ec_tpu.codec.fec import FEC
+
+    fec = FEC(4, 7, field="gf65536", backend="numpy")
+    with pytest.raises(ValueError):
+        fec.encode_single(bytes(12), 0)  # stride 3: odd, no share emitted
+    with pytest.raises(ValueError):
+        fec.encode_single(bytes(12), 4)
