@@ -77,7 +77,7 @@ def main() -> None:
     from noise_ec_tpu.gf.field import GF256
     from noise_ec_tpu.matrix.generators import generator_matrix
     from noise_ec_tpu.matrix.linalg import reconstruction_matrix
-    from noise_ec_tpu.ops.dispatch import DeviceCodec
+    from noise_ec_tpu.ops.dispatch import WORD_QUANTUM, DeviceCodec
 
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
@@ -143,7 +143,12 @@ def main() -> None:
                 dev.matmul_stripes(G3[k3:], sm3),
                 np.asarray(GoldenCodec(k3, k3 + r3).encode(sm3)),
             ), f"TPU RS({k3},{r3}) encode != golden codec"
-            S3 = ((8 << 20) // k3 // 2048) * 2048 // 4  # ~8 MiB object, words
+            # ~8 MiB object with WORD_QUANTUM-aligned shards (like the
+            # headline's 1 MiB shards): an unaligned size would charge the
+            # kernel for pad bytes it computes but the object never uses
+            # (RS(50,20)'s old size padded 41472 -> 49152 words, a 18% tax;
+            # RS(17,3) was already aligned).
+            S3 = ((8 << 20) // k3 // 4 // WORD_QUANTUM) * WORD_QUANTUM
             w3 = jnp.asarray(
                 rng.integers(0, 1 << 32, size=(k3, S3), dtype=np.uint64).astype(np.uint32)
             )
