@@ -14,3 +14,4 @@ from noise_ec_tpu.matrix.generators import (  # noqa: F401
     vandermonde_systematic,
 )
 from noise_ec_tpu.matrix.linalg import gf_inv, gf_solve, reconstruction_matrix  # noqa: F401
+from noise_ec_tpu.matrix.bw import bw_decode_stripes, grs_normalizers  # noqa: F401

@@ -9,8 +9,12 @@ parity").
 - ``mesh``      — device-mesh construction helpers
 - ``batch``     — BatchCodec: multi-object encode/reconstruct, DP + TP
 - ``streaming`` — chunked pipeline for wide/long codes (RS(17,3), RS(50,20))
+- ``multihost`` — DCN tier: one global mesh across processes/hosts via
+  JAX's distributed runtime (import the module directly; it must not load
+  at package-import time because ``initialize`` has to run before any
+  other JAX API touches devices)
 """
 
 from noise_ec_tpu.parallel.mesh import make_mesh  # noqa: F401
 from noise_ec_tpu.parallel.batch import BatchCodec  # noqa: F401
-from noise_ec_tpu.parallel.streaming import StreamingEncoder  # noqa: F401
+from noise_ec_tpu.parallel.streaming import StreamingEncoder, decode_stream  # noqa: F401
