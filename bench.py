@@ -10,7 +10,8 @@ Timing: the axon tunnel adds multi-ms RPC jitter and block_until_ready does
 not reflect device completion, so each sample runs N dependent encodes
 inside one jitted fori_loop (data-chained so they serialize) and the
 per-encode time is the slope between a small-N and a payload-size-adaptive
-large-N run (window sized to ~25 ms so jitter cannot flip the slope).
+large-N run (window sized to ~TARGET_WINDOW_S = 40 ms so jitter cannot
+flip the slope).
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": "GB/s", "vs_baseline": ...}
@@ -28,6 +29,22 @@ import time
 import numpy as np
 
 NORTH_STAR_GBPS = 40.0
+# Adaptive timing window per large-N sample (seconds); see module docstring.
+TARGET_WINDOW_S = 0.040
+
+
+class SmokeMismatch(RuntimeError):
+    """A pre-timing golden-codec smoke failed: the kernel miscompiled.
+
+    A distinct type (not bare ``assert``) so the checks survive ``python
+    -O`` and so ``main_with_retry`` can refuse to retry — a deterministic
+    correctness failure must fail the bench run, not be re-timed.
+    """
+
+
+def check_smoke(ok: bool, what: str) -> None:
+    if not ok:
+        raise SmokeMismatch(what)
 
 
 def chained_seconds_per_iter(make_encode, x, n_lo=10, n_hi=None, reps=7):
@@ -48,7 +65,9 @@ def chained_seconds_per_iter(make_encode, x, n_lo=10, n_hi=None, reps=7):
     from jax import lax
 
     if n_hi is None:
-        n_hi = n_lo + max(50, min(4000, int(0.040 * 600e9 / max(x.nbytes, 1))))
+        n_hi = n_lo + max(
+            50, min(4000, int(TARGET_WINDOW_S * 600e9 / max(x.nbytes, 1)))
+        )
 
     def mk(N):
         @jax.jit
@@ -105,7 +124,7 @@ def main() -> None:
         smoke = rng.integers(0, 256, size=(k, 8192)).astype(np.uint8)
         got = dev.matmul_stripes(G[k:], smoke)
         want = np.asarray(GoldenCodec(k, k + r).encode(smoke))
-        assert np.array_equal(got, want), "TPU fused encode != golden codec"
+        check_smoke(np.array_equal(got, want), "TPU fused encode != golden codec")
         stats["tpu_smoke"] = "ok"
 
         words = jnp.asarray(
@@ -139,10 +158,13 @@ def main() -> None:
         for (k3, r3) in ((17, 3), (50, 20)):
             G3 = generator_matrix(gf, k3, k3 + r3, "cauchy")
             sm3 = rng.integers(0, 256, size=(k3, 8192)).astype(np.uint8)
-            assert np.array_equal(
-                dev.matmul_stripes(G3[k3:], sm3),
-                np.asarray(GoldenCodec(k3, k3 + r3).encode(sm3)),
-            ), f"TPU RS({k3},{r3}) encode != golden codec"
+            check_smoke(
+                np.array_equal(
+                    dev.matmul_stripes(G3[k3:], sm3),
+                    np.asarray(GoldenCodec(k3, k3 + r3).encode(sm3)),
+                ),
+                f"TPU RS({k3},{r3}) encode != golden codec",
+            )
             # ~8 MiB object with WORD_QUANTUM-aligned shards (like the
             # headline's 1 MiB shards): an unaligned size would charge the
             # kernel for pad bytes it computes but the object never uses
@@ -174,10 +196,15 @@ def main() -> None:
             G16 = generator_matrix(gf16, k, k + r, "cauchy")
             dev16 = DeviceCodec(field="gf65536", kernel="pallas")
             smoke16 = rng.integers(0, 1 << 16, size=(k, 4096)).astype(np.uint16)
-            assert np.array_equal(
-                dev16.matmul_stripes(G16[k:], smoke16),
-                np.asarray(GoldenCodec(k, k + r, field="gf65536").encode(smoke16)),
-            ), "TPU GF(2^16) fused encode != golden codec"
+            check_smoke(
+                np.array_equal(
+                    dev16.matmul_stripes(G16[k:], smoke16),
+                    np.asarray(
+                        GoldenCodec(k, k + r, field="gf65536").encode(smoke16)
+                    ),
+                ),
+                "TPU GF(2^16) fused encode != golden codec",
+            )
             TW16 = (1 << 20) // 4 * 8  # 8 x 1 MiB per shard, as words
             w16 = jnp.asarray(
                 rng.integers(0, 1 << 32, size=(k, TW16), dtype=np.uint64).astype(np.uint32)
@@ -275,7 +302,8 @@ def main() -> None:
         for p in payloads[1:]:
             send.shard_and_broadcast(nodes[0], p)
         t_host = (time.perf_counter() - t0) / n_msgs
-        assert recv_count[0] == n_msgs + 1, recv_count
+        if recv_count[0] != n_msgs + 1:
+            raise RuntimeError(f"host roundtrip lost messages: {recv_count}")
         payload = payloads[0]
         stats["host_node_roundtrip_msgs_per_s"] = round(1.0 / t_host, 1)
         stats["host_node_roundtrip_mb_per_s"] = round(len(payload) / t_host / 1e6, 1)
@@ -308,7 +336,7 @@ def main_with_retry() -> None:
     retry = False
     try:
         main()
-    except AssertionError:
+    except (SmokeMismatch, AssertionError):
         raise  # deterministic correctness failures must fail the run
     except Exception:
         traceback.print_exc(file=sys.stderr)

@@ -14,5 +14,8 @@ Both dispatch to the same backends: pure NumPy ("numpy") or the JAX/Pallas
 device path ("device", geometry-cached kernels — see ``noise_ec_tpu.ops``).
 """
 
-from noise_ec_tpu.codec.rs import ReedSolomon  # noqa: F401
+from noise_ec_tpu.codec.rs import (  # noqa: F401
+    ReedSolomon,
+    SubsetSearchTruncated,
+)
 from noise_ec_tpu.codec.fec import FEC, Share  # noqa: F401

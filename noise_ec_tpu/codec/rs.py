@@ -34,6 +34,23 @@ Buffer = Union[bytes, bytearray, memoryview, np.ndarray]
 
 _FIELDS = {"gf256": GF256, "gf65536": GF65536}
 
+# Invertible-subset search cap for non-MDS (par1) reconstruction. The
+# default constructions never search (Cauchy submatrices are always
+# invertible, first candidate wins); only degenerate par1 geometries with
+# many singular submatrices can walk the combination space.
+SUBSET_SEARCH_CAP = 20_000
+
+
+class SubsetSearchTruncated(ValueError):
+    """The invertible-subset search hit :data:`SUBSET_SEARCH_CAP` before
+    finding a basis.
+
+    Distinct from the exhausted-search failure so callers can tell "this
+    shard set is genuinely unreconstructable" apart from "the search was
+    cut short" (klauspost's Reconstruct reports a typed error too). Retry
+    with fewer present shards, or a different matrix kind.
+    """
+
 
 class ReedSolomon:
     """RS(k = data_shards, n = data_shards + parity_shards) erasure codec.
@@ -194,8 +211,11 @@ class ReedSolomon:
             import itertools
 
             R = basis = None
-            for count, cand in enumerate(itertools.combinations(present, self.k)):
-                if count >= 20000:
+            truncated = False
+            candidates = itertools.combinations(present, self.k)
+            for count, cand in enumerate(candidates):
+                if count >= SUBSET_SEARCH_CAP:
+                    truncated = True
                     break
                 try:
                     R = reconstruction_matrix(self.gf, self.G, list(cand), missing)
@@ -204,6 +224,14 @@ class ReedSolomon:
                 except np.linalg.LinAlgError:
                     continue
             if R is None:
+                if truncated:
+                    raise SubsetSearchTruncated(
+                        f"invertible-subset search truncated at "
+                        f"{SUBSET_SEARCH_CAP} of C({len(present)},{self.k}) "
+                        f"candidate subsets without finding a basis "
+                        f"(non-MDS matrix); the shard set may still be "
+                        f"reconstructable"
+                    )
                 raise ValueError(
                     "no invertible subset of present shards (non-MDS matrix?)"
                 )

@@ -89,6 +89,8 @@ class KcpSession:
         self._rcv_nxt = 0
         self._rcv_buf: dict[int, bytes] = {}
         self._fin_at: Optional[int] = None
+        self._read_eof = False  # peer FIN fully delivered (half-closed)
+        self._half_close_deadline = 0.0  # write-idle close deadline after EOF
         # rtt estimation (Jacobson/Karels)
         self._srtt = 0.0
         self._rttvar = 0.0
@@ -100,6 +102,7 @@ class KcpSession:
         self._fin_sn: Optional[int] = None
         self._fin_acked = False
         self._close_deadline: Optional[float] = None
+        self._close_hard = 0.0  # set with _close_deadline in start_close
         self._drain_waiters: list[asyncio.Future] = []
         self._update_handle = loop.call_later(UPDATE_INTERVAL, self._update)
 
@@ -108,6 +111,9 @@ class KcpSession:
     def write(self, data: bytes) -> None:
         if self.closed:
             raise ConnectionError("kcp session closed")
+        if self._read_eof:
+            # Half-closed: each write pushes the idle-close deadline out.
+            self._half_close_deadline = time.monotonic() + self.LINGER
         buf = self._partial + data
         for i in range(0, len(buf) - MSS + 1, MSS):
             seg = bytes(buf[i : i + MSS])
@@ -172,13 +178,19 @@ class KcpSession:
         payload = data[_HDR.size : _HDR.size + ln]
         if len(payload) != ln:
             return  # truncated datagram
-        self._ack_upto(una)
+        freed = self._ack_upto(una)
         if cmd == _CMD_ACK:
             now = time.monotonic()
             for (ack_sn,) in struct.iter_unpack("<I", payload):
                 self._ack_one(ack_sn, now)
             self._after_acks()
-        elif cmd == _CMD_PUSH:
+        elif freed:
+            # una piggybacked on PUSH/FIN freed flight slots: refill the
+            # window and wake drain() waiters now rather than waiting up to
+            # UPDATE_INTERVAL for the next timer tick (bidirectional flows).
+            self._fill_window()
+            self._wake_drains()
+        if cmd == _CMD_PUSH:
             self._push(sn, payload)
         elif cmd == _CMD_FIN:
             self._fin_at = sn
@@ -186,6 +198,12 @@ class KcpSession:
             self._maybe_finish()
 
     def _push(self, sn: int, payload: bytes) -> None:
+        if self._fin_at is not None and sn >= self._fin_at:
+            # The peer's FIN covers exactly the segments below _fin_at: a
+            # PUSH at or past it is corrupt/spoofed traffic. During the
+            # half-closed linger it could otherwise reach feed_data after
+            # feed_eof (StreamReader asserts); drop it unacked.
+            return
         if sn > self._rcv_nxt + RCV_BUF_CAP:
             # Beyond the reorder window: drop WITHOUT acking, so the sender
             # retransmits once the window advances (acking here would pop it
@@ -196,6 +214,15 @@ class KcpSession:
         self._send_raw(_CMD_ACK, 0, struct.pack("<I", sn))
         if sn < self._rcv_nxt or sn in self._rcv_buf:
             return
+        if self._fin_sn is not None and self._close_deadline is not None:
+            # Inbound progress while we wait out our own close: keep the
+            # session alive so the peer's response can finish delivering —
+            # but never past the hard cap, or a peer that streams forever
+            # without FINning holds the session (and its reader buffer)
+            # open unboundedly.
+            self._close_deadline = min(
+                time.monotonic() + self.LINGER, self._close_hard
+            )
         self._rcv_buf[sn] = payload
         while self._rcv_nxt in self._rcv_buf:
             self.reader.feed_data(self._rcv_buf.pop(self._rcv_nxt))
@@ -203,14 +230,43 @@ class KcpSession:
         self._maybe_finish()
 
     def _maybe_finish(self) -> None:
+        # Half-close: the peer's FIN ends the READ side only. The write
+        # side stays fully usable — locally queued and un-acked outbound
+        # segments keep transmitting, and the app can still respond (the
+        # TCP path would deliver both after a remote close). The session
+        # fully closes when our own writer closes too (the FIN handshake in
+        # _update), or after LINGER seconds of write-side idleness as leak
+        # protection for handlers that never close their writer.
         if self._fin_at is not None and self._rcv_nxt >= self._fin_at:
+            if not self._read_eof:
+                self._read_eof = True
+                self._half_close_deadline = time.monotonic() + self.LINGER
+                self.reader.feed_eof()
+            self._maybe_close_half_closed()
+
+    def _maybe_close_half_closed(self) -> None:
+        if not self._read_eof or self.closed:
+            return
+        if self._snd_buf or self._snd_queue or self._partial:
+            return  # outbound data still delivering
+        if self._fin_sn is not None:
+            # Writer closed: the normal FIN completion in _update() owns
+            # the close (fin acked, or its deadline); finish early here
+            # when the ack already arrived.
+            if self._fin_acked:
+                self.close()
+            return
+        if time.monotonic() >= self._half_close_deadline:
             self.close()
 
     # -------------------------------------------------------------- acking
 
-    def _ack_upto(self, una: int) -> None:
-        for sn in [s for s in self._snd_buf if s < una]:
+    def _ack_upto(self, una: int) -> int:
+        """Drop in-flight segments below ``una``; returns how many freed."""
+        acked = [s for s in self._snd_buf if s < una]
+        for sn in acked:
             self._flight_bytes -= len(self._snd_buf.pop(sn).data)
+        return len(acked)
 
     def _ack_one(self, sn: int, now: float) -> None:
         if self._fin_sn is not None and sn == self._fin_sn:
@@ -239,6 +295,7 @@ class KcpSession:
                 self._transmit(sn, seg)
         self._fill_window()
         self._wake_drains()
+        self._maybe_close_half_closed()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -258,11 +315,21 @@ class KcpSession:
             self.flush_partial()
         if self._fin_sn is not None:
             done_sending = not self._snd_buf and not self._snd_queue
-            if (self._fin_acked and done_sending) or now >= self._close_deadline:
+            # Both directions must finish before the full close: our FIN
+            # acked AND the peer's FIN delivered (half-close: closing our
+            # writer must not discard the peer's in-flight response). The
+            # linger deadline bounds the wait for a peer that never FINs;
+            # _push extends it while inbound data is still arriving.
+            if (self._fin_acked and done_sending and self._read_eof) or (
+                now >= self._close_deadline
+            ):
                 self.close()
                 return
             if done_sending and not self._fin_acked:
                 self._send_raw(_CMD_FIN, self._fin_sn)  # FIN retransmit
+        self._maybe_close_half_closed()
+        if self.closed:
+            return
         self._wake_drains()
         self._update_handle = self._loop.call_later(UPDATE_INTERVAL, self._update)
 
@@ -274,6 +341,7 @@ class KcpSession:
             self._drain_waiters.clear()
 
     LINGER = 5.0  # max seconds to keep delivering the tail after close()
+    HALF_OPEN_MAX = 60.0  # hard cap on total post-close inbound lingering
 
     def start_close(self) -> None:
         """Graceful close (writer.close()): FIN covers ALL bytes written so
@@ -284,7 +352,9 @@ class KcpSession:
             return
         self.flush_partial()
         self._fin_sn = self._snd_nxt + len(self._snd_queue)
-        self._close_deadline = time.monotonic() + self.LINGER
+        now = time.monotonic()
+        self._close_deadline = now + self.LINGER
+        self._close_hard = now + self.HALF_OPEN_MAX
         self._send_raw(_CMD_FIN, self._fin_sn)
 
     def close(self, error: Optional[Exception] = None) -> None:
@@ -292,9 +362,13 @@ class KcpSession:
             return
         self.closed = True
         self._update_handle.cancel()
-        if error is not None:
+        if error is not None and not self._read_eof:
             self.reader.set_exception(error)
         else:
+            # The inbound stream already finished cleanly (peer FIN fully
+            # delivered): a send-side failure during the half-closed linger
+            # (e.g. dead link) must not turn already-delivered data and its
+            # clean EOF into a read error.
             self.reader.feed_eof()
         for fut in self._drain_waiters:
             if not fut.done():

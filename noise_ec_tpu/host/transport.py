@@ -283,7 +283,8 @@ class _SerialDispatcher:
     key runs on the pool at a time.
     """
 
-    def __init__(self, max_workers: int = 4, max_queue: int = 4096):
+    def __init__(self, max_workers: int = 4, max_queue: int = 4096,
+                 on_error=None):
         self._pool = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="noise-ec-dispatch"
         )
@@ -292,6 +293,13 @@ class _SerialDispatcher:
         self._active: set[bytes] = set()
         self.max_queue = max_queue
         self.overflows = 0
+        # Error contract: a handler that raises is reported to ``on_error``
+        # (an ``(exc) -> None`` recorder) and counted — never silently
+        # swallowed. The TCP dispatch wrapper records into Network.errors;
+        # a bare function submitted by a future caller still gets counted
+        # and logged rather than vanishing.
+        self.dropped_errors = 0
+        self._on_error = on_error
 
     def submit(self, key: bytes, fn, *args) -> bool:
         """Enqueue ``fn(*args)`` on ``key``'s ordered stream. Returns False
@@ -323,8 +331,15 @@ class _SerialDispatcher:
                 fn, args = q.popleft()
             try:
                 fn(*args)
-            except Exception:  # noqa: BLE001 — handlers record their own errors
-                pass
+            except Exception as exc:  # noqa: BLE001 — isolate the stream
+                self.dropped_errors += 1
+                if self._on_error is not None:
+                    try:
+                        self._on_error(exc)
+                    except Exception:  # noqa: BLE001 — recorder must not kill drain
+                        pass
+                else:
+                    log.warning("dispatch handler error on %r: %r", key, exc)
         # Batch exhausted with work remaining: requeue behind other senders.
         self._pool.submit(self._drain, key)
 
@@ -461,7 +476,10 @@ class TCPNetwork:
         # behind it. Per-sender ordered queues on a shared pool: order is
         # preserved within a sender, and one sender's slow decode cannot
         # stall delivery from other peers.
-        self._dispatch = _SerialDispatcher(max_workers=4, max_queue=recv_window)
+        self._dispatch = _SerialDispatcher(
+            max_workers=4, max_queue=recv_window,
+            on_error=self._record_error,
+        )
         # Write coalescing state — touched only on the event-loop thread.
         self._pending: dict[asyncio.StreamWriter, list[bytes]] = {}
         self._pending_bytes: dict[asyncio.StreamWriter, int] = {}

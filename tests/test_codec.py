@@ -124,6 +124,29 @@ def test_par1_reconstruct_falls_back(rng):
         assert np.array_equal(fixed[i], full[i]), i
 
 
+def test_subset_search_truncation_surfaced(rng, monkeypatch):
+    """When the invertible-subset search hits its cap without a basis, the
+    failure is reported as the distinct SubsetSearchTruncated (a ValueError
+    subclass), not the opaque exhausted-search error."""
+    import noise_ec_tpu.codec.rs as rs_mod
+    from noise_ec_tpu.codec import SubsetSearchTruncated
+
+    rs = ReedSolomon(4, 2, backend="numpy")
+    data = [rng.integers(0, 256, 16).astype(np.uint8) for _ in range(4)]
+    full = rs.encode(data)
+    damaged = [None, *full[1:]]
+    # Cap 0: every candidate subset is past the cap, so the search is
+    # truncated before trying any basis — the distinct error must surface.
+    monkeypatch.setattr(rs_mod, "SUBSET_SEARCH_CAP", 0)
+    with pytest.raises(SubsetSearchTruncated, match="truncated at 0"):
+        rs.reconstruct(damaged)
+    assert issubclass(SubsetSearchTruncated, ValueError)
+    # At the default cap the same shard set reconstructs fine.
+    monkeypatch.undo()
+    fixed = rs.reconstruct(damaged)
+    assert np.array_equal(fixed[0], full[0])
+
+
 # -- FEC (infectious-style) -----------------------------------------------
 
 

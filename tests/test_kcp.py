@@ -163,6 +163,50 @@ def test_arq_graceful_close_delivers_queued_tail():
     _run(go())
 
 
+def test_arq_half_close_request_response():
+    """Closing the writer ends only OUR direction: the peer still reads a
+    clean EOF, can respond over its own send side, and the closer receives
+    the full response before either session fully closes (TCP-parity
+    half-close; pre-fix a FIN tore down the whole duplex session)."""
+    async def go():
+        a, b, pump = _pair()
+        req = bytes(range(256)) * 40   # crosses several segments
+        resp = bytes(reversed(req)) * 2
+        a.write(req)
+        a.flush_partial()
+        KcpWriter(a).close()  # a: FIN after req — read side must stay live
+        responded = False
+        for _ in range(600):
+            pump()
+            await asyncio.sleep(0)
+            # b sees EOF once a's FIN delivers, then sends its response.
+            if b._read_eof and not responded:
+                assert not b.closed  # half-closed, not torn down
+                got_req = await asyncio.wait_for(
+                    b.reader.readexactly(len(req)), 5
+                )
+                assert got_req == req
+                b.write(resp)
+                b.flush_partial()
+                KcpWriter(b).close()
+                responded = True
+            if responded and a.reader._buffer and \
+                    len(a.reader._buffer) >= len(resp):
+                break
+        got = await asyncio.wait_for(a.reader.readexactly(len(resp)), 5)
+        assert got == resp
+        assert await asyncio.wait_for(a.reader.read(), 5) == b""  # clean EOF
+        for _ in range(50):  # both sides converge to fully closed
+            pump()
+            await asyncio.sleep(0.01)
+            if a.closed and b.closed:
+                break
+        assert a.closed and b.closed
+        a.close(); b.close()
+
+    _run(go())
+
+
 def test_endpoint_ignores_stray_midstream_push_and_tombstones():
     """Mid-stream retransmissions for a dead session must not resurrect a
     zombie session; a closed (addr, conv) is tombstoned."""
