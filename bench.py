@@ -165,12 +165,16 @@ def main() -> None:
                 ),
                 f"TPU RS({k3},{r3}) encode != golden codec",
             )
-            # ~8 MiB object with WORD_QUANTUM-aligned shards (like the
-            # headline's 1 MiB shards): an unaligned size would charge the
-            # kernel for pad bytes it computes but the object never uses
-            # (RS(50,20)'s old size padded 41472 -> 49152 words, a 18% tax;
-            # RS(17,3) was already aligned).
-            S3 = ((8 << 20) // k3 // 4 // WORD_QUANTUM) * WORD_QUANTUM
+            # ~8 MiB object with shards aligned to the TL=512 lane-tile
+            # quantum (8*8*512 = 32768 words): the planner can only use
+            # the TL >= 256 tile brackets (pairwise delta-swap transpose)
+            # when W8 divides by the tile, and the streaming chunk size is
+            # the framework's own knob — RS(17,3) measured 513 GB/s at an
+            # aligned shape vs 395 at the old WORD_QUANTUM-only alignment
+            # (which landed on W8 = 1920, divisible by neither 512 nor
+            # 256, silently forcing TL=128).
+            TILE_Q = 8 * 8 * 512
+            S3 = max(TILE_Q, ((8 << 20) // k3 // 4 // TILE_Q) * TILE_Q)
             w3 = jnp.asarray(
                 rng.integers(0, 1 << 32, size=(k3, S3), dtype=np.uint64).astype(np.uint32)
             )
@@ -264,6 +268,45 @@ def main() -> None:
         t_enc = (time.perf_counter() - t0) / 3
         gbps = data_bytes / t_enc / 1e9
 
+    # --- config D: decode under corruption (the infectious Decode
+    # guarantee, SURVEY.md §2.3 D1 — error CORRECTION, not just erasure
+    # fill). 1 MiB shards, all n shares present, RS(10,4):
+    # (a) whole-share: one share entirely wrong (the BW decoder's
+    #     vectorized fast path — one interpolation + re-encode);
+    # (b) scattered: corrupt bytes sprinkled across two shares
+    #     (per-column Berlekamp-Welch on the affected columns).
+    try:
+        from noise_ec_tpu.codec.fec import FEC, Share
+
+        fec = FEC(k, k + r, backend="device" if on_tpu else "numpy")
+        S1 = 1 << 20
+        stripes = rng.integers(0, 256, size=(k, S1)).astype(np.uint8)
+        shares = fec.encode_shares(stripes.tobytes())
+        for name in ("whole_share", "scattered"):
+            bad = [Share(s.number, s.data) for s in shares]
+            if name == "whole_share":
+                flip = np.frombuffer(bad[1].data, np.uint8) ^ 0xA5
+                bad[1] = Share(1, flip.tobytes())
+            else:
+                for j, pos_seed in ((1, 11), (2, 13)):
+                    arr = np.frombuffer(bad[j].data, np.uint8).copy()
+                    pos = np.random.default_rng(pos_seed).integers(0, S1, 32)
+                    arr[pos] ^= 0x5A
+                    bad[j] = Share(j, arr.tobytes())
+            got = fec.decode(bad)  # warm + correctness
+            check_smoke(got == stripes.tobytes(),
+                        f"corrupted-decode ({name}) wrong bytes")
+            ts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                fec.decode(bad)
+                ts.append(time.perf_counter() - t0)
+            stats[f"decode_corrupt_{name}_p50_ms"] = round(
+                sorted(ts)[1] * 1e3, 2
+            )
+    except Exception as exc:  # noqa: BLE001 — secondary stat only
+        stats["decode_corrupt_error"] = str(exc)[:80]
+
     # --- host-runtime story: full node round trip on the in-process
     # loopback peer set (sign -> shard -> proto marshal -> dispatch ->
     # reassemble -> Ed25519 verify), the reference's actual workload
@@ -307,6 +350,42 @@ def main() -> None:
         payload = payloads[0]
         stats["host_node_roundtrip_msgs_per_s"] = round(1.0 / t_host, 1)
         stats["host_node_roundtrip_mb_per_s"] = round(len(payload) / t_host / 1e6, 1)
+
+        # --- large-object streaming: one 64 MiB object node-to-node as
+        # 4 MiB erasure-coded chunks (sign once -> chunked encode ->
+        # per-shard wire messages -> per-chunk reassembly -> one verify),
+        # the round-3 end-to-end fast path. Two backends: the host-only
+        # tier (numpy plugin + native C++ shim encode) and, on TPU, the
+        # device codec through the pipelined StreamingEncoder.
+        big = bytes(rng.integers(0, 256, size=64 << 20, dtype=np.uint8))
+        for backend in ("numpy",) + (("device",) if on_tpu else ()):
+            got = []
+            # Fresh hub: exactly two nodes see the stream (the small-message
+            # nodes above must not multiply the fan-out).
+            hub2 = LoopbackHub()
+            node_a = LoopbackNetwork(hub2, format_address("tcp", "localhost", 3100))
+            node_b = LoopbackNetwork(hub2, format_address("tcp", "localhost", 3101))
+            node_a.add_plugin(ShardPlugin(
+                backend=backend, minimum_needed_shards=10, total_shards=14,
+            ))
+            node_b.add_plugin(ShardPlugin(
+                backend=backend, minimum_needed_shards=10, total_shards=14,
+                on_message=lambda m, s: got.append(len(m)),
+            ))
+            send_plugin = node_a.plugins[0]
+            # warm (shim/kernels/pools), then one timed pass
+            send_plugin.stream_and_broadcast(node_a, big[: 8 << 20],
+                                             chunk_bytes=4 << 20)
+            got.clear()
+            t0 = time.perf_counter()
+            send_plugin.stream_and_broadcast(node_a, big, chunk_bytes=4 << 20)
+            t_big = time.perf_counter() - t0
+            if got != [len(big)]:
+                raise RuntimeError(f"stream bench lost the object: {got}")
+            suffix = "" if backend == "numpy" else "_device"
+            stats[f"host_node_large_object{suffix}_mb_per_s"] = round(
+                len(big) / t_big / 1e6, 1
+            )
     except Exception as exc:  # noqa: BLE001 — secondary stat only
         stats["host_node_error"] = str(exc)[:80]
 

@@ -88,6 +88,12 @@ class FEC:
             self._mds_grs = True
         except ValueError:
             self._mds_grs = False
+        self._systematic = bool(
+            np.array_equal(
+                self._golden.G[:required],
+                np.eye(required, dtype=self._golden.G.dtype),
+            )
+        )
 
     @property
     def required(self) -> int:
@@ -157,24 +163,41 @@ class FEC:
         drop to per-column Berlekamp-Welch (matrix/bw.py) on the MDS GRS
         constructions; only par1 uses the golden consistent-subset search.
         """
-        dedup: dict[int, np.ndarray] = {}
+        dedup_raw: dict[int, bytes] = {}
         for s in shares:
             num = int(s.number)
             if not 0 <= num < self.n:
                 raise ValueError(
                     f"share number {num} out of range [0, {self.n})"
                 )
-            arr = self._sym(np.frombuffer(bytes(s.data), dtype=np.uint8))
-            if num in dedup:
-                if not np.array_equal(dedup[num], arr):
+            raw = bytes(s.data)
+            if num in dedup_raw:
+                if dedup_raw[num] != raw:
                     raise ValueError(f"conflicting copies of share {num}")
                 continue
-            dedup[num] = arr
-        if len(dedup) < self.k:
+            dedup_raw[num] = raw
+        if len(dedup_raw) < self.k:
             raise NotEnoughShardsError(
-                f"have {len(dedup)} shares, need {self.k}"
+                f"have {len(dedup_raw)} shares, need {self.k}"
             )
-        nums = sorted(dedup)
+        nums = sorted(dedup_raw)
+        if (
+            len(nums) == self.k
+            and nums == list(range(self.k))
+            and self._systematic
+            and len({len(b) for b in dedup_raw.values()}) == 1
+            and len(dedup_raw[0]) % (self._golden.gf.degree // 8) == 0
+        ):
+            # Systematic in-order shortcut with exactly k shares: the
+            # shares ARE the data split and there is no redundancy to
+            # check against (main.go:77 case) — one join, zero field ops
+            # and zero numpy round-trips (the stream receive hot path).
+            self.stats["fast_decodes"] += 1
+            return b"".join(dedup_raw[i] for i in range(self.k))
+        dedup = {
+            num: self._sym(np.frombuffer(raw, dtype=np.uint8))
+            for num, raw in dedup_raw.items()
+        }
         fast = self._decode_fast(nums, dedup)
         if fast is not None:
             self.stats["fast_decodes"] += 1
@@ -200,11 +223,20 @@ class FEC:
         disagreement."""
         G = self._golden.G
         basis = nums[: self.k]
-        try:
-            inv = gf_inv(self._golden.gf, G[basis])
-        except np.linalg.LinAlgError:
-            return None
-        data = self._rs._mul(inv, np.stack([stripes[i] for i in basis]))
+        if basis == list(range(self.k)) and np.array_equal(
+            G[: self.k], np.eye(self.k, dtype=G.dtype)
+        ):
+            # Systematic shortcut: the first k shares ARE the data rows
+            # (G[:k] == I), so the inverse is the identity and the multiply
+            # is a stack — the common in-order delivery case costs zero
+            # field ops before the consistency check.
+            data = np.stack([stripes[i] for i in basis])
+        else:
+            try:
+                inv = gf_inv(self._golden.gf, G[basis])
+            except np.linalg.LinAlgError:
+                return None
+            data = self._rs._mul(inv, np.stack([stripes[i] for i in basis]))
         if len(nums) == self.k:
             return data  # no redundancy to check against (main.go:77 case)
         codeword = self._rs._mul(G[nums], data)

@@ -64,6 +64,7 @@ class GF:
         exp[order - 1 :] = exp[: order - 1]
         self.exp = exp
         self.log = log
+        self._lut_cache: dict[int, np.ndarray] = {}
 
     # -- scalar/element-wise ops ------------------------------------------
 
@@ -118,20 +119,60 @@ class GF:
         prod = self.mul(A[:, :, None], B[None, :, :])  # (r, k, c)
         return np.bitwise_xor.reduce(prod.astype(np.int64), axis=1).astype(self.dtype)
 
+    def _const_lut(self, c: int) -> np.ndarray:
+        """Full multiplication table row for constant ``c``: lut[x] = c*x.
+
+        One gather per stripe instead of the generic mul's two log
+        lookups + add + exp lookup + zero-mask over int32 temporaries —
+        ~6x less memory traffic on the host encode/decode hot loop.
+        Cached per constant (256 B for GF(2^8), 128 KiB for GF(2^16)).
+        """
+        lut = self._lut_cache.get(c)
+        if lut is None:
+            lut = self.mul(c, np.arange(self.order, dtype=np.int32))
+            if len(self._lut_cache) > 512:
+                self._lut_cache.clear()
+            self._lut_cache[c] = lut
+        return lut
+
+    def mul_const(self, c: int, x: np.ndarray) -> np.ndarray:
+        """c * x for a scalar constant and an array, via the cached LUT."""
+        c = int(c)
+        x = np.asarray(x, dtype=self.dtype)
+        if c == 0:
+            return np.zeros_like(x)
+        if c == 1:
+            return x
+        return self._const_lut(c)[x]
+
     def matvec_stripes(self, A, D):
         """A @ D where D holds one stripe per row. A: (r, k), D: (k, S) -> (r, S).
 
         This IS the encode hot loop shape (reference main.go:262): parity
-        stripes = generator-parity-rows x data stripes. Row-blocked to bound
-        the (r, k, S) intermediate.
+        stripes = generator-parity-rows x data stripes. Per-coefficient
+        LUT gathers with in-place XOR accumulation; zero coefficients are
+        skipped and unit coefficients degrade to plain XOR (so the
+        systematic identity rows and sparse reconstruction matrices cost
+        only copies).
         """
-        A = np.asarray(A, dtype=np.int32)
-        D = np.asarray(D, dtype=np.int32)
+        A = np.asarray(A)
+        D = np.asarray(D, dtype=self.dtype)
         r, k = A.shape
-        out = np.empty((r, D.shape[1]), dtype=self.dtype)
+        out = np.zeros((r, D.shape[1]), dtype=self.dtype)
         for i in range(r):
-            prod = self.mul(A[i][:, None], D)  # (k, S)
-            out[i] = np.bitwise_xor.reduce(prod.astype(np.int64), axis=0).astype(self.dtype)
+            acc = None
+            for j in range(k):
+                c = int(A[i, j])
+                if c == 0:
+                    continue
+                term = self.mul_const(c, D[j])
+                if acc is None:
+                    # copy=True: term may alias a D row (c == 1).
+                    acc = np.array(term, dtype=self.dtype)
+                else:
+                    np.bitwise_xor(acc, term, out=acc)
+            if acc is not None:
+                out[i] = acc
         return out
 
 

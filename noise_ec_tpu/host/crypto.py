@@ -47,6 +47,17 @@ class Blake2bPolicy:
     def hash_bytes(self, data: bytes) -> bytes:
         return hashlib.blake2b(data, digest_size=self.digest_size).digest()
 
+    def hash_parts(self, parts) -> bytes:
+        """Hash the concatenation of ``parts`` without materializing it:
+        bit-identical to ``hash_bytes(b"".join(parts))`` (BLAKE2b is a
+        streaming hash), but skips the join copy — the signing preimage
+        is header + full message (serialize_message), so on large objects
+        the join is a whole-object memcpy."""
+        h = hashlib.blake2b(digest_size=self.digest_size)
+        for p in parts:
+            h.update(p)
+        return h.digest()
+
 
 class Ed25519Policy:
     """Ed25519 signature policy (noise/crypto/ed25519.New())."""
@@ -120,6 +131,13 @@ class KeyPair:
         main.go:219-223."""
         return sig_policy.sign(self.private_key, hash_policy.hash_bytes(message))
 
+    def sign_parts(
+        self, sig_policy: Ed25519Policy, hash_policy: Blake2bPolicy, parts
+    ) -> bytes:
+        """``sign`` over the concatenation of ``parts`` (same signature
+        bytes, no join copy)."""
+        return sig_policy.sign(self.private_key, hash_policy.hash_parts(parts))
+
 
 def verify(
     sig_policy: Ed25519Policy,
@@ -130,6 +148,17 @@ def verify(
 ) -> bool:
     """crypto.Verify(sigPolicy, hashPolicy, pubkey, msg, sig) — main.go:82-89."""
     return sig_policy.verify(public_key, hash_policy.hash_bytes(message), signature)
+
+
+def verify_parts(
+    sig_policy: Ed25519Policy,
+    hash_policy: Blake2bPolicy,
+    public_key: bytes,
+    parts,
+    signature: bytes,
+) -> bool:
+    """``verify`` over the concatenation of ``parts`` (no join copy)."""
+    return sig_policy.verify(public_key, hash_policy.hash_parts(parts), signature)
 
 
 @dataclass(frozen=True)
@@ -161,13 +190,20 @@ def serialize_message(peer_id: PeerID, message: bytes) -> bytes:
     precomputed size (main.go:297-299); here the construction makes that
     impossible by design.
     """
+    return b"".join(serialize_message_parts(peer_id, message))
+
+
+def serialize_message_parts(peer_id: PeerID, message: bytes) -> tuple:
+    """``serialize_message`` as (header, message) parts — lets callers
+    hash/sign the preimage without the whole-message join copy
+    (``hash_parts``); the digest is identical by BLAKE2b streaming."""
     addr = peer_id.address.encode("utf-8")
-    return b"".join(
+    header = b"".join(
         [
             struct.pack("<I", len(addr)),
             addr,
             struct.pack("<I", len(peer_id.node_id)),
             peer_id.node_id,
-            message,
         ]
     )
+    return (header, message)

@@ -178,6 +178,18 @@ class ShardPool:
         with self._lock:
             return self._pools.get(key)
 
+    def snapshot(self, key: str) -> tuple[list[Share], int]:
+        """(ordered share list copy, distinct count) under the pool lock —
+        safe to hand to a decoder while other threads keep adding
+        (iterating a live ``entry.shares`` outside the lock races with
+        ``add``)."""
+        with self._lock:
+            entry = self._pools.get(key)
+            if entry is None:
+                return [], 0
+            shares = [entry.shares[i] for i in sorted(entry.shares)]
+            return shares, len(shares)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._pools)

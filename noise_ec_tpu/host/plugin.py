@@ -30,7 +30,9 @@ from noise_ec_tpu.host.crypto import (
     KeyPair,
     PeerID,
     serialize_message,
+    serialize_message_parts,
     verify,
+    verify_parts,
 )
 from noise_ec_tpu.host.mempool import PoolLimitError, PoolTooLargeError, ShardPool
 from noise_ec_tpu.host.wire import Shard
@@ -159,6 +161,17 @@ class ShardPlugin:
         # tear the geometry or skip the max_total_shards validation
         # (round-1 ADVICE finding 5).
         self._geometry_lock = threading.Lock()
+        # Stream reassembly state (see _receive_stream) — initialized here,
+        # not lazily: concurrent first stream shards must share one lock
+        # and one table, and operator-configured caps must survive.
+        self._streams: OrderedDict[str, dict] = OrderedDict()
+        self._streams_lock = threading.Lock()
+        self.max_stream_object_bytes = self.DEFAULT_MAX_STREAM_OBJECT_BYTES
+        self.max_stream_objects = self.DEFAULT_MAX_STREAM_OBJECTS
+        self.max_stream_total_bytes = self.DEFAULT_MAX_STREAM_TOTAL_BYTES
+        self.max_stream_chunks = self.DEFAULT_MAX_STREAM_CHUNKS
+        self._stream_buf_bytes = 0  # sum of active reassembly buffers
+        self._shim_cache: dict[tuple[int, int], object] = {}
 
     # ---------------------------------------------------------------- codec
 
@@ -300,6 +313,433 @@ class ShardPlugin:
             )
             return self.minimum_needed_shards, self.total_shards
 
+    # ---------------------------------------------------- streaming objects
+
+    # Caps for the stream reassembly state (attacker-influenced sizes ride
+    # in every stream shard, so all are validated before allocation):
+    # per-object bytes, objects in flight, TOTAL reassembly-buffer bytes
+    # across objects (a forged tiny shard pins a whole object's buffer,
+    # so per-object x objects alone would multiply), and chunk count
+    # (teardown/repair loops iterate it).
+    DEFAULT_MAX_STREAM_OBJECT_BYTES = 1 << 30
+    DEFAULT_MAX_STREAM_OBJECTS = 8
+    DEFAULT_MAX_STREAM_TOTAL_BYTES = 1 << 30
+    DEFAULT_MAX_STREAM_CHUNKS = 4096
+    STREAM_TTL_SECONDS = 120.0
+
+    def stream_and_broadcast(
+        self,
+        network,
+        data: bytes,
+        *,
+        chunk_bytes: int = 4 << 20,
+        geometry: Optional[tuple[int, int]] = None,
+    ) -> int:
+        """Broadcast a large object as a stream of erasure-coded chunks.
+
+        The reference's node pushes whole stdin lines through one codec
+        call (main.go:201-210); objects far beyond one codeword need the
+        streaming shape instead (SURVEY.md §5 "long-context" row): the
+        object is signed ONCE (same ``serialize_message`` preimage as a
+        plain broadcast), split into fixed-capacity chunks, each chunk
+        encoded as an independent RS(k, n) codeword — on the device
+        backend through the pipelined ``StreamingEncoder``, so chunk i+1
+        transfers while chunk i computes — and every share travels as a
+        wire ``Shard`` carrying (chunk_index, chunk_count, object_bytes)
+        in the streaming extension fields (wire.py fields 6-8).
+
+        Chunk loss is repaired per chunk by the parity shares; corruption
+        surfaces at the object-level signature verify on the receiver,
+        exactly the reference's trust model (main.go:82-99). Returns the
+        number of chunks sent.
+        """
+        if not data:
+            raise ValueError("cannot stream an empty object")
+        k, n = geometry or (self.minimum_needed_shards, self.total_shards)
+        if not 1 <= k <= n <= self.max_total_shards:
+            raise ValueError(f"invalid stream geometry k={k} n={n}")
+        # Chunk capacity: whole uint32 words per stripe so the padded
+        # chunk equals the capacity on every backend (see wire.py field
+        # docs — the receiver derives per-chunk payload from it).
+        B = max(4 * k, chunk_bytes - chunk_bytes % (4 * k))
+        count = -(-len(data) // B)
+        # Same preimage as a plain broadcast (serialize_message), hashed
+        # in parts to skip a whole-object join copy.
+        file_signature = network.keys.sign_parts(
+            self.signature_policy,
+            self.hash_policy,
+            serialize_message_parts(network.id, data),
+        )
+        shards_out = bytes_out = 0
+        for index, shares in self._encode_chunks(data, k, n, B):
+            for s in shares:
+                shard = Shard(
+                    file_signature=file_signature,
+                    shard_data=s.data,
+                    shard_number=s.number,
+                    total_shards=n,
+                    minimum_needed_shards=k,
+                    stream_chunk_index=index,
+                    stream_chunk_count=count,
+                    stream_object_bytes=len(data),
+                )
+                network.broadcast(shard)
+                shards_out += 1
+                bytes_out += len(s.data)
+        self.counters.add("stream_chunks_out", count)
+        self.counters.add("shards_out", shards_out)
+        self.counters.add("bytes_out", bytes_out)
+        return count
+
+    def _encode_chunks(self, data: bytes, k: int, n: int, B: int):
+        """Yield (chunk_index, shares) for every chunk of ``data``.
+
+        Device backend: the pipelined StreamingEncoder (H2D of chunk i+1
+        overlaps chunk i's kernels). Other backends: per-chunk FEC encode
+        of the zero-padded chunk.
+        """
+        if self.backend == "device":
+            from noise_ec_tpu.parallel.streaming import StreamingEncoder
+
+            enc = StreamingEncoder(k, n - k, chunk_bytes=B)
+            for sc in enc.encode_bytes(data):
+                # memoryview rows, not .tobytes(): the wire marshal joins
+                # from the buffer directly, one copy instead of two.
+                yield sc.index, [
+                    Share(i, sc.shards[i].data) for i in range(n)
+                ]
+            return
+        import numpy as np
+
+        shim = self._stream_shim(k, n)
+        count = -(-len(data) // B)
+        stride = B // k
+        view = memoryview(data)
+        for index in range(count):
+            chunk = view[index * B : (index + 1) * B]
+            if shim is not None:
+                # Native C++ codec (byte-identical to the golden matrices,
+                # tests/test_shim.py): zero-copy parity fill in one buffer.
+                buf = np.zeros((n, stride), dtype=np.uint8)
+                flat = buf[:k].reshape(-1)
+                flat[: len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+                shim.encode_into(buf)
+                yield index, [Share(i, buf[i].data) for i in range(n)]
+            else:
+                padded = bytes(chunk)
+                if len(padded) < B:
+                    padded = padded + bytes(B - len(padded))
+                yield index, self._fec(k, n).encode_shares(padded)
+
+    def _stream_shim(self, k: int, n: int):
+        """Native C++ codec for the host-only stream encode, or None.
+
+        The numpy backend exists to serve hosts without a device; its
+        stream hot loop still deserves the native path (SURVEY.md §2.2 —
+        the shim IS the framework's native host codec)."""
+        key = (k, n)
+        if key not in self._shim_cache:
+            try:
+                from noise_ec_tpu.shim import CppReedSolomon
+
+                self._shim_cache[key] = CppReedSolomon(k, n - k)
+            except Exception:  # noqa: BLE001 — any load/build failure -> FEC
+                self._shim_cache[key] = None
+        return self._shim_cache[key]
+
+    def _receive_stream(self, ctx: PluginContext, msg: Shard) -> Optional[bytes]:
+        """Stream-shard arm of the receive state machine.
+
+        Each chunk reassembles through the same ShardPool (pool key =
+        object signature + chunk index, so chunk pools inherit the TTL /
+        byte caps and dedup); decoded chunks land in a preallocated
+        object buffer; completion of the last chunk triggers the one
+        object-level signature verify and delivery.
+
+        Repairability matches the non-stream path: chunk pools are kept
+        (not evicted) until the OBJECT verifies, and a chunk re-decodes
+        whenever its pool has gained shares since its last decode — so a
+        corrupted share among the first k of a chunk (which decodes
+        "successfully" at exactly k, with nothing to check against) is
+        corrected by Berlekamp-Welch once a parity share arrives, and the
+        object re-verifies. CorruptionError is raised only when every
+        chunk already holds all n shares and the signature still fails —
+        no future arrival can help.
+        """
+        key = msg.file_signature.hex()
+        if self._recently_completed(key):
+            self.counters.add("late_shards", 1)
+            return None
+        k = int(msg.minimum_needed_shards)
+        n = int(msg.total_shards)
+        count = int(msg.stream_chunk_count)
+        index = int(msg.stream_chunk_index)
+        length = int(msg.stream_object_bytes)
+        if not 1 <= k <= n <= self.max_total_shards:
+            self.counters.add("rejected_shards", 1)
+            raise ValueError(f"invalid geometry k={k} n={n} in stream shard")
+        if not 0 <= msg.shard_number < n:
+            self.counters.add("rejected_shards", 1)
+            raise ValueError(
+                f"shard number {msg.shard_number} out of range for n={n}"
+            )
+        streams = self._streams
+        if not 0 <= index < count:
+            self.counters.add("rejected_shards", 1)
+            raise ValueError(f"stream chunk {index} out of range [0, {count})")
+        if not 0 < length <= self.max_stream_object_bytes:
+            self.counters.add("rejected_shards", 1)
+            raise ValueError(
+                f"stream object of {length} bytes outside (0, "
+                f"{self.max_stream_object_bytes}]"
+            )
+        if count > self.max_stream_chunks:
+            self.counters.add("rejected_shards", 1)
+            raise ValueError(
+                f"stream chunk count {count} exceeds the cap "
+                f"{self.max_stream_chunks}"
+            )
+        B = k * len(msg.shard_data)
+        if B <= 0 or (count - 1) * B >= length or count * B < length:
+            self.counters.add("rejected_shards", 1)
+            raise ValueError(
+                f"stream chunk capacity {B} inconsistent with "
+                f"{count} chunks / {length} bytes"
+            )
+        now = time.monotonic()
+        with self._streams_lock:
+            st = streams.get(key)
+            if st is None:
+                # Expire stale objects, then admit (bounded).
+                for stale in [
+                    sk for sk, sv in streams.items()
+                    if now - sv["created"] > self.STREAM_TTL_SECONDS
+                ]:
+                    self._drop_stream_locked(stale)
+                if len(streams) >= self.max_stream_objects:
+                    self.counters.add("stream_rejections", 1)
+                    raise PoolLimitError(
+                        f"{len(streams)} stream objects in flight"
+                    )
+                if self._stream_buf_bytes + length > self.max_stream_total_bytes:
+                    self.counters.add("stream_rejections", 1)
+                    raise PoolLimitError(
+                        f"stream reassembly budget exhausted "
+                        f"({self._stream_buf_bytes} + {length} > "
+                        f"{self.max_stream_total_bytes})"
+                    )
+                self._stream_buf_bytes += length
+                st = {
+                    "buf": bytearray(length),
+                    # chunk index -> pool distinct count at last decode
+                    "done": {},
+                    "count": count,
+                    "B": B,
+                    "length": length,
+                    "created": now,
+                    "failed": False,  # a whole-object verify has failed
+                }
+                streams[key] = st
+            if (st["count"], st["B"], st["length"]) != (count, B, length):
+                self.counters.add("rejected_shards", 1)
+                raise ValueError(
+                    "stream shard disagrees with the object's pinned "
+                    f"shape (count {count} vs {st['count']}, capacity "
+                    f"{B} vs {st['B']}, length {length} vs {st['length']})"
+                )
+
+        share = Share(msg.shard_number, bytes(msg.shard_data))
+        pool_key = f"{key}:{index}"
+        try:
+            snapshot, distinct, was_new = self.pool.add(pool_key, share, k, n)
+        except PoolLimitError:
+            self.counters.add("pool_limit_rejections", 1)
+            raise
+        except ValueError:
+            self.counters.add("rejected_shards", 1)
+            raise
+        if distinct < k or not was_new:
+            return None
+        with self._streams_lock:
+            st = streams.get(key)
+            if st is None:
+                return None
+            prior = st["done"].get(index)
+            if prior is not None and not (st["failed"] and distinct > prior):
+                # Already decoded, and no verify failure demands a
+                # re-decode: extra shares just accumulate in the pool
+                # (repair evidence for later), the happy path pays one
+                # decode per chunk.
+                self.counters.add("late_shards", 1)
+                return None
+        fec = self._fec(k, n)
+        try:
+            with Timer(self.counters, "decode_s",
+                       nbytes=sum(len(s.data) for s in snapshot)):
+                chunk = fec.decode(snapshot)
+        except Exception as exc:
+            self.counters.add("decode_errors", 1)
+            log.error("stream chunk %d decode failed for %s…: %s",
+                      index, key[:16], exc)
+            if distinct >= n:
+                self._drop_stream(key)
+                raise CorruptionError(
+                    f"all {n} shards of stream chunk {index} arrived for "
+                    f"{key[:16]}… but decode fails: {exc}"
+                ) from exc
+            return None
+        self.counters.add("decodes", 1)
+
+        with self._streams_lock:
+            st = streams.get(key)
+            if st is None:
+                return None
+            data_len = min(st["B"], st["length"] - index * st["B"])
+            lo = index * st["B"]
+            first = index not in st["done"]
+            # Compare only on RE-decodes (repair mode): on the first
+            # decode the comparison is meaningless and its two 4 MiB
+            # copies per chunk were ~25% of the happy path.
+            changed = (not first) and (
+                memoryview(chunk)[:data_len]
+                != memoryview(st["buf"])[lo : lo + data_len]
+            )
+            if first or changed:
+                st["buf"][lo : lo + data_len] = memoryview(chunk)[:data_len]
+            st["done"][index] = distinct
+            if len(st["done"]) < st["count"]:
+                return None
+            if not (first or changed):
+                # A post-failure re-decode produced the same bytes: only
+                # the unrecoverability verdict can have changed.
+                complete = None
+            else:
+                # The live buffer, not a copy: the verify hash reads it
+                # in place; bytes are materialized only on delivery.
+                # (Per-sender serialized dispatch keeps it stable across
+                # the verify.)
+                complete = st["buf"]
+
+        if complete is not None:
+            delivered = self._verify_stream_object(ctx, msg, key, complete)
+            if delivered is not None:
+                return delivered
+        # Verify failed (now or earlier): try to repair from the pooled
+        # shares, then decide recoverability.
+        return self._repair_stream(ctx, msg, key, k, n, count)
+
+    def _verify_stream_object(
+        self, ctx: PluginContext, msg: Shard, key: str, complete
+    ) -> Optional[bytes]:
+        """Verify + deliver a fully reassembled object (``complete`` may
+        be the live reassembly bytearray — hashed in place, materialized
+        as bytes only on delivery); None on failure (caller decides
+        repair/unrecoverability)."""
+        sender = ctx.sender()
+        ok = verify_parts(
+            self.signature_policy,
+            self.hash_policy,
+            ctx.client_public_key(),
+            serialize_message_parts(sender, complete),
+            msg.file_signature,
+        )
+        if not ok:
+            self.counters.add("verify_failures", 1)
+            log.warning("stream object signature verify failed for %s…",
+                        key[:16])
+            with self._streams_lock:
+                st = self._streams.get(key)
+                if st is not None:
+                    st["failed"] = True
+            return None
+        if not self._mark_completed(key):
+            self.counters.add("late_shards", 1)
+            return None
+        delivered = bytes(complete)
+        self._drop_stream(key)
+        self.counters.add("verified", 1)
+        self.counters.add("stream_objects_in", 1)
+        log.info("completed stream object %s… (%d bytes)",
+                 key[:16], len(delivered))
+        if self.on_message is not None:
+            self.on_message(delivered, sender)
+        return delivered
+
+    def _repair_stream(
+        self, ctx: PluginContext, msg: Shard, key: str, k: int, n: int,
+        count: int,
+    ) -> Optional[bytes]:
+        """After a verify failure: re-decode every chunk whose pool holds
+        more shares than its last decode used (the extra shares enable
+        the consistency check and Berlekamp-Welch correction), re-verify
+        if anything changed, and raise CorruptionError only once every
+        chunk has all n shares and the signature still fails."""
+        fec = self._fec(k, n)
+        while True:
+            changed_any = False
+            for i in range(count):
+                shares, _ = self.pool.snapshot(f"{key}:{i}")
+                if not shares:
+                    continue
+                with self._streams_lock:
+                    st = self._streams.get(key)
+                    if st is None:
+                        return None
+                    if len(shares) <= st["done"].get(i, 0):
+                        continue
+                try:
+                    chunk = fec.decode(shares)
+                except Exception:  # noqa: BLE001 — keep repairing others
+                    self.counters.add("decode_errors", 1)
+                    continue
+                self.counters.add("decodes", 1)
+                with self._streams_lock:
+                    st = self._streams.get(key)
+                    if st is None:
+                        return None
+                    data_len = min(st["B"], st["length"] - i * st["B"])
+                    lo = i * st["B"]
+                    if bytes(st["buf"][lo : lo + data_len]) != chunk[:data_len]:
+                        st["buf"][lo : lo + data_len] = (
+                            memoryview(chunk)[:data_len]
+                        )
+                        changed_any = True
+                    st["done"][i] = len(shares)
+            if not changed_any:
+                break
+            with self._streams_lock:
+                st = self._streams.get(key)
+                if st is None or len(st["done"]) < st["count"]:
+                    return None
+                complete = st["buf"]
+            delivered = self._verify_stream_object(ctx, msg, key, complete)
+            if delivered is not None:
+                self.counters.add("stream_repairs", 1)
+                return delivered
+        if self._stream_has_all_shards(key, count, n):
+            self._drop_stream(key)
+            raise CorruptionError(
+                f"stream object {key[:16]}… has all {n} shards of all "
+                f"{count} chunks but the signature does not verify"
+            )
+        return None
+
+    def _stream_has_all_shards(self, key: str, count: int, n: int) -> bool:
+        return all(
+            self.pool.snapshot(f"{key}:{i}")[1] >= n for i in range(count)
+        )
+
+    def _drop_stream(self, key: str) -> None:
+        with self._streams_lock:
+            self._drop_stream_locked(key)
+
+    def _drop_stream_locked(self, key: str) -> None:
+        st = self._streams.pop(key, None)
+        if st is not None:
+            self._stream_buf_bytes -= st["length"]
+            for i in range(st["count"]):
+                self.pool.evict(f"{key}:{i}")
+
     # -------------------------------------------------------- receive path
 
     def receive(self, ctx: PluginContext) -> Optional[bytes]:
@@ -319,6 +759,8 @@ class ShardPlugin:
             return None
         self.counters.add("shards_in", 1)
         self.counters.add("bytes_in", len(msg.shard_data))
+        if msg.stream_chunk_count:
+            return self._receive_stream(ctx, msg)
         key = msg.file_signature.hex()  # mempool key, main.go:55
         if self._recently_completed(key):
             self.counters.add("late_shards", 1)

@@ -121,6 +121,19 @@ class Shard:
     shard_number: int = 0
     total_shards: int = 0
     minimum_needed_shards: int = 0
+    # --- streaming extension (fields 6-8; this framework only) ---------
+    # Large objects stream as a sequence of independently erasure-coded
+    # chunks sharing ONE object signature (file_signature signs the whole
+    # object; per-chunk pools key on signature + chunk index). All three
+    # fields zero-elide, so non-stream shards marshal byte-identically to
+    # the reference schema, and reference decoders skip them as unknown
+    # fields (shard.pb.go:582-680 skips; so does our _skip_field).
+    # ``stream_chunk_count > 0`` marks a stream shard; every chunk carries
+    # the same payload capacity (k * len(shard_data) bytes), and
+    # ``stream_object_bytes`` trims the final chunk's zero padding.
+    stream_chunk_index: int = 0
+    stream_chunk_count: int = 0
+    stream_object_bytes: int = 0
 
     def __str__(self) -> str:
         """Log-friendly one-liner (the gogoproto String(), SURVEY.md C20):
@@ -146,15 +159,18 @@ class Shard:
         )
 
     def marshal(self) -> bytes:
-        out = bytearray()
+        # shard_data dominates the message (often megabytes on the stream
+        # path): join the three segments so its bytes are copied exactly
+        # once, instead of bytearray-append + bytes() copying them twice.
+        head = bytearray()
         if self.file_signature:
-            out.append(0x0A)
-            _put_varint(out, len(self.file_signature))
-            out += self.file_signature
+            head.append(0x0A)
+            _put_varint(head, len(self.file_signature))
+            head += self.file_signature
         if self.shard_data:
-            out.append(0x12)
-            _put_varint(out, len(self.shard_data))
-            out += self.shard_data
+            head.append(0x12)
+            _put_varint(head, len(self.shard_data))
+        out = bytearray()
         if self.shard_number:
             out.append(0x18)
             _put_varint(out, self.shard_number)
@@ -164,7 +180,18 @@ class Shard:
         if self.minimum_needed_shards:
             out.append(0x28)
             _put_varint(out, self.minimum_needed_shards)
-        return bytes(out)
+        if self.stream_chunk_index:
+            out.append(0x30)
+            _put_varint(out, self.stream_chunk_index)
+        if self.stream_chunk_count:
+            out.append(0x38)
+            _put_varint(out, self.stream_chunk_count)
+        if self.stream_object_bytes:
+            out.append(0x40)
+            _put_varint(out, self.stream_object_bytes)
+        if self.shard_data:
+            return b"".join((bytes(head), self.shard_data, bytes(out)))
+        return bytes(head + out)
 
     def size(self) -> int:
         n = 0
@@ -180,6 +207,12 @@ class Shard:
             n += 1 + _varint_size(self.total_shards)
         if self.minimum_needed_shards:
             n += 1 + _varint_size(self.minimum_needed_shards)
+        if self.stream_chunk_index:
+            n += 1 + _varint_size(self.stream_chunk_index)
+        if self.stream_chunk_count:
+            n += 1 + _varint_size(self.stream_chunk_count)
+        if self.stream_object_bytes:
+            n += 1 + _varint_size(self.stream_object_bytes)
         return n
 
     @classmethod
@@ -207,7 +240,7 @@ class Shard:
                     msg.file_signature = val
                 else:
                     msg.shard_data = val
-            elif field_num in (3, 4, 5):
+            elif field_num in (3, 4, 5, 6, 7, 8):
                 if wire_type != 0:
                     raise WireError(
                         f"field {field_num}: expected wire type 0, got {wire_type}"
@@ -217,8 +250,14 @@ class Shard:
                     msg.shard_number = val
                 elif field_num == 4:
                     msg.total_shards = val
-                else:
+                elif field_num == 5:
                     msg.minimum_needed_shards = val
+                elif field_num == 6:
+                    msg.stream_chunk_index = val
+                elif field_num == 7:
+                    msg.stream_chunk_count = val
+                else:
+                    msg.stream_object_bytes = val
             else:
                 pos = _skip_field(buf, pos, wire_type)
         return msg

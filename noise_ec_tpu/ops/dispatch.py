@@ -88,20 +88,28 @@ def _fused_words_pipeline(r: int, m: int, bits_rows: tuple, interpret: bool):
     )
 
     def f(words):
-        from noise_ec_tpu.ops.pallas_fused import fused_encode_words, fused_lane_tl
+        from noise_ec_tpu.ops.pallas_fused import (
+            NoFusedPlanError,
+            fused_encode_words_planned,
+        )
 
         k, TW = words.shape
         W8 = TW // (8 * m)
-        # Tier 1: single fused kernel (pack -> matmul -> unpack in VMEM,
-        # no HBM intermediates — 1.4D total traffic instead of 4.2D). Only
-        # the tile-fit probe is guarded: a ValueError out of the kernel
-        # build itself is a real bug and must surface.
+        # Tier 1: fused kernel (pack -> matmul -> unpack in VMEM, no HBM
+        # intermediates — 1.4D total traffic instead of 4.2D), through the
+        # verified planner: candidates (single-phase, temp-capped
+        # single-phase, manual-DMA split for wide codes) are ordered by
+        # estimated VPU cost and compile-probed, so a Mosaic stack OOM
+        # demotes to the next plan instead of failing the encode (see
+        # pallas_fused "Verified planning"). Only the no-candidate signal
+        # falls through to tier 2; a ValueError out of the chosen kernel's
+        # build/run is a real bug and must surface.
         try:
-            fused_lane_tl(TW, m, k, r, bits_rows)
-        except ValueError:
+            return fused_encode_words_planned(
+                bits_rows, words, r, m, interpret=interpret
+            )
+        except NoFusedPlanError:
             pass
-        else:
-            return fused_encode_words(bits_rows, words, r, m, interpret=interpret)
         # Tier 2: three-kernel lane pipeline (packed planes round-trip HBM).
         mr = max(k, r)  # ONE rows budget -> ONE TL for pack AND unpack
         try:

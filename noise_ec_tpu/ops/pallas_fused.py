@@ -36,6 +36,9 @@ from noise_ec_tpu.ops.pallas_pack import (
     _ROUNDS16,
     _pack_lanes_kernel,
     _unpack_lanes_kernel,
+    _use_pairwise,
+    lane_delta_swap,
+    transpose_windows,
 )
 from noise_ec_tpu.ops.xor_factor import eval_bits_rows
 
@@ -49,12 +52,15 @@ _FUSED_VMEM_BUDGET = 13 << 20
 
 
 def fused_lane_tl(TW: int, m: int, k: int, r: int, bits_rows: tuple) -> int:
-    """Largest TL in {512, 256, 128} whose fused working set fits VMEM.
+    """Largest TL in {512, 256, 128} whose fused working set fits VMEM
+    WITH the fully-factored network (no temp cap).
 
-    Working set per lane of tile: in block (k rows) and out block (r rows)
-    are double-buffered by the grid pipeline; the two plane scratches
-    (k and r rows) are single-buffered; the Paar network's temporaries are
-    charged via the shared calibrated estimate (see pallas_gf2mm).
+    Conservative by design: callers that guard on this (parallel/batch.py
+    tier selection) do not run the compile probe, and temp-capped plans
+    are exactly the ones whose real Mosaic stack usage the static model
+    cannot predict — those are only reachable through the verified
+    planner (fused_encode_words_planned). Raises ValueError when no
+    uncapped tile fits.
     """
     from noise_ec_tpu.ops.pallas_gf2mm import xor_temp_bytes_per_lane
 
@@ -66,22 +72,75 @@ def fused_lane_tl(TW: int, m: int, k: int, r: int, bits_rows: tuple) -> int:
         if W8 % TL == 0 and per_lane * TL <= _FUSED_VMEM_BUDGET:
             return TL
     raise ValueError(
+        f"no uncapped fused tile for TW={TW}, m={m}, k={k}, r={r}"
+    )
+
+
+# A temp cap is accepted only while the refactored network stays within
+# this factor of the fully-factored XOR cost — beyond it, the extra VPU
+# work outweighs the larger lane tile it buys.
+_CAP_COST_RATIO = 1.25
+
+
+def single_fused_plan(TW: int, m: int, k: int, r: int,
+                      bits_rows: tuple) -> tuple:
+    """(TL, temp_cap) for the single-phase fused kernel.
+
+    For each candidate TL (largest first), the Paar temporaries either fit
+    outright (temp_cap = None) or are re-factored under the cap the VMEM
+    headroom allows — accepted when the capped network costs at most
+    _CAP_COST_RATIO of the full factoring (GF(2^16) RS(10,4): cap 400
+    costs +9% XORs but lifts TL 256 -> 512). Raises ValueError when no
+    tile fits.
+    """
+    from noise_ec_tpu.ops.pallas_gf2mm import (
+        TEMP_ALIVE_FRACTION,
+        xor_temp_bytes_per_lane,
+    )
+    from noise_ec_tpu.ops.xor_factor import factored_cost, paar_factor
+
+    W8 = TW // (8 * m)
+    blocks_per_lane = 4 * 8 * m * (2 * k + 2 * r + k + r)
+    temps_full = xor_temp_bytes_per_lane(bits_rows, k * m)
+    bytes_per_temp = 8 * 4 * TEMP_ALIVE_FRACTION
+    full_cost = None
+    for TL in (512, 256, 128):
+        if W8 % TL:
+            continue
+        headroom = _FUSED_VMEM_BUDGET // TL - blocks_per_lane
+        if headroom < 0:
+            continue
+        if temps_full <= headroom:
+            return (TL, None)
+        cap = int(headroom // bytes_per_temp)
+        if cap < 1:
+            continue
+        if full_cost is None:
+            ops, rows = paar_factor(bits_rows, k * m)
+            full_cost = factored_cost(ops, rows)
+        ops_c, rows_c = paar_factor(bits_rows, k * m, max_temps=cap)
+        if factored_cost(ops_c, rows_c) <= _CAP_COST_RATIO * full_cost:
+            return (TL, cap)
+    raise ValueError(
         f"no fused tile for TW={TW}, m={m}, k={k}, r={r} "
         f"(need TW % {1024 * m} == 0 and a tile within VMEM)"
     )
 
 
-def _fused_kernel(m, TL, rounds, bits_rows, in_ref, out_ref, pk_ref, po_ref):
+def _fused_kernel(m, TL, rounds, bits_rows, temp_cap, in_ref, out_ref,
+                  pk_ref, po_ref):
     k = in_ref.shape[0]
     # 1. pack into VMEM scratch — the standalone lane-pack kernel body,
     # pointed at the scratch ref instead of an HBM-backed output block.
     _pack_lanes_kernel(m, TL, rounds, in_ref, pk_ref)
     # 2. geometry-baked sparse GF(2) matmul on (8, TL) plane tiles, with
-    # Paar common-subexpression factoring (~2-3x fewer XORs).
+    # Paar common-subexpression factoring (~2-3x fewer XORs), optionally
+    # temp-capped to fit a larger lane tile (single_fused_plan).
     outs = eval_bits_rows(
         bits_rows, k * m,
         lambda c: pk_ref[c // m, c % m, :, :],
         lambda: jnp.zeros((8, TL), dtype=jnp.uint32),
+        max_temps=temp_cap if temp_cap is not None else 100_000,
     )
     for row, val in enumerate(outs):
         po_ref[row // m, row % m, :, :] = val
@@ -92,10 +151,10 @@ def _fused_kernel(m, TL, rounds, bits_rows, in_ref, out_ref, pk_ref, po_ref):
 @functools.lru_cache(maxsize=512)
 def _fused_call(bits_rows: tuple, k: int, r: int, TW: int, m: int,
                 interpret: bool):
-    TL = fused_lane_tl(TW, m, k, r, bits_rows)
+    TL, temp_cap = single_fused_plan(TW, m, k, r, bits_rows)
     rounds = _ROUNDS if m == 8 else _ROUNDS16
     return pl.pallas_call(
-        functools.partial(_fused_kernel, m, TL, rounds, bits_rows),
+        functools.partial(_fused_kernel, m, TL, rounds, bits_rows, temp_cap),
         grid=(TW // (8 * m * TL),),
         in_specs=[
             pl.BlockSpec((k, 8 * m * TL), lambda c: (0, c),
@@ -128,3 +187,365 @@ def fused_encode_words(
     """
     k, TW = words.shape
     return _fused_call(bits_rows, k, r, TW, m, interpret)(words)
+
+
+# ---------------------------------------------------------------------------
+# Split-phase fused encode: wide codes at full lane tiles.
+#
+# The single-launch fused kernel's VMEM working set scales with k (input
+# block + packed scratch) AND with the Paar network's temporaries, so wide
+# codes (RS(50,20): 400 input planes, ~3.8k temps) are forced down to
+# TL=128 — below the TL>=256 bracket where the pairwise delta-swap
+# transpose (2.8x fewer vector ops than the full-slab form) applies, and
+# the kernel runs ~VPU-bound at half the flagship rate.
+#
+# The split formulation processes the input in P contiguous K-SLICES:
+# phase p packs only its slice into a slice-sized scratch and evaluates
+# only the sub-network over that slice's plane columns, XOR-accumulating
+# into the parity-plane scratch; the last phase applies the inverse
+# transpose and writes parity words. A Pallas-pipelined (lanes x phases)
+# grid version re-fetched the revisited input block from HBM every phase
+# step (measured: throughput ~ 1/P) and was removed; the kernel below
+# keeps the input in HBM (memory_space=ANY) and hand-rolls the slice DMA
+# with double buffering, so input bytes move exactly once.
+#
+# Reference hot loop: /root/reference/main.go:262 (contract accepts any
+# k <= n <= 256, so wide geometries are first-class).
+
+
+def split_bits_rows_ksl(bits_rows: tuple, k: int, m: int, ksl: int) -> tuple:
+    """Partition the (r*m)-row network into ceil(k/ksl) sub-networks by
+    contiguous ksl-row input slices; sub-network p's column ids are
+    re-indexed to its local [0, ksl*m) plane range (a padded final slice
+    simply has columns no term references)."""
+    P = -(-k // ksl)
+    out = []
+    for p in range(P):
+        lo, hi = p * ksl * m, min((p + 1) * ksl * m, k * m)
+        out.append(
+            tuple(
+                tuple(c - lo for c in row if lo <= c < hi) for row in bits_rows
+            )
+        )
+    return tuple(out)
+
+
+def _pack_rows_kernel(m, TL, rounds, in_ref, out_ref, row_lo, rows):
+    """_pack_lanes_kernel on a static row slice of the input block."""
+    for sigma in range(8):
+        if _use_pairwise(TL):
+            ws = transpose_windows(
+                [
+                    in_ref[row_lo : row_lo + rows,
+                           (sigma * m + i) * TL : (sigma * m + i + 1) * TL]
+                    for i in range(m)
+                ],
+                rounds,
+            )
+        else:
+            V = lane_delta_swap(
+                in_ref[row_lo : row_lo + rows,
+                       sigma * m * TL : (sigma + 1) * m * TL],
+                TL, rounds,
+            )
+            ws = [V[:, i * TL : (i + 1) * TL] for i in range(m)]
+        for i in range(m):
+            out_ref[:rows, i, sigma, :] = ws[i]
+
+
+# ---------------------------------------------------------------------------
+# Manual-DMA split kernel: the production wide-code formulation.
+#
+# The Pallas-pipelined split kernel above re-fetches its (revisited) input
+# block from HBM on EVERY phase step — measured: RS(10,4) P=2 drops from
+# 421 to 299 GB/s and P=5 to 193, i.e. throughput ~ 1/P, the signature of
+# P-fold input traffic. This variant keeps the input in HBM
+# (memory_space=ANY) and hand-rolls the slice movement: one grid step per
+# lane tile runs ALL phases, DMA-ing each phase's ceil(k/P)-row slice into
+# a double-buffered VMEM scratch (phase p+1's copy overlaps phase p's
+# pack + XOR network). Input bytes move exactly once; VMEM holds only two
+# slices, one slice's packed planes, the parity planes, and one phase's
+# Paar temporaries — which is what buys TL >= 256 (pairwise transpose)
+# for codes whose single-phase working set forces TL=128.
+
+
+def _dma_split_kernel(m, TL, rounds, nets, ksl,
+                      in_ref, out_ref, buf_ref, pk_ref, po_ref, sems):
+    # The input array is padded to P*ksl rows with ksl a multiple of 8:
+    # Mosaic requires HBM row slices aligned to the (8, 128) tiling, and
+    # full slices keep every DMA identical. Padded rows are zero and no
+    # sub-network references their plane columns.
+    P = len(nets)
+    L = 8 * m * TL
+    c = pl.program_id(0)
+
+    def copy(ph, slot):
+        return pltpu.make_async_copy(
+            in_ref.at[pl.ds(ph * ksl, ksl), pl.ds(c * L, L)],
+            buf_ref.at[slot],
+            sems.at[slot],
+        )
+
+    copy(0, 0).start()
+    for ph, net in enumerate(nets):
+        slot = ph % 2
+        copy(ph, slot).wait()
+        if ph + 1 < P:
+            copy(ph + 1, 1 - slot).start()
+        _pack_rows_kernel(m, TL, rounds, buf_ref.at[slot], pk_ref, 0, ksl)
+        outs = eval_bits_rows(
+            net, ksl * m,
+            lambda col: pk_ref[col // m, col % m, :, :],
+            lambda: jnp.zeros((8, TL), dtype=jnp.uint32),
+        )
+        for row, val in enumerate(outs):
+            if ph == 0:
+                po_ref[row // m, row % m, :, :] = val
+            else:
+                po_ref[row // m, row % m, :, :] ^= val
+    _unpack_lanes_kernel(m, TL, rounds, po_ref, out_ref)
+
+
+@functools.lru_cache(maxsize=512)
+def _dma_split_call(nets: tuple, r: int, TW: int, m: int, ksl: int,
+                    TL: int, interpret: bool):
+    P = len(nets)
+    rounds = _ROUNDS if m == 8 else _ROUNDS16
+    return pl.pallas_call(
+        functools.partial(_dma_split_kernel, m, TL, rounds, nets, ksl),
+        grid=(TW // (8 * m * TL),),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],  # stays in HBM
+        out_specs=pl.BlockSpec((r, 8 * m * TL), lambda c: (0, c),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((r, TW), jnp.uint32),
+        scratch_shapes=[
+            pltpu.VMEM((2, ksl, 8 * m * TL), jnp.uint32),  # slice buffers
+            pltpu.VMEM((ksl, m, 8, TL), jnp.uint32),
+            pltpu.VMEM((r, m, 8, TL), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Verified planning: candidates ordered by estimated cost, compile-probed.
+#
+# The static VMEM models above are PRE-FILTERS, not guarantees: Mosaic's
+# stack allocator overlaps XOR-network temporaries by a geometry-dependent
+# fraction (measured 0.4 for RS(10,4)'s 193 temps, ~0.9 for capped
+# GF(2^16) networks), so a plan that fits the model can still OOM the 16M
+# scoped-vmem limit at compile time — and the model must stay conservative
+# enough that it rejects plans a different geometry would have run fine.
+# Rather than tightening the model until every geometry loses headroom,
+# the planner AOT-compiles one lane tile of each candidate (cheap, cached
+# per geometry — VMEM usage is TW-independent) and picks the first that
+# actually compiles.
+
+
+def _pack_w(TL: int) -> int:
+    # VPU bytes per packed word: pairwise delta-swap (7.5 ops x 4 B) at
+    # TL >= 256, full-slab rolls (~21 ops x 4 B) at TL = 128.
+    return 30 if TL >= 256 else 84
+
+
+_PROBE_BUDGET = 15_750_000  # loose pre-filter; the probe is the real gate
+# Calibrations shared by the candidate scan (single source of truth):
+# - split-kernel temporaries don't overlap across traced phase bodies the
+#   way the single-phase calibration assumes (observed: RS(50,20) P=5
+#   hit 16.25M scoped vs 12.76M accounted) -> scale the shared estimate.
+# - scan-time estimates for sub-network factoring yield and temp count
+#   (conservative fits to measured matrices: RS(50,20) 0.32/0.12,
+#   GF(2^16) RS(10,4) 0.34/0.13).
+_SPLIT_TEMP_SCALE = 2.5
+_FACTOR_RATIO = 0.35
+_TEMP_RATIO = 0.15
+
+
+def _temp_bytes_per_op() -> float:
+    from noise_ec_tpu.ops.pallas_gf2mm import TEMP_ALIVE_FRACTION
+
+    return 8 * 4 * TEMP_ALIVE_FRACTION
+
+
+def fused_plan_candidates(TW: int, m: int, k: int, r: int,
+                          bits_rows: tuple) -> list:
+    """Ordered candidate plans: ("single", TL, cap) and ("dma", TL, ksl).
+
+    Scored by estimated VPU bytes per input byte (XOR network + transpose
+    work, including split accumulates and row padding); ascending score =
+    descending predicted throughput.
+    """
+    from noise_ec_tpu.ops.pallas_gf2mm import xor_temp_bytes_per_lane
+    from noise_ec_tpu.ops.xor_factor import (
+        factored_cost,
+        paar_factor,
+        xor_cost,
+    )
+
+    W8 = TW // (8 * m)
+    out = []
+    blocks_single = 4 * 8 * m * (2 * k + 2 * r + k + r)
+    temps_full = xor_temp_bytes_per_lane(bits_rows, k * m)
+    ops_f, rows_f = paar_factor(bits_rows, k * m)
+    full_cost = factored_cost(ops_f, rows_f)
+    # Mild preference for wider lane tiles beyond what the op counts
+    # capture (fewer grid steps, better vectorization; RS(10,4) measured
+    # +16% at 512 vs 256).
+    tl_factor = {512: 1.0, 256: 1.08, 128: 1.15}
+
+    def single_score(TL, cost):
+        return tl_factor[TL] * (32 * cost + _pack_w(TL) * 8 * m * (k + r))
+
+    for TL in (512, 256, 128):
+        if W8 % TL:
+            continue
+        headroom = _PROBE_BUDGET // TL - blocks_single
+        if headroom >= temps_full:
+            out.append((single_score(TL, full_cost), ("single", TL, 0)))
+        # Capped variants whenever the STRICT model would demand a cap at
+        # this TL — emitted alongside the uncapped candidate (the probe
+        # decides which actually compiles), at the model cap and a
+        # tighter 0.6x fallback for geometries whose temporaries Mosaic
+        # overlaps poorly.
+        strict_headroom = _FUSED_VMEM_BUDGET // TL - blocks_single
+        if strict_headroom > 0 and temps_full > strict_headroom:
+            cap_model = int(strict_headroom // _temp_bytes_per_op())
+            for cap in (cap_model, max(1, int(cap_model * 0.6))):
+                if cap < 1 or cap * _temp_bytes_per_op() >= temps_full:
+                    continue
+                ops_c, rows_c = paar_factor(bits_rows, k * m, max_temps=cap)
+                cost_c = factored_cost(ops_c, rows_c)
+                if cost_c <= _CAP_COST_RATIO * full_cost:
+                    out.append((single_score(TL, cost_c), ("single", TL, cap)))
+    # DMA-split candidates (ksl multiple of 8 — Mosaic HBM row slices must
+    # align to the (8, 128) tiling; the runner zero-pads the input rows).
+    max_ksl = -(-k // 8) * 8
+    for TL in (512, 256):
+        if W8 % TL:
+            continue
+        for ksl in range(8, max_ksl + 1, 8):
+            P = -(-k // ksl)
+            if P < 2:
+                continue
+            nets = split_bits_rows_ksl(bits_rows, k, m, ksl)
+            raw_max = max(xor_cost(net) for net in nets)
+            est_temps = raw_max * _TEMP_RATIO * _temp_bytes_per_op()
+            per_lane_est = (
+                4 * 8 * m * (3 * ksl + 3 * r)
+                + est_temps * _SPLIT_TEMP_SCALE
+            )
+            if per_lane_est * TL > _PROBE_BUDGET:
+                continue
+            sumf_est = sum(xor_cost(net) for net in nets) * _FACTOR_RATIO
+            # 1.5x: measured overhead of the manual-DMA formulation beyond
+            # the op counts (per-phase parity-plane accumulate traffic,
+            # first-phase DMA bubbles, slice-pad pack) — RS(50,20) measured
+            # 178.8 GB/s dma(TL=256) vs 243.6 single(TL=128) on v5e, so
+            # the score must not prefer dma on op counts alone.
+            score = 1.5 * tl_factor[TL] * (
+                32 * (sumf_est + (P - 1) * r * m)
+                + _pack_w(TL) * 8 * m * (P * ksl + r)
+            )
+            out.append((score, ("dma", TL, ksl)))
+    out.sort(key=lambda t: t[0])
+    # Bound probe work: a handful of best candidates is always enough.
+    return [cand for _, cand in out[:8]]
+
+
+def _build_planned_call(bits_rows: tuple, k: int, r: int, TW: int, m: int,
+                        cand: tuple, interpret: bool):
+    """(callable, padded_k) for a candidate plan at the given TW."""
+    kind, TL = cand[0], cand[1]
+    if kind == "single":
+        cap = cand[2] or None
+        rounds = _ROUNDS if m == 8 else _ROUNDS16
+        call = pl.pallas_call(
+            functools.partial(_fused_kernel, m, TL, rounds, bits_rows, cap),
+            grid=(TW // (8 * m * TL),),
+            in_specs=[
+                pl.BlockSpec((k, 8 * m * TL), lambda c: (0, c),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((r, 8 * m * TL), lambda c: (0, c),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((r, TW), jnp.uint32),
+            scratch_shapes=[
+                pltpu.VMEM((k, m, 8, TL), jnp.uint32),
+                pltpu.VMEM((r, m, 8, TL), jnp.uint32),
+            ],
+            interpret=interpret,
+        )
+        return call, k
+    ksl = cand[2]
+    nets = split_bits_rows_ksl(bits_rows, k, m, ksl)
+    return _dma_split_call(nets, r, TW, m, ksl, TL, interpret), len(nets) * ksl
+
+
+@functools.lru_cache(maxsize=1024)
+def _probe_compiles(bits_rows: tuple, k: int, r: int, m: int,
+                    cand: tuple) -> bool:
+    """AOT-compile TWO lane tiles of the candidate; True iff it compiles.
+
+    Past two tiles VMEM pressure is independent of the grid length (the
+    pipeline double-buffers at grid >= 2 — a ONE-tile probe skips the
+    second buffer and falsely passed plans that OOM on real grids), so a
+    two-tile probe validates any TW with the same TL.
+    """
+    TW = 2 * 8 * m * cand[1]
+    try:
+        call, k_pad = _build_planned_call(bits_rows, k, r, TW, m, cand, False)
+        shape = jax.ShapeDtypeStruct((k_pad, TW), jnp.uint32)
+        jax.jit(call).lower(shape).compile()
+        return True
+    except Exception:  # noqa: BLE001 — any compile failure disqualifies
+        return False
+
+
+@functools.lru_cache(maxsize=512)
+def verified_fused_plan(bits_rows: tuple, k: int, r: int, TW: int, m: int,
+                        interpret: bool):
+    """Best candidate that actually compiles, or None.
+
+    Interpret mode (CPU tests) has no scoped-vmem limit: the first
+    candidate wins without probing.
+    """
+    cands = fused_plan_candidates(TW, m, k, r, bits_rows)
+    if interpret:
+        return cands[0] if cands else None
+    for cand in cands:
+        if _probe_compiles(bits_rows, k, r, m, cand):
+            return cand
+    return None
+
+
+class NoFusedPlanError(ValueError):
+    """No fused-kernel candidate compiles for this geometry — the caller
+    should fall back to the three-kernel pipeline. A distinct type so the
+    dispatch fallback cannot swallow a genuine ValueError raised while
+    building or running a chosen kernel (that is a bug and must surface)."""
+
+
+def fused_encode_words_planned(
+    bits_rows: tuple,
+    words: jnp.ndarray,  # (k, TW) uint32
+    r: int,
+    m: int = 8,
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused encode through the verified planner (single or DMA-split).
+
+    Raises :class:`NoFusedPlanError` when no candidate compiles — callers
+    fall back to the three-kernel pipeline.
+    """
+    k, TW = words.shape
+    cand = verified_fused_plan(bits_rows, k, r, TW, m, interpret)
+    if cand is None:
+        raise NoFusedPlanError(
+            f"no fused plan compiles for k={k}, r={r}, m={m}"
+        )
+    call, k_pad = _build_planned_call(bits_rows, k, r, TW, m, cand, interpret)
+    if k_pad != k:
+        words = jnp.pad(words, ((0, k_pad - k), (0, 0)))
+    return call(words)

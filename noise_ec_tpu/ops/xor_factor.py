@@ -135,15 +135,17 @@ def eval_factored(ops, rows, get_input, make_zero):
     return outs
 
 
-def eval_bits_rows(bits_rows, C: int, get_plane, make_zero):
+def eval_bits_rows(bits_rows, C: int, get_plane, make_zero,
+                   max_temps: int = 100_000):
     """Factor ``bits_rows`` and evaluate it over input planes 0..C-1.
 
     The one entry point both baked kernels (the fused single-kernel encode
     and the standalone sparse matmul) trace through: hoists each used input
     plane once via ``get_plane``, then runs the factored network. Returns
-    the list of output-row values.
+    the list of output-row values. ``max_temps`` caps the temporary count
+    (VMEM stack pressure) at the price of more XORs — see paar_factor.
     """
-    ops, rows = paar_factor(bits_rows, C)
+    ops, rows = paar_factor(bits_rows, C, max_temps=max_temps)
     used = {c for terms in rows for c in terms if c < C}
     used |= {c for _, a, b in ops for c in (a, b) if c < C}
     vs = {c: get_plane(c) for c in sorted(used)}

@@ -59,13 +59,14 @@ def test_large_varints_roundtrip():
 def test_unknown_fields_skipped():
     """skipShard analogue (shard.pb.go:582-680): unknown varint,
     length-delimited, fixed32/64, and group fields are skipped."""
+    # Fields 6-8 are the streaming extension now; unknown starts at 9.
     base = Shard(shard_number=9).marshal()
     unknown = (
-        bytes([0x30, 0x7F])  # field 6, varint
-        + bytes([0x3A, 2, 0xAA, 0xBB])  # field 7, bytes
-        + bytes([0x45, 1, 2, 3, 4])  # field 8, fixed32
-        + bytes([0x49, 1, 2, 3, 4, 5, 6, 7, 8])  # field 9, fixed64
-        + bytes([0x53, 0x58, 0x05, 0x54])  # field 10 group{field 11 varint} end
+        bytes([0x48, 0x7F])  # field 9, varint
+        + bytes([0x52, 2, 0xAA, 0xBB])  # field 10, bytes
+        + bytes([0x5D, 1, 2, 3, 4])  # field 11, fixed32
+        + bytes([0x61, 1, 2, 3, 4, 5, 6, 7, 8])  # field 12, fixed64
+        + bytes([0x6B, 0x70, 0x05, 0x6C])  # field 13 group{field 14 varint} end
     )
     assert Shard.unmarshal(base + unknown) == Shard(shard_number=9)
     assert Shard.unmarshal(unknown + base) == Shard(shard_number=9)
