@@ -278,7 +278,11 @@ def main() -> None:
     try:
         from noise_ec_tpu.codec.fec import FEC, Share
 
-        fec = FEC(k, k + r, backend="device" if on_tpu else "numpy")
+        # numpy backend: BW correction is host-side by design (per-column
+        # algebra), and its big matvecs run on the native C++ shim; the
+        # device backend would only re-route the doomed consistency check
+        # through the tunnel (multi-ms RPC per 14 MiB transfer).
+        fec = FEC(k, k + r, backend="numpy")
         S1 = 1 << 20
         stripes = rng.integers(0, 256, size=(k, S1)).astype(np.uint8)
         shares = fec.encode_shares(stripes.tobytes())
@@ -373,15 +377,22 @@ def main() -> None:
                 on_message=lambda m, s: got.append(len(m)),
             ))
             send_plugin = node_a.plugins[0]
-            # warm (shim/kernels/pools), then one timed pass
-            send_plugin.stream_and_broadcast(node_a, big[: 8 << 20],
+            # Warm with a FULL-SIZE pass (shim/kernels/pools and the
+            # allocator's high-water mark), then best of two timed passes;
+            # payloads are distinct because identical bytes dedup by
+            # signature.
+            send_plugin.stream_and_broadcast(node_a, big[2:] + b"\x00\x00",
                                              chunk_bytes=4 << 20)
-            got.clear()
-            t0 = time.perf_counter()
-            send_plugin.stream_and_broadcast(node_a, big, chunk_bytes=4 << 20)
-            t_big = time.perf_counter() - t0
-            if got != [len(big)]:
-                raise RuntimeError(f"stream bench lost the object: {got}")
+            t_big = float("inf")
+            for trial in range(2):
+                payload = big if trial == 0 else big[1:] + b"\x00"
+                got.clear()
+                t0 = time.perf_counter()
+                send_plugin.stream_and_broadcast(node_a, payload,
+                                                 chunk_bytes=4 << 20)
+                t_big = min(t_big, time.perf_counter() - t0)
+                if got != [len(payload)]:
+                    raise RuntimeError(f"stream bench lost the object: {got}")
             suffix = "" if backend == "numpy" else "_device"
             stats[f"host_node_large_object{suffix}_mb_per_s"] = round(
                 len(big) / t_big / 1e6, 1

@@ -223,9 +223,7 @@ class FEC:
         disagreement."""
         G = self._golden.G
         basis = nums[: self.k]
-        if basis == list(range(self.k)) and np.array_equal(
-            G[: self.k], np.eye(self.k, dtype=G.dtype)
-        ):
+        if basis == list(range(self.k)) and self._systematic:
             # Systematic shortcut: the first k shares ARE the data rows
             # (G[:k] == I), so the inverse is the identity and the multiply
             # is a stack — the common in-order delivery case costs zero

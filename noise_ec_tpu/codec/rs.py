@@ -28,6 +28,7 @@ import numpy as np
 
 from noise_ec_tpu.gf.field import GF, GF256, GF65536
 from noise_ec_tpu.matrix.generators import generator_matrix
+from noise_ec_tpu.matrix.hostmath import host_matvec
 from noise_ec_tpu.matrix.linalg import reconstruction_matrix
 
 Buffer = Union[bytes, bytearray, memoryview, np.ndarray]
@@ -103,7 +104,7 @@ class ReedSolomon:
     def _mul(self, M: np.ndarray, D: np.ndarray) -> np.ndarray:
         if self._dev is not None:
             return self._dev.matmul_stripes(M, D)
-        return self.gf.matvec_stripes(M, D)
+        return host_matvec(self.gf, M, D)
 
     def _to_sym(self, buf: Buffer, name: str) -> np.ndarray:
         arr = np.frombuffer(buf, dtype=np.uint8) if not isinstance(buf, np.ndarray) else buf

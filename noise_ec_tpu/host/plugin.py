@@ -536,16 +536,27 @@ class ShardPlugin:
                     "count": count,
                     "B": B,
                     "length": length,
+                    "k": k,
+                    "n": n,
                     "created": now,
                     "failed": False,  # a whole-object verify has failed
                 }
                 streams[key] = st
-            if (st["count"], st["B"], st["length"]) != (count, B, length):
+            if (st["count"], st["B"], st["length"], st["k"], st["n"]) != (
+                count, B, length, k, n
+            ):
+                # Geometry is pinned too: a forged shard whose k *
+                # len(shard_data) happens to match B must not steer the
+                # repair/unrecoverability logic (or decode to a SHORTER
+                # chunk — a step-1 bytearray slice assignment from a
+                # shorter source silently RESIZES the buffer, corrupting
+                # every later chunk's offsets).
                 self.counters.add("rejected_shards", 1)
                 raise ValueError(
                     "stream shard disagrees with the object's pinned "
                     f"shape (count {count} vs {st['count']}, capacity "
-                    f"{B} vs {st['B']}, length {length} vs {st['length']})"
+                    f"{B} vs {st['B']}, length {length} vs {st['length']}, "
+                    f"geometry ({k},{n}) vs ({st['k']},{st['n']}))"
                 )
 
         share = Share(msg.shard_number, bytes(msg.shard_data))

@@ -202,13 +202,18 @@ def bw_decode_stripes(
     code's message is the coefficient vector); for the systematic kinds they
     are the data shards.
     """
+    from noise_ec_tpu.matrix.hostmath import host_matvec, host_scale_rows
+
     m, S = stripes.shape
     if m < k:
         raise ValueError(f"need >= {k} rows, got {m}")
     e = (m - k) // 2
     N = grs_normalizers(gf, kind, k, n)
     xs = np.asarray(nums, dtype=np.int64)
-    R = gf.mul(N[xs][:, None], stripes).astype(np.int64)  # (m, S) f(x_i) + err
+    # (m, S) f(x_i) + err — per-row constant scale on the native kernels.
+    # Kept in the field dtype: int64 promotion here used to cost two full
+    # (m, S) conversions plus 8x the compare traffic in disagreements.
+    R = host_scale_rows(gf, N[xs], stripes).astype(gf.dtype, copy=False)
 
     Vm = np.ones((m, k), dtype=np.int64)
     for j in range(1, k):
@@ -223,13 +228,14 @@ def bw_decode_stripes(
         for j in range(1, k):
             Vb[:, j] = gf.mul(Vb[:, j - 1], xs[basis])
         src = R[basis] if cols is None else R[np.ix_(basis, cols)]
-        # matvec_stripes (not matmul) keeps the (rows, k, S) intermediate
-        # row-blocked — S can be millions of symbols on the FEC fallback.
-        return gf.matvec_stripes(gf_inv(gf, Vb), src)  # (k, len(cols) or S)
+        # host_matvec: native split-nibble/GFNI kernels when the shim is
+        # available, row-blocked NumPy otherwise — S can be millions of
+        # symbols on the FEC fallback.
+        return host_matvec(gf, gf_inv(gf, Vb), src)  # (k, len(cols) or S)
 
     def disagreements(cand: np.ndarray, cols=None) -> np.ndarray:
         """Per-column count of received rows the candidate disagrees with."""
-        predicted = gf.matvec_stripes(Vm, cand).astype(np.int64)
+        predicted = host_matvec(gf, Vm, cand).astype(gf.dtype, copy=False)
         ref = R if cols is None else R[:, cols]
         return np.sum(predicted != ref, axis=0)
 
@@ -274,5 +280,5 @@ def bw_decode_stripes(
     pts = np.arange(k, dtype=np.int64)
     for j in range(1, k):
         Vd[:, j] = gf.mul(Vd[:, j - 1], pts)
-    vals = gf.matvec_stripes(Vd, coeffs)  # (k, S) f(j)
-    return gf.div(vals, N[:k][:, None])
+    vals = host_matvec(gf, Vd, coeffs)  # (k, S) f(j)
+    return host_scale_rows(gf, gf.inv(N[:k]), vals).astype(gf.dtype)

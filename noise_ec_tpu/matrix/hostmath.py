@@ -1,0 +1,48 @@
+"""Host-side GF products with the native shim fast path.
+
+The pure-NumPy ``GF.matvec_stripes`` (gf/field.py) is the framework's
+ground truth and stays dependency-free; every *production* host path that
+multiplies a small GF matrix by multi-megabyte stripes — the numpy-backend
+codec, the Berlekamp-Welch interpolation/re-encode products — should go
+through these wrappers instead, which dispatch to the native C++ codec's
+split-nibble/GFNI kernels (noise_ec_tpu/shim, klauspost-class throughput)
+when the shared library is available and fall back to NumPy otherwise.
+GF(2^16) always takes the NumPy path (the shim is GF(2^8) only).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from noise_ec_tpu.gf.field import GF
+
+
+def host_matvec(gf: GF, M: np.ndarray, D: np.ndarray) -> np.ndarray:
+    """M (r, k) @ D (k, S) on the fastest host backend available."""
+    if gf.degree == 8:
+        try:
+            from noise_ec_tpu.shim import gf_matmul_stripes
+
+            out = gf_matmul_stripes(np.asarray(M), np.asarray(D))
+            if out is not None:
+                return out
+        except Exception:  # noqa: BLE001 — any shim failure -> NumPy
+            pass
+    return gf.matvec_stripes(M, D)
+
+
+def host_scale_rows(gf: GF, consts: np.ndarray, D: np.ndarray) -> np.ndarray:
+    """Row-wise constant scale: row i of the result = consts[i] * D[i]."""
+    if gf.degree == 8:
+        try:
+            from noise_ec_tpu.shim import gf_scale_rows
+
+            out = gf_scale_rows(np.asarray(consts), np.asarray(D))
+            if out is not None:
+                return out
+        except Exception:  # noqa: BLE001 — any shim failure -> NumPy
+            pass
+    consts = np.asarray(consts)
+    return np.stack(
+        [gf.mul_const(int(consts[i]), D[i]) for i in range(D.shape[0])]
+    )

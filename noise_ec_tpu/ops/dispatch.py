@@ -284,9 +284,15 @@ class DeviceCodec:
         TW = words.shape[2]
         TWp = pad_words(TW) if self.gf.degree == 8 else pad_words16(TW)
         if TWp != TW:
-            out = jax.vmap(fn)(jnp.pad(words, ((0, 0), (0, 0), (0, TWp - TW))))
-            return out[:, :, :TW]
-        return jax.vmap(fn)(words)
+            words = jnp.pad(words, ((0, 0), (0, 0), (0, TWp - TW)))
+        if words.shape[0] == 1:
+            # Single object: skip the vmap wrapper (its extra grid
+            # dimension measurably slows wide codes — RS(50,20) 243 vs
+            # 201 GB/s on v5e).
+            out = fn(words[0])[None]
+        else:
+            out = jax.vmap(fn)(words)
+        return out[:, :, :TW] if TWp != TW else out
 
     def matmul_planes(self, M: np.ndarray, planes: jnp.ndarray) -> jnp.ndarray:
         """Device-level entry on packed (C, W) planes (HBM-resident path).

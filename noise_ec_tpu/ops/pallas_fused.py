@@ -103,16 +103,26 @@ def single_fused_plan(TW: int, m: int, k: int, r: int,
     blocks_per_lane = 4 * 8 * m * (2 * k + 2 * r + k + r)
     temps_full = xor_temp_bytes_per_lane(bits_rows, k * m)
     bytes_per_temp = 8 * 4 * TEMP_ALIVE_FRACTION
+    # Pass 1 — any UNCAPPED tile, largest first: an uncapped smaller tile
+    # beats a capped larger one here, because this planner's callers
+    # (fused_encode_words, via parallel/batch.py) compile WITHOUT the
+    # probe, and capped plans are exactly the ones whose real Mosaic
+    # stack usage the static model cannot predict. The probing planner
+    # (fused_plan_candidates) makes its own capped-vs-uncapped ordering.
+    for TL in (512, 256, 128):
+        if W8 % TL:
+            continue
+        headroom = _FUSED_VMEM_BUDGET // TL - blocks_per_lane
+        if headroom >= temps_full:
+            return (TL, None)
+    # Pass 2 — capped fallback (last resort; only reached when nothing
+    # fits uncapped at any tile).
     full_cost = None
     for TL in (512, 256, 128):
         if W8 % TL:
             continue
         headroom = _FUSED_VMEM_BUDGET // TL - blocks_per_lane
-        if headroom < 0:
-            continue
-        if temps_full <= headroom:
-            return (TL, None)
-        cap = int(headroom // bytes_per_temp)
+        cap = int(headroom // bytes_per_temp) if headroom > 0 else 0
         if cap < 1:
             continue
         if full_cost is None:

@@ -6,6 +6,18 @@ library the Python binding loads. :mod:`noise_ec_tpu.shim.binding` is the
 ctypes loader.
 """
 
-from noise_ec_tpu.shim.binding import CppReedSolomon, build_shim, shim_available
+from noise_ec_tpu.shim.binding import (
+    CppReedSolomon,
+    build_shim,
+    gf_matmul_stripes,
+    gf_scale_rows,
+    shim_available,
+)
 
-__all__ = ["CppReedSolomon", "build_shim", "shim_available"]
+__all__ = [
+    "CppReedSolomon",
+    "build_shim",
+    "gf_matmul_stripes",
+    "gf_scale_rows",
+    "shim_available",
+]

@@ -71,12 +71,76 @@ def _load() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int,
         ]
         lib.rs_shim_version.restype = ctypes.c_char_p
+        lib.rs_matmul.restype = ctypes.c_int
+        lib.rs_matmul.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_size_t,
+        ]
+        lib.rs_scale_rows.restype = ctypes.c_int
+        lib.rs_scale_rows.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int, ctypes.c_size_t,
+        ]
         _lib = lib
     return _lib
 
 
 def _as_u8_ptr(arr: np.ndarray):
     return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+_fast_ok: Optional[bool] = None
+
+
+def _fast_lib() -> Optional[ctypes.CDLL]:
+    """The loaded shim, or None when it cannot be built/loaded (callers
+    fall back to NumPy). Resolution is cached."""
+    global _fast_ok
+    if _fast_ok is None:
+        try:
+            _load()
+            _fast_ok = True
+        except Exception:  # noqa: BLE001 — any load failure -> NumPy path
+            _fast_ok = False
+    return _lib if _fast_ok else None
+
+
+def gf_matmul_stripes(M: np.ndarray, D: np.ndarray) -> Optional[np.ndarray]:
+    """M (r, k) @ D (k, S) over GF(2^8) on the native split-nibble/GFNI
+    kernels; None when the shim is unavailable (caller falls back).
+
+    Only uint8 operands (GF(2^8)); the matrix entries must already be
+    field elements of the shim's polynomial (0x11D — the same one as
+    gf/field.py, asserted by the cross tests in tests/test_shim.py).
+    """
+    lib = _fast_lib()
+    if lib is None:
+        return None
+    Mb = np.ascontiguousarray(M, dtype=np.uint8)
+    Db = np.ascontiguousarray(D, dtype=np.uint8)
+    r, k = Mb.shape
+    out = np.empty((r, Db.shape[1]), dtype=np.uint8)
+    rc = lib.rs_matmul(_as_u8_ptr(Mb), r, k, _as_u8_ptr(Db), _as_u8_ptr(out),
+                       Db.shape[1])
+    if rc != 0:
+        raise RuntimeError(f"rs_matmul failed: {rc}")
+    return out
+
+
+def gf_scale_rows(consts: np.ndarray, D: np.ndarray) -> Optional[np.ndarray]:
+    """Row-wise constant scale over GF(2^8): returns a new (rows, S) array
+    with row i = consts[i] * D[i]; None when the shim is unavailable."""
+    lib = _fast_lib()
+    if lib is None:
+        return None
+    buf = np.array(D, dtype=np.uint8, copy=True, order="C")
+    cb = np.ascontiguousarray(consts, dtype=np.uint8)
+    rc = lib.rs_scale_rows(_as_u8_ptr(cb), _as_u8_ptr(buf), buf.shape[0],
+                           buf.shape[1])
+    if rc != 0:
+        raise RuntimeError(f"rs_scale_rows failed: {rc}")
+    return buf
 
 
 class CppReedSolomon:

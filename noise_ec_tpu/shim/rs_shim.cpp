@@ -262,6 +262,42 @@ extern "C" {
 
 const char* rs_shim_version() { return "noise-ec-tpu-shim/1 gf256 poly=0x11D"; }
 
+// Generic GF(2^8) product out (r x len) = M (r x k) @ in (k x len), all
+// buffers contiguous row-major. The framework's host-side decode paths
+// (submatrix-inverse multiplies, Berlekamp-Welch interpolation and
+// re-encode) are arbitrary-matrix products on multi-megabyte stripes; this
+// runs them on the same split-nibble/GFNI kernels as rs_encode instead of
+// NumPy table gathers. Returns 0 on success.
+int rs_matmul(const uint8_t* M, int r, int k, const uint8_t* in, uint8_t* out,
+              size_t len) {
+  if (!M || !in || !out || r < 1 || k < 1) return -1;
+  std::memset(out, 0, static_cast<size_t>(r) * len);
+  for (int i = 0; i < r; ++i)
+    for (int j = 0; j < k; ++j)
+      mul_add_row(out + static_cast<size_t>(i) * len,
+                  in + static_cast<size_t>(j) * len, M[i * k + j], len);
+  return 0;
+}
+
+// In-place per-row scale: buf row i *= consts[i] (rows x len, contiguous).
+int rs_scale_rows(const uint8_t* consts, uint8_t* buf, int rows, size_t len) {
+  if (!consts || !buf || rows < 1) return -1;
+  std::vector<uint8_t> tmp(len);
+  for (int i = 0; i < rows; ++i) {
+    uint8_t c = consts[i];
+    if (c == 1) continue;
+    uint8_t* row = buf + static_cast<size_t>(i) * len;
+    if (c == 0) {
+      std::memset(row, 0, len);
+      continue;
+    }
+    std::memcpy(tmp.data(), row, len);
+    std::memset(row, 0, len);
+    mul_add_row(row, tmp.data(), c, len);
+  }
+  return 0;
+}
+
 // matrix_kind: 0 = cauchy (default), 1 = systematic vandermonde.
 // Returns nullptr on invalid geometry.
 void* rs_encoder_new(int data_shards, int parity_shards, int matrix_kind) {

@@ -48,6 +48,15 @@ int rs_verify(void* enc, const uint8_t* shards, size_t shard_len);
 int rs_reconstruct(void* enc, uint8_t* shards, size_t shard_len,
                    const uint8_t* present, int data_only);
 
+/* Generic GF(2^8) product: out (r x len) = M (r x k) @ in (k x len),
+ * all contiguous row-major. Returns 0 on success. */
+int rs_matmul(const uint8_t* M, int r, int k, const uint8_t* in,
+              uint8_t* out, size_t len);
+
+/* In-place per-row scale: buf row i *= consts[i] ((rows x len)
+ * contiguous). Returns 0 on success. */
+int rs_scale_rows(const uint8_t* consts, uint8_t* buf, int rows, size_t len);
+
 #ifdef __cplusplus
 } /* extern "C" */
 #endif

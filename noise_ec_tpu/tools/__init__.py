@@ -1,0 +1,1 @@
+"""Operational tools: hardware checks and diagnostics."""
