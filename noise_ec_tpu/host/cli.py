@@ -6,9 +6,13 @@ Run one node per process:
     python -m noise_ec_tpu.host.cli -port 3002 -peers tcp://localhost:3001
 
 Each stdin line is erasure-sharded, signed, and broadcast to all peers;
-peers reassemble, verify, and log the completed message. Flags mirror the
-reference (`-port -host -protocol -peers`, main.go:121-124); the codec
-backend flag is new (device = TPU/JAX kernels, numpy = host-only).
+peers reassemble, verify, and log the completed message. A line of the
+form ``/send PATH`` streams the FILE at PATH instead (chunked
+erasure-coded broadcast — ``ShardPlugin.stream_and_broadcast``), which is
+how objects beyond one codeword travel; receivers log the object and,
+with ``-recv-dir DIR``, save it under a content-hash name. Flags mirror
+the reference (`-port -host -protocol -peers`, main.go:121-124); the
+codec backend, trace, recv-dir, and chunk-size flags are new.
 """
 
 from __future__ import annotations
@@ -52,6 +56,19 @@ def build_parser() -> argparse.ArgumentParser:
         help="capture a JAX/XLA profiler trace of the session into LOGDIR "
         "(view with tensorboard's profile plugin)",
     )
+    p.add_argument(
+        "-recv-dir",
+        default="",
+        metavar="DIR",
+        help="save received messages/objects into DIR (file name = 16-hex "
+        "BLAKE2b content hash of the bytes; logged on save)",
+    )
+    p.add_argument(
+        "-chunk-bytes",
+        type=int,
+        default=4 << 20,
+        help="chunk payload size for /send file streaming (bytes)",
+    )
     return p
 
 
@@ -68,7 +85,32 @@ def main(argv: list[str] | None = None) -> int:
     )
 
     def on_message(message: bytes, sender: PeerID) -> None:
-        log.info("message from %s: %s", sender.address, message.hex())
+        # The reference logs the full hex dump (main.go:92); for streamed
+        # objects that would be megabytes of log — log a prefix + length,
+        # and save the body when -recv-dir is set.
+        if len(message) <= 256:
+            log.info("message from %s: %s", sender.address, message.hex())
+        else:
+            log.info(
+                "message from %s: %s… (%d bytes)",
+                sender.address, message[:32].hex(), len(message),
+            )
+        if args.recv_dir:
+            import hashlib
+            import os
+
+            # Never raise out of on_message: the plugin has already marked
+            # the object completed, so an exception here would lose the
+            # bytes silently (the transport only records it).
+            try:
+                os.makedirs(args.recv_dir, exist_ok=True)
+                name = hashlib.blake2b(message, digest_size=8).hexdigest()
+                path = os.path.join(args.recv_dir, name)
+                with open(path, "wb") as f:
+                    f.write(message)
+                log.info("saved %d bytes to %s", len(message), path)
+            except OSError as exc:
+                log.error("could not save received object: %s", exc)
 
     plugin = ShardPlugin(backend=args.backend, on_message=on_message)
     plugin.prewarm()  # compile the default geometry before traffic arrives
@@ -83,9 +125,28 @@ def main(argv: list[str] | None = None) -> int:
     try:
         with device_trace(args.trace):
             for line in sys.stdin:  # blocking REPL, main.go:175-198
-                input_bytes = line.rstrip("\n").encode()
-                if not input_bytes:
+                stripped = line.rstrip("\n")
+                if not stripped:
                     continue  # skip blank lines, main.go:179-181
+                if stripped.startswith("/send "):
+                    path = stripped[len("/send "):].strip()
+                    try:
+                        with open(path, "rb") as f:
+                            data = f.read()
+                    except OSError as exc:
+                        log.error("cannot read %s: %s", path, exc)
+                        continue
+                    log.info("streaming %s (%d bytes)", path, len(data))
+                    try:
+                        chunks = plugin.stream_and_broadcast(
+                            net, data, chunk_bytes=args.chunk_bytes
+                        )
+                    except ValueError as exc:
+                        log.error("stream failed: %s", exc)
+                        continue
+                    log.info("streamed %s as %d chunks", path, chunks)
+                    continue
+                input_bytes = stripped.encode()
                 log.info("broadcasting message: %s", input_bytes.hex())
                 plugin.shard_and_broadcast(net, input_bytes)
     except KeyboardInterrupt:

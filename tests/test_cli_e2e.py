@@ -36,7 +36,8 @@ def _free_ports(count: int) -> list[int]:
 class Node:
     """One CLI subprocess with a line-buffered stderr scraper."""
 
-    def __init__(self, port: int, peers: str = "", protocol: str = "tcp"):
+    def __init__(self, port: int, peers: str = "", protocol: str = "tcp",
+                 recv_dir: str = "", chunk_bytes: int = 0):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"  # keep subprocesses off the TPU tunnel
         env.pop("PYTHONPATH", None)
@@ -47,6 +48,10 @@ class Node:
         ]
         if peers:
             argv += ["-peers", peers]
+        if recv_dir:
+            argv += ["-recv-dir", recv_dir]
+        if chunk_bytes:
+            argv += ["-chunk-bytes", str(chunk_bytes)]
         self.proc = subprocess.Popen(
             argv,
             stdin=subprocess.PIPE,
@@ -151,6 +156,32 @@ def test_three_process_discovery_transitive(nodes):
                 raise
     got_b = b.wait_for(needle, 5.0)
     assert needle in got_b and needle in got_c
+
+
+def test_file_streaming_across_processes(nodes, tmp_path):
+    """`/send PATH` streams a multi-chunk file over real sockets; the
+    receiver reassembles all chunks, verifies the one object signature,
+    and saves the bytes under -recv-dir — the large-object story at the
+    product surface (the reference's node only ships stdin lines)."""
+    import hashlib
+
+    pa, pb = _free_ports(2)
+    recv_dir = tmp_path / "inbox"
+    b = nodes(pb, recv_dir=str(recv_dir))
+    b.wait_for("listening for peers", NODE_START_TIMEOUT)
+    # small chunks so several chunks cross the wire
+    a = nodes(pa, peers=f"tcp://127.0.0.1:{pb}", chunk_bytes=262144)
+    a.wait_for("listening for peers", NODE_START_TIMEOUT)
+
+    payload = os.urandom(1_500_000)  # ~1.5 MB -> six 256 KiB chunks
+    src = tmp_path / "payload.bin"
+    src.write_bytes(payload)
+    a.proc.stdin.write(f"/send {src}\n")
+    a.proc.stdin.flush()
+    a.wait_for("streamed", MESSAGE_TIMEOUT)
+    b.wait_for("saved 1500000 bytes", MESSAGE_TIMEOUT)
+    name = hashlib.blake2b(payload, digest_size=8).hexdigest()
+    assert (recv_dir / name).read_bytes() == payload
 
 
 def test_geometry_adjustment_logged_across_processes(nodes):
