@@ -106,8 +106,12 @@ def main(argv: list[str] | None = None) -> int:
                 os.makedirs(args.recv_dir, exist_ok=True)
                 name = hashlib.blake2b(message, digest_size=8).hexdigest()
                 path = os.path.join(args.recv_dir, name)
-                with open(path, "wb") as f:
+                # Atomic: the name claims to be the content hash, so a
+                # torn write must never leave a partial file under it.
+                tmp = path + ".part"
+                with open(tmp, "wb") as f:
                     f.write(message)
+                os.replace(tmp, path)
                 log.info("saved %d bytes to %s", len(message), path)
             except OSError as exc:
                 log.error("could not save received object: %s", exc)
@@ -131,24 +135,25 @@ def main(argv: list[str] | None = None) -> int:
                 if stripped.startswith("/send "):
                     path = stripped[len("/send "):].strip()
                     try:
-                        with open(path, "rb") as f:
-                            data = f.read()
-                    except OSError as exc:
-                        log.error("cannot read %s: %s", path, exc)
-                        continue
-                    log.info("streaming %s (%d bytes)", path, len(data))
-                    try:
-                        chunks = plugin.stream_and_broadcast(
-                            net, data, chunk_bytes=args.chunk_bytes
+                        # O(chunk) sender memory: the plugin hashes and
+                        # reads the file in passes, never loading it whole.
+                        chunks = plugin.stream_and_broadcast_file(
+                            net, path, chunk_bytes=args.chunk_bytes
                         )
-                    except ValueError as exc:
-                        log.error("stream failed: %s", exc)
+                    except (OSError, ValueError) as exc:
+                        log.error("stream of %s failed: %s", path, exc)
                         continue
                     log.info("streamed %s as %d chunks", path, chunks)
                     continue
                 input_bytes = stripped.encode()
                 log.info("broadcasting message: %s", input_bytes.hex())
-                plugin.shard_and_broadcast(net, input_bytes)
+                try:
+                    plugin.shard_and_broadcast(net, input_bytes)
+                except ValueError as exc:
+                    # e.g. accumulated dynamic geometry exceeding the field
+                    # order (main.go:185-191 reproduced) — the node must
+                    # outlive a rejected line.
+                    log.error("broadcast failed: %s", exc)
     except KeyboardInterrupt:
         pass
     finally:

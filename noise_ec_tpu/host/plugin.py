@@ -355,14 +355,7 @@ class ShardPlugin:
         """
         if not data:
             raise ValueError("cannot stream an empty object")
-        k, n = geometry or (self.minimum_needed_shards, self.total_shards)
-        if not 1 <= k <= n <= self.max_total_shards:
-            raise ValueError(f"invalid stream geometry k={k} n={n}")
-        # Chunk capacity: whole uint32 words per stripe so the padded
-        # chunk equals the capacity on every backend (see wire.py field
-        # docs — the receiver derives per-chunk payload from it).
-        B = max(4 * k, chunk_bytes - chunk_bytes % (4 * k))
-        count = -(-len(data) // B)
+        k, n, B, count = self._stream_plan(len(data), chunk_bytes, geometry)
         # Same preimage as a plain broadcast (serialize_message), hashed
         # in parts to skip a whole-object join copy.
         file_signature = network.keys.sign_parts(
@@ -370,8 +363,94 @@ class ShardPlugin:
             self.hash_policy,
             serialize_message_parts(network.id, data),
         )
+        view = memoryview(data)
+        chunks = (view[i * B : (i + 1) * B] for i in range(count))
+        return self._emit_stream(
+            network, file_signature, k, n, B, count, len(data), chunks
+        )
+
+    def stream_and_broadcast_file(
+        self,
+        network,
+        path: str,
+        *,
+        chunk_bytes: int = 4 << 20,
+        geometry: Optional[tuple[int, int]] = None,
+    ) -> int:
+        """Stream a FILE without loading it into memory.
+
+        Sender memory stays O(chunk): pass 1 computes the object
+        signature by streaming the file through the hash (same
+        ``serialize_message`` preimage — bit-identical signature to
+        ``stream_and_broadcast`` of the same bytes), pass 2 reads, encodes
+        and broadcasts one chunk at a time.
+        """
+        import os
+
+        size = os.path.getsize(path)
+        if size == 0:
+            raise ValueError("cannot stream an empty file")
+        k, n, B, count = self._stream_plan(size, chunk_bytes, geometry)
+        header = serialize_message_parts(network.id, b"")[0]
+
+        def sig_parts():
+            yield header
+            with open(path, "rb") as f:
+                while True:
+                    blk = f.read(4 << 20)
+                    if not blk:
+                        return
+                    yield blk
+
+        file_signature = network.keys.sign_parts(
+            self.signature_policy, self.hash_policy, sig_parts()
+        )
+
+        def chunks():
+            with open(path, "rb") as f:
+                for _ in range(count):
+                    yield f.read(B)
+
+        return self._emit_stream(
+            network, file_signature, k, n, B, count, size, chunks()
+        )
+
+    def _stream_plan(
+        self, length: int, chunk_bytes: int, geometry
+    ) -> tuple[int, int, int, int]:
+        """Validate and size a stream: (k, n, chunk capacity B, count).
+
+        Rejects up front what every receiver would reject anyway (chunk
+        count / object size over the caps) — otherwise the sender reports
+        success while receivers silently drop every shard.
+        """
+        k, n = geometry or (self.minimum_needed_shards, self.total_shards)
+        if not 1 <= k <= n <= self.max_total_shards:
+            raise ValueError(f"invalid stream geometry k={k} n={n}")
+        # Chunk capacity: whole uint32 words per stripe so the padded
+        # chunk equals the capacity on every backend (see wire.py field
+        # docs — the receiver derives per-chunk payload from it).
+        B = max(4 * k, chunk_bytes - chunk_bytes % (4 * k))
+        count = -(-length // B)
+        if length > self.max_stream_object_bytes:
+            raise ValueError(
+                f"object of {length} bytes exceeds the stream cap "
+                f"{self.max_stream_object_bytes}; raise "
+                "max_stream_object_bytes on both ends"
+            )
+        if count > self.max_stream_chunks:
+            raise ValueError(
+                f"{count} chunks exceed the stream cap "
+                f"{self.max_stream_chunks}; use a larger chunk_bytes"
+            )
+        return k, n, B, count
+
+    def _emit_stream(
+        self, network, file_signature: bytes, k: int, n: int, B: int,
+        count: int, length: int, chunks,
+    ) -> int:
         shards_out = bytes_out = 0
-        for index, shares in self._encode_chunks(data, k, n, B):
+        for index, shares in self._encode_chunk_stream(chunks, k, n, B):
             for s in shares:
                 shard = Shard(
                     file_signature=file_signature,
@@ -381,7 +460,7 @@ class ShardPlugin:
                     minimum_needed_shards=k,
                     stream_chunk_index=index,
                     stream_chunk_count=count,
-                    stream_object_bytes=len(data),
+                    stream_object_bytes=length,
                 )
                 network.broadcast(shard)
                 shards_out += 1
@@ -391,18 +470,18 @@ class ShardPlugin:
         self.counters.add("bytes_out", bytes_out)
         return count
 
-    def _encode_chunks(self, data: bytes, k: int, n: int, B: int):
-        """Yield (chunk_index, shares) for every chunk of ``data``.
+    def _encode_chunk_stream(self, chunks, k: int, n: int, B: int):
+        """Yield (chunk_index, shares) for an iterable of chunk payloads.
 
         Device backend: the pipelined StreamingEncoder (H2D of chunk i+1
-        overlaps chunk i's kernels). Other backends: per-chunk FEC encode
-        of the zero-padded chunk.
+        overlaps chunk i's kernels). Other backends: per-chunk encode on
+        the native C++ shim, FEC fallback.
         """
         if self.backend == "device":
             from noise_ec_tpu.parallel.streaming import StreamingEncoder
 
             enc = StreamingEncoder(k, n - k, chunk_bytes=B)
-            for sc in enc.encode_bytes(data):
+            for sc in enc.encode_stream(chunks):
                 # memoryview rows, not .tobytes(): the wire marshal joins
                 # from the buffer directly, one copy instead of two.
                 yield sc.index, [
@@ -412,11 +491,8 @@ class ShardPlugin:
         import numpy as np
 
         shim = self._stream_shim(k, n)
-        count = -(-len(data) // B)
         stride = B // k
-        view = memoryview(data)
-        for index in range(count):
-            chunk = view[index * B : (index + 1) * B]
+        for index, chunk in enumerate(chunks):
             if shim is not None:
                 # Native C++ codec (byte-identical to the golden matrices,
                 # tests/test_shim.py): zero-copy parity fill in one buffer.
