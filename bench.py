@@ -96,7 +96,7 @@ def main() -> None:
     from noise_ec_tpu.gf.field import GF256
     from noise_ec_tpu.matrix.generators import generator_matrix
     from noise_ec_tpu.matrix.linalg import reconstruction_matrix
-    from noise_ec_tpu.ops.dispatch import WORD_QUANTUM, DeviceCodec
+    from noise_ec_tpu.ops.dispatch import DeviceCodec
 
     backend = jax.default_backend()
     on_tpu = backend == "tpu"

@@ -352,3 +352,40 @@ def test_fec_par1_still_uses_subset_search(rng):
     assert fec.decode(bad) == data
     assert fec.stats["subset_decodes"] == 1
     assert fec.stats["bw_decodes"] == 0
+
+
+def test_hostmath_shim_and_numpy_paths_agree(rng, monkeypatch):
+    """host_matvec / host_scale_rows produce identical bytes with the
+    native shim and with the NumPy fallback (CI always has the shim, so
+    the fallback would otherwise never run), and GF(2^16) always takes
+    the NumPy path."""
+    import numpy as np
+
+    import noise_ec_tpu.shim.binding as binding
+    from noise_ec_tpu.gf.field import GF256, GF65536
+    from noise_ec_tpu.matrix.hostmath import host_matvec, host_scale_rows
+
+    if binding._fast_lib() is None:  # pragma: no cover - shim is in CI
+        import pytest
+
+        pytest.skip("native shim unavailable; nothing to cross-check")
+    gf = GF256()
+    M = rng.integers(0, 256, size=(5, 9)).astype(np.uint8)
+    D = rng.integers(0, 256, size=(9, 4097)).astype(np.uint8)
+    consts = rng.integers(0, 256, size=9).astype(np.uint8)
+    with_shim_mv = host_matvec(gf, M, D)
+    with_shim_sc = host_scale_rows(gf, consts, D)
+    # Force the fallback: pretend the library cannot load.
+    monkeypatch.setattr(binding, "_fast_ok", False)
+    no_shim_mv = host_matvec(gf, M, D)
+    no_shim_sc = host_scale_rows(gf, consts, D)
+    assert np.array_equal(with_shim_mv, no_shim_mv)
+    assert np.array_equal(with_shim_sc, no_shim_sc)
+    monkeypatch.undo()
+
+    gf16 = GF65536()
+    M16 = rng.integers(0, 1 << 16, size=(3, 4)).astype(np.uint16)
+    D16 = rng.integers(0, 1 << 16, size=(4, 257)).astype(np.uint16)
+    assert np.array_equal(
+        host_matvec(gf16, M16, D16), gf16.matvec_stripes(M16, D16)
+    )
