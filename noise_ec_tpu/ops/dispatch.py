@@ -229,27 +229,85 @@ class DeviceCodec:
         if self.kernel == "xla":
             fn = _fused_xla_fn(m, r, k, S)
             out = fn(jnp.asarray(self.masks_for(M)), jnp.asarray(D))
+            # np.array (copy) so callers get an ordinary writable ndarray,
+            # not a read-only view of the device buffer.
+            return np.array(out)
+        if m == 16:
+            # BYTE-SLICED GF(2^16): each u16 symbol splits into (lo, hi)
+            # byte rows (2k rows of S bytes), and the device runs the
+            # GF(2^8)-shaped m=8 pipeline — the expanded bit matrix needs
+            # NO permutation because the flat plane index is identical:
+            # 16*j + b == (2*j + b//8)*8 + b%8. This trades two host
+            # relayout passes for the 3-round delta-swap transpose
+            # (vs 4 rounds for 16-plane groups) and the m=8 lane quantum.
+            Db = (
+                np.ascontiguousarray(D)
+                .view(np.uint8)
+                .reshape(k, S, 2)
+                .transpose(0, 2, 1)  # (k, 2, S): row 0 = lo bytes (LE)
+                .reshape(2 * k, S)
+            )
+            out_b = self._bytesliced_words(M, Db, 2 * r)
+            return np.ascontiguousarray(
+                out_b.reshape(r, 2, S).transpose(0, 2, 1)
+            ).view("<u2").reshape(r, S)
+        TWp = pad_words(-(-S // 4))
+        if 4 * TWp != S:
+            buf = np.zeros((k, 4 * TWp), dtype=self.gf.dtype)
+            buf[:, :S] = D
         else:
-            # Host-side symbol -> uint32 view (free when contiguous); the
-            # device program runs entirely on words.
-            sym_per_word = 4 if m == 8 else 2
-            quantize = pad_words if m == 8 else pad_words16
-            TWp = quantize(-(-S // sym_per_word))
-            if sym_per_word * TWp != S:
-                buf = np.zeros((k, sym_per_word * TWp), dtype=self.gf.dtype)
-                buf[:, :S] = D
-            else:
-                buf = np.ascontiguousarray(D)
-            words = buf.view("<u4")
-            mk = _fused_words_fn if m == 8 else _fused_words16_fn
-            fn = mk(r, self.bits_rows_for(M), self.kernel == "pallas_interpret")
-            # np.array: writable copy (np.asarray of a jax array is read-only
-            # and callers are promised an ordinary ndarray).
-            out_w = np.array(fn(jnp.asarray(words)))
-            return np.ascontiguousarray(out_w.view(self.gf.dtype)[:, :S])
-        # np.array (copy) so callers get an ordinary writable ndarray, not a
-        # read-only view of the device buffer.
-        return np.array(out)
+            buf = np.ascontiguousarray(D)
+        words = buf.view("<u4")
+        fn = _fused_words_fn(
+            r, self.bits_rows_for(M), self.kernel == "pallas_interpret"
+        )
+        # np.array: writable copy (np.asarray of a jax array is read-only
+        # and callers are promised an ordinary ndarray).
+        out_w = np.array(fn(jnp.asarray(words)))
+        return np.ascontiguousarray(out_w.view(self.gf.dtype)[:, :S])
+
+    def _bytesliced_words(self, M: np.ndarray, Db: np.ndarray,
+                          r2: int) -> np.ndarray:
+        """(2k, S) uint8 byte rows x the gf65536 matrix -> (2r, S) uint8.
+
+        Runs the m=8 words pipeline over byte rows with the UNPERMUTED
+        expanded GF(2^16) bits (see matmul_stripes).
+        """
+        k2, S = Db.shape
+        TWp = pad_words(-(-S // 4))
+        if 4 * TWp != S:
+            buf = np.zeros((k2, 4 * TWp), dtype=np.uint8)
+            buf[:, :S] = Db
+        else:
+            buf = np.ascontiguousarray(Db)
+        fn = _fused_words_fn(
+            r2, self.bits_rows_for(M), self.kernel == "pallas_interpret"
+        )
+        out_w = np.array(fn(jnp.asarray(buf.view("<u4"))))
+        return out_w.view(np.uint8)[:, :S]
+
+    def matmul_words_bytesliced(self, M: np.ndarray,
+                                words: jnp.ndarray) -> jnp.ndarray:
+        """Device-resident BYTE-SLICED gf65536 words entry.
+
+        ``words`` is (2k, TW8) uint32 over byte rows (shard j's lo-byte
+        row at 2j, hi-byte row at 2j+1 — the framework's device-resident
+        GF(2^16) layout); returns (2r, TW8) parity byte-row words. This
+        is the fast path the bench times; ``matmul_words`` keeps the
+        interleaved-u16 contract on the 16-plane kernels for callers
+        holding that layout.
+        """
+        if self.gf.degree != 16:
+            raise ValueError("matmul_words_bytesliced is gf65536-only")
+        r2 = 2 * M.shape[0]
+        fn = _fused_words_fn(
+            r2, self.bits_rows_for(M), self.kernel == "pallas_interpret"
+        )
+        TW = words.shape[1]
+        TWp = pad_words(TW)
+        if TWp != TW:
+            return fn(jnp.pad(words, ((0, 0), (0, TWp - TW))))[:, :TW]
+        return fn(words)
 
     def matmul_words(self, M: np.ndarray, words: jnp.ndarray) -> jnp.ndarray:
         """Device-resident words entry: (k, TW) uint32 -> (r, TW) uint32.
