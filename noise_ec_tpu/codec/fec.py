@@ -31,7 +31,7 @@ import numpy as np
 
 from noise_ec_tpu.codec.rs import ReedSolomon
 from noise_ec_tpu.golden.codec import GoldenCodec, NotEnoughShardsError, TooManyErrorsError
-from noise_ec_tpu.matrix.bw import grs_normalizers
+from noise_ec_tpu.matrix.bw import grs_normalizers, syndrome_decode_rows
 from noise_ec_tpu.matrix.linalg import gf_inv
 
 __all__ = ["FEC", "Share", "NotEnoughShardsError", "TooManyErrorsError"]
@@ -63,11 +63,24 @@ class FEC:
         field: str = "gf256",
         matrix: str = "cauchy",
         backend: str = "device",
+        bw_route: str = "host",
     ):
         if required < 1:
             raise ValueError(f"required must be >= 1, got {required}")
         if total < required:
             raise ValueError(f"total {total} < required {required}")
+        if bw_route not in ("host", "device"):
+            raise ValueError(f"unknown bw_route {bw_route!r}")
+        if bw_route == "device" and backend != "device":
+            raise ValueError("bw_route='device' requires backend='device'")
+        # Where the decode's syndrome/solve matmuls run. "host" (default)
+        # uses the native shim — right when shares arrive as host bytes
+        # over the wire, since a device round-trip would re-ship every
+        # received byte (multi-ms over PCIe-class links, seconds over the
+        # axon tunnel). "device" routes them through
+        # DeviceCodec.syndrome_stripes — right when stripes are already
+        # device-resident or the host<->device link is wide.
+        self.bw_route = bw_route
         self.k = required
         self.n = total
         self._rs = ReedSolomon(
@@ -198,19 +211,46 @@ class FEC:
             num: self._sym(np.frombuffer(raw, dtype=np.uint8))
             for num, raw in dedup_raw.items()
         }
+        if self._mds_grs:
+            # MDS constructions: the syndrome decoder IS both the fast
+            # path and the error-correcting path (matrix/bw.py) — one
+            # (m-k) x k parity-check product flags bad columns, clean
+            # systematic rows are emitted zero-copy, and corrections are
+            # row XORs solved from the syndrome (the infectious Decode
+            # guarantee, main.go:77).
+            res = syndrome_decode_rows(
+                self._golden.gf,
+                self._golden.matrix_kind,
+                self.k,
+                self.n,
+                nums,
+                [dedup[i] for i in nums],
+                G=self._golden.G,
+                device=self._rs._dev if self.bw_route == "device" else None,
+            )
+            if res is None:
+                m = len(nums)
+                raise TooManyErrorsError(
+                    f"some column has more than {(m - self.k) // 2} errors "
+                    f"(m={m}, k={self.k})"
+                )
+            rows, touched, corrected = res
+            self.stats["bw_decodes" if corrected else "fast_decodes"] += 1
+            # One-copy join: untouched systematic rows ARE the received
+            # bytes; only corrected rows go through a buffer view.
+            return b"".join(
+                dedup_raw[j]
+                if not touched[j]
+                else memoryview(np.ascontiguousarray(rows[j]).view(np.uint8))
+                for j in range(self.k)
+            )
         fast = self._decode_fast(nums, dedup)
         if fast is not None:
             self.stats["fast_decodes"] += 1
             return np.ascontiguousarray(fast).tobytes()
         pairs = [(i, dedup[i]) for i in nums]
-        if self._mds_grs:
-            # Inconsistent shares on an MDS construction: polynomial-time
-            # per-column Berlekamp-Welch (what infectious runs, main.go:77).
-            self.stats["bw_decodes"] += 1
-            data = self._golden.decode_shares_bw(pairs)
-        else:
-            self.stats["subset_decodes"] += 1
-            data = self._golden.decode_shares(pairs)  # (k, S) symbol rows
+        self.stats["subset_decodes"] += 1
+        data = self._golden.decode_shares(pairs)  # (k, S) symbol rows
         return np.ascontiguousarray(data).tobytes()
 
     def _decode_fast(

@@ -181,6 +181,339 @@ def bw_correct_column(
     return out
 
 
+def _syndrome(
+    gf: GF,
+    A: np.ndarray,
+    rows: list,
+    k: int,
+    *,
+    want_s: bool = True,
+    device=None,
+) -> tuple[Optional[np.ndarray], np.ndarray]:
+    """s = A @ rows[:k] ^ rows[k:], plus per-column nonzero-row counts.
+
+    Dispatch: DeviceCodec (one augmented-matrix device matmul) when a
+    device is supplied, the native shim's fused tiled kernel for GF(2^8)
+    on host, row-blocked NumPy otherwise. Row buffers are consumed in
+    place (no stacking copy on the shim path).
+    """
+    if device is not None:
+        return device.syndrome_stripes(A, np.stack(rows))
+    if gf.degree == 8:
+        try:
+            from noise_ec_tpu.shim import gf_syndrome_rows
+
+            out = gf_syndrome_rows(
+                np.asarray(A), rows[:k], rows[k:], rows[0].size,
+                want_syndrome=want_s,
+            )
+            if out is not None:
+                return out
+        except Exception:  # noqa: BLE001 — any shim failure -> NumPy
+            pass
+    pred = gf.matvec_stripes(np.asarray(A, dtype=np.int64), np.stack(rows[:k]))
+    s = (pred.astype(gf.dtype) ^ np.stack(rows[k:])).astype(gf.dtype)
+    return s, np.count_nonzero(s, axis=0)
+
+
+def _matmul_rows(gf: GF, M: np.ndarray, rows: list, *, device=None) -> np.ndarray:
+    """M @ rows over GF on the fastest available backend (see _syndrome)."""
+    if device is not None:
+        return np.asarray(device.matmul_stripes(np.asarray(M), np.stack(rows)))
+    if gf.degree == 8:
+        try:
+            from noise_ec_tpu.shim import gf_matmul_rows
+
+            out = gf_matmul_rows(np.asarray(M), rows, rows[0].size)
+            if out is not None:
+                return out
+        except Exception:  # noqa: BLE001
+            pass
+    return gf.matvec_stripes(
+        np.asarray(M, dtype=np.int64), np.stack(rows)
+    ).astype(gf.dtype)
+
+
+def _independent_rows(gf: GF, B: np.ndarray) -> Optional[list[int]]:
+    """Indices of linearly independent rows spanning B's column space,
+    one per column (B must have full column rank — guaranteed for error
+    signature matrices of MDS codes, where any <= m-k columns of the
+    parity check are independent)."""
+    r2, t = B.shape
+    M = np.asarray(B, dtype=np.int64).copy()
+    chosen: list[int] = []
+    used: set[int] = set()
+    for col in range(t):
+        piv = next(
+            (rr for rr in range(r2) if rr not in used and M[rr, col]), None
+        )
+        if piv is None:
+            return None
+        used.add(piv)
+        chosen.append(piv)
+        M[piv] = gf.div(M[piv], M[piv, col]).astype(np.int64)
+        factors = M[:, col].copy()
+        factors[piv] = 0
+        M ^= gf.mul(factors[:, None], M[piv][None, :]).astype(np.int64)
+    return chosen
+
+
+def _column_error_support(
+    gf: GF, kind: str, k: int, n: int, nums: list[int], colvals: np.ndarray
+) -> Optional[frozenset]:
+    """Received-row indices in error at one column, via a full per-column
+    Berlekamp-Welch solve in the normalized GRS domain; None when the
+    column is beyond the unique-decoding radius."""
+    N = grs_normalizers(gf, kind, k, n)
+    xs = np.asarray(nums, dtype=np.int64)
+    R = gf.mul(N[xs], colvals).astype(np.int64)
+    f = bw_correct_column(gf, xs, R, k)
+    if f is None:
+        return None
+    diff = poly_eval(gf, f, xs).astype(np.int64) != R
+    return frozenset(np.flatnonzero(diff).tolist())
+
+
+def _data_from_coeffs(
+    gf: GF, kind: str, k: int, n: int, f: np.ndarray
+) -> np.ndarray:
+    """One column's k output symbols from its message polynomial: the
+    coefficients themselves for ``vandermonde_raw``, f(j)/N_j at the data
+    positions for the systematic kinds."""
+    if kind == "vandermonde_raw":
+        out = np.zeros(k, dtype=gf.dtype)
+        out[: len(f)] = f[:k]
+        return out
+    N = grs_normalizers(gf, kind, k, n)
+    pts = np.arange(k, dtype=np.int64)
+    vals = poly_eval(gf, f, pts).astype(np.int64)
+    return gf.mul(vals, gf.inv(N[:k]).astype(np.int64)).astype(gf.dtype)
+
+
+# Gather bad columns into a compact sub-problem below this count; above it
+# the full-width path (one masked pass over every column) wins because the
+# gather/scatter traffic exceeds the extra matmul width.
+_GATHER_CAP = 1 << 16
+
+
+def syndrome_decode_rows(
+    gf: GF,
+    kind: str,
+    k: int,
+    n: int,
+    nums: list[int],
+    rows: list,
+    *,
+    G: Optional[np.ndarray] = None,
+    device=None,
+) -> Optional[tuple[list[np.ndarray], list[bool], bool]]:
+    """Error-correcting decode of m received stripe rows, syndrome-first.
+
+    The polynomial-time replacement for per-column Berlekamp-Welch as the
+    *bulk* algorithm, with the same unique-decoding radius floor((m-k)/2)
+    per column — the guarantee infectious's ``Decode`` gives the reference
+    at /root/reference/main.go:77 (SURVEY.md §2.3 D1). Structure:
+
+    1. ONE (m-k) x k parity-check product ``s = A @ basis ^ extra`` with
+       ``A = G[extra] @ inv(G[basis])`` flags the bad columns: a column
+       whose basis-decode disagrees with at most e = floor((m-k)/2)
+       received rows IS the unique codeword (distinct codewords differ in
+       >= m-k+1 > 2e rows), so columns with counts <= e are done — and for
+       a systematic basis their data rows are the received buffers,
+       zero-copy.
+    2. The error *support* T is discovered once per corruption pattern
+       (per-column BW on one bad column), and the error *magnitudes* come
+       from the syndrome itself: s = B_T @ z where B_T stacks A-columns
+       (basis rows) and unit vectors (extra rows), so z solves from |T|
+       independent syndrome rows and the remaining rows verify the
+       hypothesis — small matmuls, no re-interpolation over the payload.
+       Any <= m-k columns of B are independent (punctured MDS duals are
+       MDS), so the solve is exact whenever |T| <= e.
+    3. Corrections are row XORs (``data ^= z`` at verified bad columns);
+       only columns that defeat every shared support fall to the
+       per-column BW loop.
+
+    Returns (data_rows, touched, corrected) — ``touched[j]`` False means
+    row j is the caller's own received buffer, untouched (callers can emit
+    the original bytes without a copy); ``corrected`` is True when error
+    correction actually fired — or None when some column is beyond the
+    radius. Row count m may exceed n only through duplicate share numbers,
+    which callers must have deduplicated.
+    """
+    m = len(rows)
+    if len(nums) != m:
+        raise ValueError(f"{m} rows but {len(nums)} share numbers")
+    if m < k:
+        raise ValueError(f"need >= {k} rows, got {m}")
+    S = rows[0].size
+    if any(r.size != S for r in rows):
+        raise ValueError("stripe lengths differ")
+    nums = [int(x) for x in nums]
+    grs_normalizers(gf, kind, k, n)  # raises for kinds with no GRS form
+    if G is None:
+        from noise_ec_tpu.matrix.generators import generator_matrix
+
+        G = generator_matrix(gf, k, n, kind)
+    e = (m - k) // 2
+    r2 = m - k
+    Gb_inv = gf_inv(gf, G[nums[:k]])
+    A = None
+    s = None
+    # received-row index -> pending XOR deltas; column -> solved (k,) output
+    corrections: dict[int, list] = {}
+    overrides: dict[int, np.ndarray] = {}
+    if r2:
+        A = (
+            gf.matvec_stripes(
+                np.asarray(G[nums[k:]], dtype=np.int64),
+                np.asarray(Gb_inv, dtype=np.int64),
+            )
+        ).astype(gf.dtype)
+        s, counts = _syndrome(gf, A, rows, k, device=device)
+        rem_mask = counts > e
+        nrem = int(np.count_nonzero(rem_mask))
+        if nrem:
+            if e == 0:
+                return None  # any inconsistency is beyond the radius
+            T: list[int] = []
+            for _round in range(e + 1):
+                if not nrem:
+                    break
+                col = int(np.argmax(rem_mask))  # first still-bad column
+                colvals = np.array([int(r_[col]) for r_ in rows], dtype=np.int64)
+                supp = _column_error_support(gf, kind, k, n, nums, colvals)
+                if supp is None:
+                    return None
+                new_T = sorted(set(T) | supp)
+                if not supp or len(new_T) > e:
+                    break  # shared-support model exhausted -> per-column
+                T = new_T
+                t = len(T)
+                B = np.zeros((r2, t), dtype=gf.dtype)
+                for ci, trow in enumerate(T):
+                    if trow < k:
+                        B[:, ci] = A[:, trow]
+                    else:
+                        B[trow - k, ci] = 1
+                P = _independent_rows(gf, B)
+                if P is None:
+                    break
+                W = gf_inv(gf, B[P])
+                Q = [i for i in range(r2) if i not in set(P)]
+                if nrem <= _GATHER_CAP:
+                    remaining = np.flatnonzero(rem_mask)
+                    scols = np.ascontiguousarray(s[:, remaining])
+                    z = _matmul_rows(gf, W, [scols[p] for p in P])
+                    if Q:
+                        _, c2 = _syndrome(
+                            gf, B[Q], list(z) + [scols[q] for q in Q], t,
+                            want_s=False,
+                        )
+                        ok = c2 == 0
+                    else:
+                        ok = np.ones(remaining.size, dtype=bool)
+                    if not ok.any():
+                        break
+                    okcols = remaining[ok]
+                    for ci, trow in enumerate(T):
+                        corrections.setdefault(trow, []).append(
+                            ("sparse", okcols, z[ci][ok].astype(gf.dtype))
+                        )
+                    rem_mask[okcols] = False
+                    nrem -= int(okcols.size)
+                else:
+                    # Full-width pass: index materialization over millions
+                    # of bad columns (whole-share corruption makes every
+                    # column bad) costs more than operating on the masks.
+                    z = _matmul_rows(gf, W, [s[p] for p in P], device=device)
+                    if Q:
+                        _, c2 = _syndrome(
+                            gf, B[Q], list(z) + [s[q] for q in Q], t,
+                            want_s=False, device=device,
+                        )
+                        apply_mask = rem_mask & (c2 == 0)
+                    else:
+                        apply_mask = rem_mask.copy()
+                    napply = int(np.count_nonzero(apply_mask))
+                    if napply == 0:
+                        break
+                    for ci, trow in enumerate(T):
+                        delta = (
+                            z[ci].astype(gf.dtype, copy=False)
+                            if napply == S
+                            else np.where(apply_mask, z[ci], 0).astype(gf.dtype)
+                        )
+                        corrections.setdefault(trow, []).append(("full", delta))
+                    if napply == nrem:
+                        nrem = 0
+                    else:
+                        rem_mask &= ~apply_mask
+                        nrem -= napply
+            # Columns no shared support explains: full per-column solves.
+            if nrem:
+                N = grs_normalizers(gf, kind, k, n)
+                xs = np.asarray(nums, dtype=np.int64)
+                for col in np.flatnonzero(rem_mask):
+                    colvals = np.array(
+                        [int(r_[col]) for r_ in rows], dtype=np.int64
+                    )
+                    f = bw_correct_column(
+                        gf, xs, gf.mul(N[xs], colvals).astype(np.int64), k
+                    )
+                    if f is None:
+                        return None
+                    overrides[int(col)] = _data_from_coeffs(gf, kind, k, n, f)
+
+    ov_cols = ov_vals = None
+    if overrides:
+        ov_cols = np.fromiter(overrides.keys(), dtype=np.int64)
+        ov_vals = np.stack([overrides[int(c)] for c in ov_cols], axis=1)
+
+    def corrected(i: int, force_copy: bool = False) -> tuple[np.ndarray, bool]:
+        """Row i with its pending deltas applied; (array, was_touched)."""
+        out: Optional[np.ndarray] = None
+        for entry in corrections.get(i, ()):
+            if entry[0] == "full":
+                out = (rows[i] if out is None else out) ^ entry[1]
+            else:
+                _, cols, vals = entry
+                if out is None:
+                    out = rows[i].copy()
+                out[cols] ^= vals
+        if out is None:
+            if force_copy:
+                return rows[i].copy(), False
+            return rows[i], False
+        return out, True
+
+    pos_of: dict[int, int] = {}
+    for i, num in enumerate(nums):
+        pos_of.setdefault(num, i)
+    systematic = kind != "vandermonde_raw" and np.array_equal(
+        np.asarray(G[:k]), np.eye(k, dtype=np.asarray(G).dtype)
+    )
+    if systematic and all(j in pos_of for j in range(k)):
+        data_rows: list[np.ndarray] = []
+        touched: list[bool] = []
+        for j in range(k):
+            row, was = corrected(pos_of[j], force_copy=ov_cols is not None)
+            if ov_cols is not None:
+                row[ov_cols] = ov_vals[j]
+                was = True
+            data_rows.append(row)
+            touched.append(was)
+        return data_rows, touched, bool(corrections or overrides)
+    # General path (missing data positions, or an evaluation code): decode
+    # the message from the corrected basis rows — clean columns have
+    # error-free basis rows (an error there forces counts > e), corrected
+    # columns were restored above, override columns are overwritten below.
+    base = [corrected(i)[0] for i in range(k)]
+    data = _matmul_rows(gf, Gb_inv, base, device=device)
+    if ov_cols is not None:
+        data[:, ov_cols] = ov_vals
+    return list(data), [True] * k, bool(corrections or overrides)
+
+
 def bw_decode_stripes(
     gf: GF,
     kind: str,
@@ -191,94 +524,16 @@ def bw_decode_stripes(
 ) -> Optional[np.ndarray]:
     """Decode (m, S) received stripes at share numbers ``nums`` -> (k, S) data.
 
-    Error-correcting within the per-column unique-decoding radius
-    floor((m - k)/2), exactly the guarantee infectious's Decode gives the
-    reference (SURVEY.md §2.3 D1). Vectorized fast path: interpolate f from
-    the first k received rows for every column at once, re-evaluate at all
-    received points, and run per-column Berlekamp-Welch only on columns with
-    a disagreement. Returns None if any column is beyond the radius.
-
+    Array-in/array-out wrapper over :func:`syndrome_decode_rows` (same
+    radius, same reference contract — infectious Decode, main.go:77).
     For ``vandermonde_raw`` the returned rows are f's coefficients (the
-    code's message is the coefficient vector); for the systematic kinds they
-    are the data shards.
+    code's message is the coefficient vector); for the systematic kinds
+    they are the data shards.
     """
-    from noise_ec_tpu.matrix.hostmath import host_matvec, host_scale_rows
-
-    m, S = stripes.shape
-    if m < k:
-        raise ValueError(f"need >= {k} rows, got {m}")
-    e = (m - k) // 2
-    N = grs_normalizers(gf, kind, k, n)
-    xs = np.asarray(nums, dtype=np.int64)
-    # (m, S) f(x_i) + err — per-row constant scale on the native kernels.
-    # Kept in the field dtype: int64 promotion here used to cost two full
-    # (m, S) conversions plus 8x the compare traffic in disagreements.
-    R = host_scale_rows(gf, N[xs], stripes).astype(gf.dtype, copy=False)
-
-    Vm = np.ones((m, k), dtype=np.int64)
-    for j in range(1, k):
-        Vm[:, j] = gf.mul(Vm[:, j - 1], xs)
-
-    def interpolate_from(basis: list[int], cols=None) -> np.ndarray:
-        """Vectorized degree-<k fit through ``basis`` rows.
-
-        ``cols`` restricts the fit to a column subset (pass 2 touches only
-        the columns pass 1 rejected, not all S of them)."""
-        Vb = np.ones((k, k), dtype=np.int64)
-        for j in range(1, k):
-            Vb[:, j] = gf.mul(Vb[:, j - 1], xs[basis])
-        src = R[basis] if cols is None else R[np.ix_(basis, cols)]
-        # host_matvec: native split-nibble/GFNI kernels when the shim is
-        # available, row-blocked NumPy otherwise — S can be millions of
-        # symbols on the FEC fallback.
-        return host_matvec(gf, gf_inv(gf, Vb), src)  # (k, len(cols) or S)
-
-    def disagreements(cand: np.ndarray, cols=None) -> np.ndarray:
-        """Per-column count of received rows the candidate disagrees with."""
-        predicted = host_matvec(gf, Vm, cand).astype(gf.dtype, copy=False)
-        ref = R if cols is None else R[:, cols]
-        return np.sum(predicted != ref, axis=0)
-
-    # Pass 1 — interpolate from the first k rows. Any degree-<k polynomial
-    # is a codeword, and distinct codewords differ in >= m-k+1 > 2e rows,
-    # so a candidate within Hamming distance e of a column IS that column's
-    # unique decode: accept every column with <= e disagreements.
-    coeffs = interpolate_from(list(range(k)))
-    bad = np.nonzero(disagreements(coeffs) > e)[0]
-    coeffs = coeffs.astype(gf.dtype)
-
-    if len(bad):
-        # Pass 2 — the basis itself was poisoned. Under whole-share
-        # corruption (the common case: a peer ships garbage) the same rows
-        # are wrong in every column, so ONE per-column solve identifies
-        # them; re-fit without those rows and re-apply the distance test.
-        # Only genuinely scattered corruption pays the per-column loop.
-        f0 = bw_correct_column(gf, xs, R[:, bad[0]], k)
-        if f0 is None:
-            return None
-        suspect = set(
-            np.nonzero(poly_eval(gf, f0, xs).astype(np.int64) != R[:, bad[0]])[0].tolist()
-        )
-        coeffs[:, bad[0]] = f0
-        bad = bad[1:]
-        clean = [i for i in range(m) if i not in suspect]
-        if len(bad) and suspect and len(clean) >= k:
-            refit = interpolate_from(clean[:k], cols=bad)
-            ok = disagreements(refit, cols=bad) <= e
-            coeffs[:, bad[ok]] = refit[:, ok].astype(gf.dtype)
-            bad = bad[~ok]
-        for col in bad:
-            fixed = bw_correct_column(gf, xs, R[:, col], k)
-            if fixed is None:
-                return None
-            coeffs[:, col] = fixed
-
-    if kind == "vandermonde_raw":
-        return coeffs
-    # Systematic kinds: d_j = f(j) / N_j for data positions 0..k-1.
-    Vd = np.ones((k, k), dtype=np.int64)
-    pts = np.arange(k, dtype=np.int64)
-    for j in range(1, k):
-        Vd[:, j] = gf.mul(Vd[:, j - 1], pts)
-    vals = host_matvec(gf, Vd, coeffs)  # (k, S) f(j)
-    return host_scale_rows(gf, gf.inv(N[:k]), vals).astype(gf.dtype)
+    stripes = np.asarray(stripes)
+    rows = [np.ascontiguousarray(stripes[i]) for i in range(stripes.shape[0])]
+    res = syndrome_decode_rows(gf, kind, k, n, list(nums), rows)
+    if res is None:
+        return None
+    data_rows, _, _ = res
+    return np.stack(data_rows).astype(gf.dtype)

@@ -1,0 +1,157 @@
+"""GF(2) generator matmul on the MXU: int8 bit-planes, mod-2 accumulators.
+
+The fused VPU kernels (ops/pallas_fused.py) compute the bitsliced encode as
+a Paar-factored XOR network on u32 lanes; for wide codes the XOR count is
+the wall (RS(50,20): ~10.1k XORs — BASELINE.md config 3). This module is
+the alternative formulation VERDICT r3 asked to measure before conceding
+that bound: treat the (8r, 8k) GF(2) generator bit-matrix as an int8
+operand, the data bits as an int8 (8k, S) matrix of 0/1, and run the whole
+product on the 128x128 systolic array —
+
+    acc (8r, S) = M2 (8r, 8k) @ bits (8k, S)   in int8 x int8 -> int32
+    parity_bit  = acc & 1                       (popcount parity == mod 2)
+
+Everything (u32 -> byte -> bit unpack, the dot, bit -> byte -> u32 repack)
+lives inside ONE Pallas kernel so the 8x bit-plane blowup and the 32-bit
+accumulators never touch HBM: per grid step the kernel reads a (k, TWt)
+u32 block and writes the (r, TWt) parity block, HBM traffic identical to
+the VPU kernels. Arithmetic cost is fixed at 64*r*k MACs per data byte —
+on a v5e (394 INT8 TOPS) the roofline for RS(50,20) is ~308 GB/s, which is
+why this only makes sense for wide codes; RS(10,4)'s XOR network is far
+below its MXU MAC count.
+
+Reference contract: the same encode hot loop as ops/pallas_fused.py
+(/root/reference/main.go:262 via infectious Encode).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from noise_ec_tpu.gf.bitmatrix import expand_generator_bits
+from noise_ec_tpu.gf.field import GF
+
+# Lane-tile width in u32 words per grid step. 512 words = 2048 byte
+# columns; the in-kernel int8 bit matrix is (8k, 2048) = 16k * k bytes —
+# ~800 KiB at k=50, comfortably VMEM-resident beside the i32 accumulator.
+MXU_TILE_WORDS = 512
+
+
+def _mxu_kernel(r: int, k: int, kernel_tw: int, m2_ref, w_ref, o_ref):
+    st = kernel_tw * 4  # byte columns per step
+    w = w_ref[...]  # (k, TWt) uint32
+    shifts = jnp.arange(4, dtype=jnp.uint32) * 8  # LE byte order (<u4 view)
+    byts = (w[:, :, None] >> shifts[None, None, :]) & 0xFF  # (k, TWt, 4)
+    byts = byts.reshape(k, st)
+    bitshift = jnp.arange(8, dtype=jnp.uint32)
+    bits = (byts[:, None, :] >> bitshift[None, :, None]) & 1  # (k, 8, st)
+    x = bits.reshape(8 * k, st).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        m2_ref[...],
+        x,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # (8r, st)
+    pbits = (acc & 1).astype(jnp.uint32).reshape(r, 8, st)
+    pbytes = (pbits << bitshift[None, :, None]).sum(axis=1)  # (r, st)
+    pbytes = pbytes.reshape(r, kernel_tw, 4)
+    o_ref[...] = (
+        pbytes[:, :, 0]
+        | (pbytes[:, :, 1] << 8)
+        | (pbytes[:, :, 2] << 16)
+        | (pbytes[:, :, 3] << 24)
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("r", "k", "tile_words", "interpret")
+)
+def _mxu_encode_words_jit(m2, words, *, r, k, tile_words, interpret):
+    from jax.experimental import pallas as pl
+
+    kt = tile_words
+    tw = words.shape[1]
+    grid = (tw // kt,)
+    return pl.pallas_call(
+        functools.partial(_mxu_kernel, r, k, kt),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((8 * r, 8 * k), lambda i: (0, 0)),
+            pl.BlockSpec((k, kt), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((r, kt), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((r, tw), jnp.uint32),
+        interpret=interpret,
+    )(m2, words)
+
+
+class MxuCodec:
+    """Experimental MXU-route encoder over u32 word stripes.
+
+    Same contract as DeviceCodec.matmul_words (parity rows only); kept
+    separate so the verified planner can measure it against the XOR
+    network per geometry instead of hardwiring either.
+    """
+
+    def __init__(self, gf: GF, tile_words: int = MXU_TILE_WORDS,
+                 interpret: bool = False):
+        if gf.degree != 8:
+            raise ValueError("MXU route currently GF(2^8) only")
+        self.gf = gf
+        self.tile_words = tile_words
+        self.interpret = interpret
+        self._m2_cache: dict[bytes, jnp.ndarray] = {}
+
+    def _m2_for(self, M: np.ndarray) -> jnp.ndarray:
+        M = np.ascontiguousarray(np.asarray(M, dtype=self.gf.dtype))
+        key = M.tobytes() + bytes([M.shape[1] & 0xFF])
+        hit = self._m2_cache.get(key)
+        if hit is None:
+            hit = jnp.asarray(
+                expand_generator_bits(self.gf, M).astype(np.int8)
+            )
+            if len(self._m2_cache) > 256:
+                self._m2_cache.clear()
+            self._m2_cache[key] = hit
+        return hit
+
+    def encode_words(self, M: np.ndarray, words) -> jnp.ndarray:
+        """(r, k) GF matrix x (k, TW) u32 words -> (r, TW) parity words.
+
+        TW must be a multiple of ``tile_words`` (callers pad, exactly as
+        for the fused VPU kernels)."""
+        r, k = np.asarray(M).shape
+        words = jnp.asarray(words)
+        if words.shape[0] != k:
+            raise ValueError(f"matrix cols {k} != word rows {words.shape[0]}")
+        if words.shape[1] % self.tile_words:
+            raise ValueError(
+                f"TW {words.shape[1]} not a multiple of tile {self.tile_words}"
+            )
+        return _mxu_encode_words_jit(
+            self._m2_for(M),
+            words,
+            r=r,
+            k=k,
+            tile_words=self.tile_words,
+            interpret=self.interpret,
+        )
+
+    def encode_stripes(self, M: np.ndarray, D: np.ndarray) -> np.ndarray:
+        """Byte-stripe convenience wrapper (pads to the word tile)."""
+        D = np.ascontiguousarray(np.asarray(D, dtype=np.uint8))
+        r, k = np.asarray(M).shape
+        S = D.shape[1]
+        quantum = 4 * self.tile_words
+        Sp = -(-S // quantum) * quantum
+        if Sp != S:
+            buf = np.zeros((k, Sp), dtype=np.uint8)
+            buf[:, :S] = D
+        else:
+            buf = D
+        out = np.array(self.encode_words(M, buf.view("<u4")))
+        return out.view(np.uint8)[:, :S]

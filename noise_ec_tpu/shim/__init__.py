@@ -9,15 +9,19 @@ ctypes loader.
 from noise_ec_tpu.shim.binding import (
     CppReedSolomon,
     build_shim,
+    gf_matmul_rows,
     gf_matmul_stripes,
     gf_scale_rows,
+    gf_syndrome_rows,
     shim_available,
 )
 
 __all__ = [
     "CppReedSolomon",
     "build_shim",
+    "gf_matmul_rows",
     "gf_matmul_stripes",
     "gf_scale_rows",
+    "gf_syndrome_rows",
     "shim_available",
 ]

@@ -82,6 +82,19 @@ def _load() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_int, ctypes.c_size_t,
         ]
+        lib.rs_matmul_rows.restype = ctypes.c_int
+        lib.rs_matmul_rows.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_size_t,
+        ]
+        lib.rs_syndrome_rows.restype = ctypes.c_int
+        lib.rs_syndrome_rows.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_size_t,
+        ]
         _lib = lib
     return _lib
 
@@ -126,6 +139,76 @@ def gf_matmul_stripes(M: np.ndarray, D: np.ndarray) -> Optional[np.ndarray]:
     if rc != 0:
         raise RuntimeError(f"rs_matmul failed: {rc}")
     return out
+
+
+def _row_ptrs(rows: Sequence[np.ndarray]):
+    """ctypes void* array over per-row uint8 buffers (no stacking copy).
+
+    Each row must be a C-contiguous 1-D uint8 array; returns (ptr_array,
+    keepalive list) — the caller must hold the keepalive until the C call
+    returns, because ascontiguousarray may have created temporaries.
+    """
+    keep = [np.ascontiguousarray(r, dtype=np.uint8) for r in rows]
+    arr = (ctypes.c_void_p * len(keep))(*[r.ctypes.data for r in keep])
+    return arr, keep
+
+
+def gf_matmul_rows(
+    M: np.ndarray, rows: Sequence[np.ndarray], length: int
+) -> Optional[np.ndarray]:
+    """M (r, k) @ rows (k separate buffers of ``length`` bytes) -> (r,
+    length) uint8, tiled; None when the shim is unavailable."""
+    lib = _fast_lib()
+    if lib is None:
+        return None
+    Mb = np.ascontiguousarray(M, dtype=np.uint8)
+    r, k = Mb.shape
+    out = np.empty((r, length), dtype=np.uint8)
+    in_ptrs, in_keep = _row_ptrs(rows)
+    out_ptrs, out_keep = _row_ptrs(list(out))
+    rc = lib.rs_matmul_rows(_as_u8_ptr(Mb), r, k, in_ptrs, out_ptrs, length)
+    del in_keep
+    if rc != 0:
+        raise RuntimeError(f"rs_matmul_rows failed: {rc}")
+    # out rows were written through out_keep views, which alias out's rows
+    # only if ascontiguousarray did not copy — rows of a fresh C-order
+    # array are contiguous, so they alias by construction.
+    del out_keep
+    return out
+
+
+def gf_syndrome_rows(
+    A: np.ndarray,
+    basis: Sequence[np.ndarray],
+    extra: Sequence[np.ndarray],
+    length: int,
+    want_syndrome: bool = True,
+) -> Optional[tuple[Optional[np.ndarray], np.ndarray]]:
+    """Fused decode syndrome (see rs_syndrome_rows): returns (s, counts)
+    where s (len(extra), length) = A @ basis ^ extra and counts[col] is the
+    number of nonzero syndrome rows at that column; s is None when
+    ``want_syndrome`` is False. None when the shim is unavailable."""
+    lib = _fast_lib()
+    if lib is None:
+        return None
+    Ab = np.ascontiguousarray(A, dtype=np.uint8)
+    r2, k = Ab.shape
+    counts = np.empty(length, dtype=np.uint8)
+    b_ptrs, b_keep = _row_ptrs(basis)
+    e_ptrs, e_keep = _row_ptrs(extra)
+    s = np.empty((r2, length), dtype=np.uint8) if want_syndrome else None
+    if s is not None:
+        s_ptrs, s_keep = _row_ptrs(list(s))
+    else:
+        s_ptrs, s_keep = None, None
+    rc = lib.rs_syndrome_rows(
+        _as_u8_ptr(Ab), r2, k, b_ptrs, e_ptrs, s_ptrs, _as_u8_ptr(counts),
+        length,
+    )
+    del b_keep, e_keep, s_keep
+    if rc != 0:
+        raise RuntimeError(f"rs_syndrome_rows failed: {rc}")
+    return s, counts
 
 
 def gf_scale_rows(consts: np.ndarray, D: np.ndarray) -> Optional[np.ndarray]:

@@ -271,11 +271,53 @@ const char* rs_shim_version() { return "noise-ec-tpu-shim/1 gf256 poly=0x11D"; }
 int rs_matmul(const uint8_t* M, int r, int k, const uint8_t* in, uint8_t* out,
               size_t len) {
   if (!M || !in || !out || r < 1 || k < 1) return -1;
-  std::memset(out, 0, static_cast<size_t>(r) * len);
-  for (int i = 0; i < r; ++i)
-    for (int j = 0; j < k; ++j)
-      mul_add_row(out + static_cast<size_t>(i) * len,
-                  in + static_cast<size_t>(j) * len, M[i * k + j], len);
+  std::vector<const uint8_t*> ip(static_cast<size_t>(k));
+  std::vector<uint8_t*> op(static_cast<size_t>(r));
+  for (int j = 0; j < k; ++j) ip[j] = in + static_cast<size_t>(j) * len;
+  for (int i = 0; i < r; ++i) op[i] = out + static_cast<size_t>(i) * len;
+  matmul_rows(M, r, k, ip.data(), op.data(), len);
+  return 0;
+}
+
+// Pointer-based variant of rs_matmul: each input/output row is its own
+// buffer, so callers holding non-contiguous rows (e.g. byte views of
+// separately received shards) pay zero stacking copies. Same tiled kernel.
+int rs_matmul_rows(const uint8_t* M, int r, int k, const uint8_t* const* in,
+                   uint8_t* const* out, size_t len) {
+  if (!M || !in || !out || r < 1 || k < 1) return -1;
+  matmul_rows(M, r, k, in, out, len);
+  return 0;
+}
+
+// Fused syndrome kernel for the error-correcting decode (matrix/bw.py):
+//   s_i = (sum_j A[i][j] * basis[j]) ^ extra[i]        i in [0, r2)
+//   counts[col] = number of rows i with s_i[col] != 0
+// in ONE cache-tiled pass over the inputs — the decode's bad-column scan
+// costs one read of the received rows instead of matmul + XOR + compare +
+// reduce round-trips through memory. s_out may be NULL (counts only) and
+// counts may be NULL (syndrome only); rows are independent pointers so
+// received shard buffers are consumed in place. Returns 0 on success.
+int rs_syndrome_rows(const uint8_t* A, int r2, int k,
+                     const uint8_t* const* basis, const uint8_t* const* extra,
+                     uint8_t* const* s_out, uint8_t* counts, size_t len) {
+  if (!A || !basis || !extra || r2 < 1 || k < 1) return -1;
+  if (!s_out && !counts) return -1;
+  constexpr size_t kTile = 32 << 10;
+  std::vector<uint8_t> tmp(kTile);
+  if (counts) std::memset(counts, 0, len);
+  for (size_t off = 0; off < len; off += kTile) {
+    size_t t = len - off < kTile ? len - off : kTile;
+    for (int i = 0; i < r2; ++i) {
+      std::memcpy(tmp.data(), extra[i] + off, t);
+      for (int j = 0; j < k; ++j)
+        mul_add_row(tmp.data(), basis[j] + off, A[static_cast<size_t>(i) * k + j], t);
+      if (counts) {
+        uint8_t* cnt = counts + off;
+        for (size_t c = 0; c < t; ++c) cnt[c] += tmp[c] != 0;
+      }
+      if (s_out) std::memcpy(s_out[i] + off, tmp.data(), t);
+    }
+  }
   return 0;
 }
 

@@ -57,6 +57,19 @@ int rs_matmul(const uint8_t* M, int r, int k, const uint8_t* in,
  * contiguous). Returns 0 on success. */
 int rs_scale_rows(const uint8_t* consts, uint8_t* buf, int rows, size_t len);
 
+/* rs_matmul over independent row buffers (no stacking copies): out[i] =
+ * sum_j M[i][j] * in[j], cache-tiled. Returns 0 on success. */
+int rs_matmul_rows(const uint8_t* M, int r, int k, const uint8_t* const* in,
+                   uint8_t* const* out, size_t len);
+
+/* Fused decode syndrome: s[i] = (M-product of basis rows) ^ extra[i], and
+ * counts[col] = number of nonzero rows of s at that column, one tiled
+ * pass. s_out may be NULL (counts only); counts may be NULL (syndrome
+ * only). Returns 0 on success. */
+int rs_syndrome_rows(const uint8_t* A, int r2, int k,
+                     const uint8_t* const* basis, const uint8_t* const* extra,
+                     uint8_t* const* s_out, uint8_t* counts, size_t len);
+
 #ifdef __cplusplus
 } /* extern "C" */
 #endif

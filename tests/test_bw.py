@@ -389,3 +389,203 @@ def test_hostmath_shim_and_numpy_paths_agree(rng, monkeypatch):
     assert np.array_equal(
         host_matvec(gf16, M16, D16), gf16.matvec_stripes(M16, D16)
     )
+
+
+# -- syndrome-decode machinery (round 4) ------------------------------------
+
+
+def test_shim_syndrome_and_matmul_rows_match_numpy(rng):
+    """The fused rs_syndrome_rows / rs_matmul_rows kernels agree with the
+    NumPy formulation bit-for-bit, including the counts reduction and the
+    counts-only (s_out = NULL) mode."""
+    import noise_ec_tpu.shim.binding as binding
+
+    if binding._fast_lib() is None:  # pragma: no cover - shim is in CI
+        pytest.skip("native shim unavailable")
+    from noise_ec_tpu.shim import gf_matmul_rows, gf_syndrome_rows
+
+    gf = GF256()
+    k, r2, S = 7, 5, 4097  # odd length exercises the tile tail
+    A = rng.integers(0, 256, size=(r2, k)).astype(np.uint8)
+    basis = [rng.integers(0, 256, size=S).astype(np.uint8) for _ in range(k)]
+    extra = [rng.integers(0, 256, size=S).astype(np.uint8) for _ in range(r2)]
+    want_pred = gf.matvec_stripes(A, np.stack(basis)).astype(np.uint8)
+    want_s = want_pred ^ np.stack(extra)
+    got_mm = gf_matmul_rows(A, basis, S)
+    np.testing.assert_array_equal(got_mm, want_pred)
+    s, counts = gf_syndrome_rows(A, basis, extra, S)
+    np.testing.assert_array_equal(s, want_s)
+    np.testing.assert_array_equal(counts, np.count_nonzero(want_s, axis=0))
+    s2, counts2 = gf_syndrome_rows(A, basis, extra, S, want_syndrome=False)
+    assert s2 is None
+    np.testing.assert_array_equal(counts2, counts)
+
+
+def test_syndrome_decode_rows_zero_copy_touched_flags(rng):
+    """Clean systematic decode returns the caller's own row buffers
+    (touched all False); corruption touches ONLY the repaired rows."""
+    from noise_ec_tpu.matrix.bw import syndrome_decode_rows
+
+    gf = GF256()
+    k, n, S = 5, 9, 2048
+    gold = GoldenCodec(k, n)
+    data = rng.integers(0, 256, size=(k, S)).astype(np.uint8)
+    cw = gold.encode_all(data)
+    rows = [np.ascontiguousarray(cw[i]) for i in range(n)]
+    out, touched, corrected = syndrome_decode_rows(
+        gf, "cauchy", k, n, list(range(n)), rows
+    )
+    assert not corrected
+    assert touched == [False] * k
+    for j in range(k):
+        assert out[j] is rows[j]  # the very same buffer, no copy
+    # Corrupt data share 2 wholesale: only row 2 is touched.
+    rows2 = list(rows)
+    rows2[2] = rows[2] ^ 0x7F
+    out2, touched2, corrected2 = syndrome_decode_rows(
+        gf, "cauchy", k, n, list(range(n)), rows2
+    )
+    assert corrected2
+    assert touched2 == [False, False, True, False, False]
+    np.testing.assert_array_equal(np.stack(out2), data)
+
+
+def test_syndrome_decode_parity_corruption_leaves_data_untouched(rng):
+    """Corruption confined to parity shares: data rows pass through
+    zero-copy (corrections target rows the output never uses)."""
+    from noise_ec_tpu.matrix.bw import syndrome_decode_rows
+
+    gf = GF256()
+    k, n, S = 4, 10, 1024
+    gold = GoldenCodec(k, n)
+    data = rng.integers(0, 256, size=(k, S)).astype(np.uint8)
+    cw = gold.encode_all(data)
+    rows = [np.ascontiguousarray(cw[i]) for i in range(n)]
+    rows[k] = rows[k] ^ 0x11  # parity share 4 garbage
+    rows[k + 1] = rows[k + 1] ^ 0x22  # parity share 5 garbage
+    out, touched, corrected = syndrome_decode_rows(
+        gf, "cauchy", k, n, list(range(n)), rows
+    )
+    # Basis decode already agrees with >= m - e rows; whether the solver
+    # marks the run corrected is an implementation detail, but data rows
+    # must be the original buffers.
+    np.testing.assert_array_equal(np.stack(out), data)
+    assert touched == [False] * k
+
+
+def test_syndrome_decode_missing_data_share_with_corruption(rng):
+    """Erasure + corruption mix: data share 1 never arrives AND share 3 is
+    corrupt — the general (non-passthrough) path reconstructs both."""
+    from noise_ec_tpu.matrix.bw import syndrome_decode_rows
+
+    gf = GF256()
+    k, n, S = 5, 11, 777
+    gold = GoldenCodec(k, n)
+    data = rng.integers(0, 256, size=(k, S)).astype(np.uint8)
+    cw = gold.encode_all(data)
+    nums = [0, 2, 3, 4, 5, 6, 7, 8, 9, 10]  # share 1 missing
+    rows = [np.ascontiguousarray(cw[i]) for i in nums]
+    rows[2] = rows[2] ^ 0x55  # corrupt share number 3 (one whole share)
+    out, touched, corrected = syndrome_decode_rows(
+        gf, "cauchy", k, n, nums, rows
+    )
+    assert corrected
+    assert touched == [True] * k
+    np.testing.assert_array_equal(np.stack(out), data)
+
+
+def test_syndrome_decode_gf65536_numpy_fallback(rng):
+    """GF(2^16) has no native shim: the NumPy syndrome path must correct
+    a corrupted share identically."""
+    from noise_ec_tpu.matrix.bw import syndrome_decode_rows
+
+    gf = GF65536()
+    k, n, S = 4, 8, 513
+    gold = GoldenCodec(k, n, field="gf65536")
+    data = rng.integers(0, 1 << 16, size=(k, S)).astype(np.uint16)
+    cw = gold.encode_all(data)
+    rows = [np.ascontiguousarray(cw[i]) for i in range(n)]
+    rows[0] = rows[0] ^ 0x1234
+    out, touched, corrected = syndrome_decode_rows(
+        gf, "cauchy", k, n, list(range(n)), rows
+    )
+    assert corrected and touched[0]
+    np.testing.assert_array_equal(np.stack(out), data)
+
+
+def test_device_codec_syndrome_stripes_matches_host(rng):
+    """DeviceCodec.syndrome_stripes (the [A | I] augmented device matmul)
+    equals the host shim/NumPy syndrome — the VERDICT-r3 device route for
+    corrupted-share decode."""
+    from noise_ec_tpu.matrix.bw import _syndrome
+    from noise_ec_tpu.ops.dispatch import DeviceCodec
+
+    gf = GF256()
+    k, r2, S = 6, 4, 2048
+    A = rng.integers(0, 256, size=(r2, k)).astype(np.uint8)
+    rows = [
+        rng.integers(0, 256, size=S).astype(np.uint8) for _ in range(k + r2)
+    ]
+    host_s, host_counts = _syndrome(gf, A, rows, k)
+    dev = DeviceCodec(field="gf256", kernel="xla")
+    dev_s, dev_counts = dev.syndrome_stripes(A, np.stack(rows))
+    np.testing.assert_array_equal(dev_s, host_s)
+    np.testing.assert_array_equal(dev_counts, host_counts)
+
+
+def test_fec_bw_route_device_corrects_corruption(rng):
+    """FEC(bw_route='device') drives the whole error-correcting decode
+    with the device codec doing the syndrome matmuls (jax CPU backend in
+    CI; the same code path hits the TPU kernels on hardware)."""
+    from noise_ec_tpu.codec.fec import FEC, Share
+
+    fec = FEC(6, 10, backend="device", bw_route="device")
+    data = bytes(rng.integers(0, 256, size=6 * 512).astype(np.uint8))
+    shares = fec.encode_shares(data)
+    bad = [
+        Share(s.number, bytes(b ^ 0x5A for b in s.data))
+        if s.number in (1, 7)
+        else s
+        for s in shares
+    ]
+    assert fec.decode(bad) == data
+    assert fec.stats["bw_decodes"] == 1
+    # And the clean set still decodes fast.
+    assert fec.decode(shares) == data
+    assert fec.stats["fast_decodes"] >= 1
+
+
+def test_fec_bw_route_validation():
+    from noise_ec_tpu.codec.fec import FEC
+
+    with pytest.raises(ValueError):
+        FEC(4, 6, bw_route="numpy")
+    with pytest.raises(ValueError):
+        FEC(4, 6, backend="numpy", bw_route="device")
+
+
+def test_syndrome_decode_scattered_distinct_supports_per_column(rng):
+    """Each column's corrupt-row set differs (the union of supports
+    exceeds no single column's weight): the shared-support rounds plus the
+    per-column fallback must still land every column exactly."""
+    from noise_ec_tpu.matrix.bw import syndrome_decode_rows
+
+    gf = GF256()
+    k, n = 4, 12  # e = 4 with all shares present
+    S = 640
+    gold = GoldenCodec(k, n)
+    data = rng.integers(0, 256, size=(k, S)).astype(np.uint8)
+    cw = gold.encode_all(data).astype(np.uint8)
+    corrupt = cw.copy()
+    # Four disjoint column blocks, each corrupting a different row PAIR.
+    pairs = [(0, 5), (1, 6), (2, 7), (3, 8)]
+    for b, (r1, r2_) in enumerate(pairs):
+        cols = slice(b * 160, b * 160 + 160)
+        corrupt[r1, cols] ^= 0xA5
+        corrupt[r2_, cols] ^= 0x3C
+    rows = [np.ascontiguousarray(corrupt[i]) for i in range(n)]
+    out, _, corrected = syndrome_decode_rows(
+        gf, "cauchy", k, n, list(range(n)), rows
+    )
+    assert corrected
+    np.testing.assert_array_equal(np.stack(out), data)
