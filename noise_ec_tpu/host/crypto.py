@@ -22,6 +22,7 @@ from __future__ import annotations
 import hashlib
 import struct
 from dataclasses import dataclass
+from typing import Optional
 
 from cryptography.exceptions import InvalidSignature
 from cryptography.hazmat.primitives.asymmetric.ed25519 import (
@@ -40,12 +41,48 @@ __all__ = [
 
 
 class Blake2bPolicy:
-    """BLAKE2b-256 hash policy (noise/crypto/blake2b.New())."""
+    """BLAKE2b-256 hash policy (noise/crypto/blake2b.New()).
+
+    Prefers the native shim's streaming BLAKE2b (AVX512VL rotates in the
+    compression function — bit-identical to hashlib by RFC 7693, cross-
+    checked in tests/test_host_crypto.py) because whole-object sign and
+    verify hashes dominate the host node's large-object stream path;
+    hashlib is the always-available fallback.
+    """
 
     digest_size = 32
 
+    # Below this input size hashlib wins: the native path pays a ctypes
+    # context allocate/marshal/free per hash, which beats the ~10% faster
+    # compression function only when the payload amortizes it.
+    NATIVE_MIN_BYTES = 1 << 18
+
+    _native_factory = None  # resolved once; False = unavailable
+
+    def _hasher(self, approx_size: Optional[int] = None):
+        if approx_size is not None and approx_size < self.NATIVE_MIN_BYTES:
+            return hashlib.blake2b(digest_size=self.digest_size)
+        cls = type(self)
+        if cls._native_factory is None:
+            try:
+                from noise_ec_tpu.shim import native_blake2b
+
+                cls._native_factory = (
+                    native_blake2b if native_blake2b(1) else False
+                )
+            except Exception:  # noqa: BLE001 — any shim failure -> hashlib
+                cls._native_factory = False
+        if cls._native_factory:
+            try:
+                return cls._native_factory(self.digest_size)
+            except Exception:  # noqa: BLE001
+                pass
+        return hashlib.blake2b(digest_size=self.digest_size)
+
     def hash_bytes(self, data: bytes) -> bytes:
-        return hashlib.blake2b(data, digest_size=self.digest_size).digest()
+        h = self._hasher(len(data))
+        h.update(data)
+        return h.digest()
 
     def hash_parts(self, parts) -> bytes:
         """Hash the concatenation of ``parts`` without materializing it:
@@ -53,7 +90,10 @@ class Blake2bPolicy:
         streaming hash), but skips the join copy — the signing preimage
         is header + full message (serialize_message), so on large objects
         the join is a whole-object memcpy."""
-        h = hashlib.blake2b(digest_size=self.digest_size)
+        approx = None
+        if isinstance(parts, (tuple, list)):
+            approx = sum(len(p) for p in parts)
+        h = self._hasher(approx)
         for p in parts:
             h.update(p)
         return h.digest()

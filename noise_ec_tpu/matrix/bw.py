@@ -295,6 +295,38 @@ def _data_from_coeffs(
 # gather/scatter traffic exceeds the extra matmul width.
 _GATHER_CAP = 1 << 16
 
+# (field degree, kind, k, n, received numbers) -> (inv(G[basis]), A).
+# Geometry and arrival pattern recur per stream/bench (the reference's
+# geometry rides in every message and is stable per sender), and the k x k
+# inversion plus the A product are per-decode host algebra worth skipping.
+_PLAN_CACHE: dict[tuple, tuple[np.ndarray, Optional[np.ndarray]]] = {}
+
+
+def _decode_plan(
+    gf: GF, kind: str, k: int, n: int, nums: list[int], G: np.ndarray
+) -> tuple[np.ndarray, Optional[np.ndarray]]:
+    # The generator bytes are part of the key: callers may supply their own
+    # G (and fields of one degree can use different polynomials), and a
+    # plan inverted from a different matrix would decode to wrong bytes.
+    key = (
+        gf.degree, getattr(gf, "poly", None), kind, k, n, tuple(nums),
+        np.ascontiguousarray(G).tobytes(),
+    )
+    hit = _PLAN_CACHE.get(key)
+    if hit is None:
+        Gb_inv = gf_inv(gf, G[nums[:k]])
+        A = None
+        if len(nums) > k:
+            A = gf.matvec_stripes(
+                np.asarray(G[nums[k:]], dtype=np.int64),
+                np.asarray(Gb_inv, dtype=np.int64),
+            ).astype(gf.dtype)
+        if len(_PLAN_CACHE) > 512:
+            _PLAN_CACHE.clear()
+        _PLAN_CACHE[key] = (Gb_inv, A)
+        hit = (Gb_inv, A)
+    return hit
+
 
 def syndrome_decode_rows(
     gf: GF,
@@ -356,19 +388,12 @@ def syndrome_decode_rows(
         G = generator_matrix(gf, k, n, kind)
     e = (m - k) // 2
     r2 = m - k
-    Gb_inv = gf_inv(gf, G[nums[:k]])
-    A = None
+    Gb_inv, A = _decode_plan(gf, kind, k, n, nums, G)
     s = None
     # received-row index -> pending XOR deltas; column -> solved (k,) output
     corrections: dict[int, list] = {}
     overrides: dict[int, np.ndarray] = {}
     if r2:
-        A = (
-            gf.matvec_stripes(
-                np.asarray(G[nums[k:]], dtype=np.int64),
-                np.asarray(Gb_inv, dtype=np.int64),
-            )
-        ).astype(gf.dtype)
         s, counts = _syndrome(gf, A, rows, k, device=device)
         rem_mask = counts > e
         nrem = int(np.count_nonzero(rem_mask))

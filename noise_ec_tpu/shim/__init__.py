@@ -8,6 +8,8 @@ ctypes loader.
 
 from noise_ec_tpu.shim.binding import (
     CppReedSolomon,
+    NativeBlake2b,
+    native_blake2b,
     build_shim,
     gf_matmul_rows,
     gf_matmul_stripes,
@@ -18,6 +20,8 @@ from noise_ec_tpu.shim.binding import (
 
 __all__ = [
     "CppReedSolomon",
+    "NativeBlake2b",
+    "native_blake2b",
     "build_shim",
     "gf_matmul_rows",
     "gf_matmul_stripes",

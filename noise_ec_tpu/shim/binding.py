@@ -54,6 +54,12 @@ def _load() -> ctypes.CDLL:
     global _lib
     if _lib is None:
         lib = ctypes.CDLL(str(build_shim()))
+        if not hasattr(lib, "b2b_new"):
+            # Stale prebuilt .so from before the ABI grew (build_shim only
+            # runs make when the file is MISSING): rebuild in place —
+            # otherwise registering the missing symbol below would fail
+            # the load and silently disable EVERY native path.
+            lib = ctypes.CDLL(str(build_shim(force=True)))
         lib.rs_encoder_new.restype = ctypes.c_void_p
         lib.rs_encoder_new.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
         lib.rs_encoder_free.argtypes = [ctypes.c_void_p]
@@ -95,6 +101,17 @@ def _load() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_size_t,
         ]
+        lib.b2b_new.restype = ctypes.c_void_p
+        lib.b2b_new.argtypes = [ctypes.c_int]
+        lib.b2b_update.restype = ctypes.c_int
+        lib.b2b_update.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+        ]
+        lib.b2b_final.restype = ctypes.c_int
+        lib.b2b_final.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.b2b_copy.restype = ctypes.c_void_p
+        lib.b2b_copy.argtypes = [ctypes.c_void_p]
+        lib.b2b_free.argtypes = [ctypes.c_void_p]
         _lib = lib
     return _lib
 
@@ -224,6 +241,75 @@ def gf_scale_rows(consts: np.ndarray, D: np.ndarray) -> Optional[np.ndarray]:
     if rc != 0:
         raise RuntimeError(f"rs_scale_rows failed: {rc}")
     return buf
+
+
+class NativeBlake2b:
+    """Streaming unkeyed BLAKE2b on the shim (bit-identical to
+    hashlib.blake2b — RFC 7693; cross-checked in tests/test_host_crypto).
+
+    Exists because the host node's sign/verify hashes whole objects
+    (main.go:82-89, 219-223) and the shim's compression function uses the
+    AVX512VL rotate form. Use :func:`native_blake2b` to construct (returns
+    None when the shim is unavailable).
+    """
+
+    __slots__ = ("_lib", "_ctx", "digest_size")
+
+    def __init__(self, lib, digest_size: int):
+        self._lib = lib
+        self.digest_size = digest_size
+        self._ctx = lib.b2b_new(digest_size)
+        if not self._ctx:
+            raise ValueError(f"bad digest size {digest_size}")
+
+    def update(self, data) -> None:
+        n = len(data)
+        if n == 0:
+            return
+        if isinstance(data, bytes):
+            ptr = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p)
+        else:
+            try:  # writable buffers (bytearray, writable memoryview)
+                ptr = ctypes.cast(
+                    (ctypes.c_ubyte * n).from_buffer(data), ctypes.c_void_p
+                )
+            except TypeError:  # read-only non-bytes view: one copy
+                data = bytes(data)
+                ptr = ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p)
+                n = len(data)
+        rc = self._lib.b2b_update(self._ctx, ptr, n)
+        if rc != 0:
+            raise RuntimeError(f"b2b_update failed: {rc}")
+
+    def digest(self) -> bytes:
+        # Finalize a CLONE: hashlib semantics allow digest() mid-stream,
+        # repeated digest(), and update() afterwards; native finalization
+        # is destructive.
+        dup = self._lib.b2b_copy(self._ctx)
+        if not dup:
+            raise MemoryError("b2b_copy failed")
+        try:
+            out = ctypes.create_string_buffer(self.digest_size)
+            rc = self._lib.b2b_final(dup, out)
+            if rc != 0:
+                raise RuntimeError(f"b2b_final failed: {rc}")
+            return out.raw
+        finally:
+            self._lib.b2b_free(dup)
+
+    def __del__(self):
+        ctx = getattr(self, "_ctx", None)
+        if ctx:
+            self._lib.b2b_free(ctx)
+            self._ctx = None
+
+
+def native_blake2b(digest_size: int = 32) -> Optional[NativeBlake2b]:
+    """A fresh native streaming BLAKE2b, or None (caller uses hashlib)."""
+    lib = _fast_lib()
+    if lib is None:
+        return None
+    return NativeBlake2b(lib, digest_size)
 
 
 class CppReedSolomon:

@@ -589,3 +589,29 @@ def test_syndrome_decode_scattered_distinct_supports_per_column(rng):
     )
     assert corrected
     np.testing.assert_array_equal(np.stack(out), data)
+
+
+def test_decode_plan_cache_keyed_by_generator_matrix(rng):
+    """Two decodes with the SAME (kind, k, n, nums) but DIFFERENT
+    generator matrices must each use their own basis inverse — the plan
+    cache may not hand matrix A's inverse to matrix B's codewords."""
+    from noise_ec_tpu.matrix.bw import syndrome_decode_rows
+    from noise_ec_tpu.matrix.generators import generator_matrix
+
+    gf = GF256()
+    k, n = 4, 7
+    nums = [0, 2, 4, 5, 6]  # non-systematic basis: the inverse matters
+    data = rng.integers(0, 256, size=(k, 256)).astype(np.uint8)
+    G1 = generator_matrix(gf, k, n, "cauchy")
+    G2 = generator_matrix(gf, k, n, "vandermonde")
+    # Same kind string for both so only the G bytes distinguish the plans
+    # (clean decodes never touch the kind's GRS normalizers).
+    for G in (G1, G2, G1):  # alternate to force cache cross-talk if any
+        cw = gf.matvec_stripes(
+            np.asarray(G, dtype=np.int64), data.astype(np.int64)
+        ).astype(np.uint8)
+        rows = [np.ascontiguousarray(cw[i]) for i in nums]
+        out, _, _ = syndrome_decode_rows(
+            gf, "cauchy", k, n, nums, rows, G=G
+        )
+        np.testing.assert_array_equal(np.stack(out), data)

@@ -72,3 +72,27 @@ def test_peer_id_create_hashes_pubkey():
     pid = PeerID.create("tcp://h:1", kp.public_key)
     assert pid.node_id == hashlib.blake2b(kp.public_key, digest_size=32).digest()
     assert pid.public_key == kp.public_key
+
+
+def test_native_blake2b_hashlib_semantics():
+    """NativeBlake2b must match hashlib's object semantics: digest() is
+    non-destructive (mid-stream digests, repeated digests, update after
+    digest), and every digest equals hashlib's for the same prefix."""
+    import hashlib
+
+    import numpy as np
+    import pytest
+
+    from noise_ec_tpu.shim import native_blake2b
+
+    h = native_blake2b(32)
+    if h is None:
+        pytest.skip("native shim unavailable")
+    ref = hashlib.blake2b(digest_size=32)
+    rng = np.random.default_rng(7)
+    for n in (1, 100, 129, 5000):
+        part = bytes(rng.integers(0, 256, n, dtype=np.uint8))
+        h.update(part)
+        ref.update(part)
+        assert h.digest() == ref.digest()  # mid-stream digest
+        assert h.digest() == ref.digest()  # repeated digest
