@@ -111,6 +111,14 @@ class KcpSession:
     def write(self, data: bytes) -> None:
         if self.closed:
             raise ConnectionError("kcp session closed")
+        if self._fin_sn is not None:
+            # Writer already closed (start_close announced _fin_sn): the
+            # peer's _push guard drops any segment with sn >= _fin_sn
+            # unacked, so queued data would be silently lost and
+            # retransmitted until the close deadline. TCP
+            # shutdown(SHUT_WR) semantics: writing after closing the
+            # write side is an error (round-3 ADVICE finding 3).
+            raise ConnectionError("kcp write side already closed")
         if self._read_eof:
             # Half-closed: each write pushes the idle-close deadline out.
             self._half_close_deadline = time.monotonic() + self.LINGER

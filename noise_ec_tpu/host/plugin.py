@@ -183,6 +183,12 @@ class ShardPlugin:
         self.max_stream_chunks = self.DEFAULT_MAX_STREAM_CHUNKS
         self._stream_buf_bytes = 0  # sum of active reassembly buffers
         self._shim_cache: dict[tuple[int, int], object] = {}
+        # Novel-geometry rate limiter state (see _fec_receive) + the
+        # host-only fallback codec cache for rate-limited senders.
+        self._novel_geometry: OrderedDict[bytes, list] = OrderedDict()
+        self._novel_global: list = []
+        self._novel_lock = threading.Lock()
+        self._fec_host_cache: OrderedDict[tuple[int, int], FEC] = OrderedDict()
 
     # ---------------------------------------------------------------- codec
 
@@ -196,12 +202,88 @@ class ShardPlugin:
                 self._fec_cache.move_to_end((k, n))
                 return fec
         fec = FEC(k, n, backend=self.backend)  # build outside the lock
+        return self._cache_put_locked(self._fec_cache, (k, n), fec)
+
+    # Per-sender novel-geometry budget on the RECEIVE path: geometry rides
+    # in every message (main.go:73), and on the device backend the first
+    # use of a fresh (k, n) compiles kernels — seconds. Without a cap one
+    # hostile sender minting fresh geometries keeps a dispatch worker
+    # perpetually compiling (round-3 VERDICT weak #5). Within the window a
+    # sender gets this many novel geometries on the full backend; beyond
+    # it, decodes fall back to a host-only codec (numpy/shim — correct,
+    # no kernel compile) until a geometry recurs or the window rolls.
+    NOVEL_GEOMETRY_WINDOW_SECONDS = 60.0
+    NOVEL_GEOMETRY_PER_WINDOW = 8
+    # Aggregate cap across ALL senders per window: sender identities are
+    # cheap to mint, so a per-sender budget alone is bypassed by key
+    # rotation. Past this, every novel geometry decodes host-only.
+    NOVEL_GEOMETRY_GLOBAL_PER_WINDOW = 32
+
+    @staticmethod
+    def _sender_key(ctx: PluginContext) -> bytes:
+        try:
+            return bytes(ctx.client_public_key())
+        except Exception:  # noqa: BLE001 — identity-less test transports
+            return b""
+
+    def _cache_put_locked(self, cache, key, fec: FEC) -> FEC:
+        """LRU insert-or-get under self._fec_lock (shared by both codec
+        caches so the eviction policy cannot diverge)."""
         with self._fec_lock:
-            self._fec_cache.setdefault((k, n), fec)
-            self._fec_cache.move_to_end((k, n))
-            while len(self._fec_cache) > self.fec_cache_size:
-                self._fec_cache.popitem(last=False)
-            return self._fec_cache[(k, n)]
+            cache.setdefault(key, fec)
+            cache.move_to_end(key)
+            while len(cache) > self.fec_cache_size:
+                cache.popitem(last=False)
+            return cache[key]
+
+    def _fec_receive(self, k: int, n: int, ctx: PluginContext) -> FEC:
+        """Receive-path codec lookup with the novel-geometry rate caps
+        (per sender AND global). Cached geometries (the steady state:
+        senders reuse their geometry) bypass the limiter entirely."""
+        with self._fec_lock:
+            fec = self._fec_cache.get((k, n))
+            if fec is not None:
+                self._fec_cache.move_to_end((k, n))
+                return fec
+        if self.backend == "numpy":
+            return self._fec(k, n)  # no compile cost to protect
+        sender_key = self._sender_key(ctx)
+        now = time.monotonic()
+        cutoff = now - self.NOVEL_GEOMETRY_WINDOW_SECONDS
+        with self._novel_lock:
+            dq = self._novel_geometry.get(sender_key)
+            if dq is None:
+                dq = self._novel_geometry[sender_key] = []
+                # Bound the tracking table itself.
+                while len(self._novel_geometry) > 1024:
+                    self._novel_geometry.pop(
+                        next(iter(self._novel_geometry))
+                    )
+            else:
+                self._novel_geometry.move_to_end(sender_key)
+            while dq and dq[0] < cutoff:
+                dq.pop(0)
+            while self._novel_global and self._novel_global[0] < cutoff:
+                self._novel_global.pop(0)
+            limited = (
+                len(dq) >= self.NOVEL_GEOMETRY_PER_WINDOW
+                or len(self._novel_global)
+                >= self.NOVEL_GEOMETRY_GLOBAL_PER_WINDOW
+            )
+            if not limited:
+                dq.append(now)
+                self._novel_global.append(now)
+        if not limited:
+            return self._fec(k, n)
+        self.counters.add("geometry_rate_limited", 1)
+        with self._fec_lock:
+            fec = self._fec_host_cache.get((k, n))
+            if fec is not None:
+                self._fec_host_cache.move_to_end((k, n))
+                return fec
+        return self._cache_put_locked(
+            self._fec_host_cache, (k, n), FEC(k, n, backend="numpy")
+        )
 
     def prewarm(self, geometries=None, stripe_len: int = 64) -> None:
         """Build (and jit-warm) codecs for ``geometries`` before traffic.
@@ -398,7 +480,8 @@ class ShardPlugin:
         """
         import os
 
-        size = os.path.getsize(path)
+        stat0 = os.stat(path)
+        size = stat0.st_size
         if size == 0:
             raise ValueError("cannot stream an empty file")
         k, n, B, count = self._stream_plan(size, chunk_bytes, geometry)
@@ -422,9 +505,24 @@ class ShardPlugin:
                 for _ in range(count):
                     yield f.read(B)
 
-        return self._emit_stream(
+        sent = self._emit_stream(
             network, file_signature, k, n, B, count, size, chunks()
         )
+        # Two-pass hazard: pass 1 signed the file, pass 2 re-read it. If
+        # the file changed in between, every receiver reassembles bytes
+        # that can never verify — the sender must report failure, not
+        # success (round-3 ADVICE finding 2). size + mtime_ns catches
+        # every ordinary rewrite; a same-size same-mtime splice is below
+        # the filesystem's own change-detection granularity.
+        stat1 = os.stat(path)
+        if (stat1.st_size, stat1.st_mtime_ns) != (size, stat0.st_mtime_ns):
+            raise RuntimeError(
+                f"{path} changed while streaming (size {size} -> "
+                f"{stat1.st_size}, mtime {stat0.st_mtime_ns} -> "
+                f"{stat1.st_mtime_ns}): receivers got an unverifiable "
+                "object; re-send"
+            )
+        return sent
 
     def _stream_plan(
         self, length: int, chunk_bytes: int, geometry
@@ -564,7 +662,18 @@ class ShardPlugin:
         chunk already holds all n shares and the signature still fails —
         no future arrival can help.
         """
-        key = msg.file_signature.hex()
+        # Stream state is keyed by (signature, SENDER): verify binds the
+        # object to the transport sender's key (main.go:85 — the sender IS
+        # the encoder; shards are never relayed), so shards from another
+        # identity can never contribute to this object. Scoping the key
+        # (rather than pinning a signature-keyed stream to its first
+        # sender) means an interloper racing the first shard merely opens
+        # their own doomed stream instead of hijacking the real one — and
+        # it makes the reassembly buffer single-writer by construction
+        # (per-sender serialized dispatch), which is what lets the
+        # object-level verify hash the live buffer outside the lock.
+        sender_pk = self._sender_key(ctx)
+        key = f"{msg.file_signature.hex()}:{sender_pk.hex()}"
         if self._recently_completed(key):
             self.counters.add("late_shards", 1)
             return None
@@ -722,7 +831,7 @@ class ShardPlugin:
                 if delivered is not None:
                     return delivered
                 return self._repair_stream(ctx, msg, key, k, n, count)
-        fec = self._fec(k, n)
+        fec = self._fec_receive(k, n, ctx)
         try:
             with Timer(self.counters, "decode_s",
                        nbytes=sum(len(s.data) for s in snapshot)):
@@ -835,7 +944,7 @@ class ShardPlugin:
         the consistency check and Berlekamp-Welch correction), re-verify
         if anything changed, and raise CorruptionError only once every
         chunk has all n shares and the signature still fails."""
-        fec = self._fec(k, n)
+        fec = self._fec_receive(k, n, ctx)
         while True:
             changed_any = False
             for i in range(count):
@@ -964,7 +1073,7 @@ class ShardPlugin:
             return None
 
         # CASE C: enough distinct shares — decode + verify (main.go:72-99).
-        fec = self._fec(k, n)
+        fec = self._fec_receive(k, n, ctx)
         try:
             with Timer(self.counters, "decode_s",
                        nbytes=sum(len(s.data) for s in snapshot)):

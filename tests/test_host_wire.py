@@ -124,3 +124,36 @@ def test_shard_gostring_evaluates_back():
     s = Shard(file_signature=b"sig", shard_data=b"\x00\xffdata",
               shard_number=3, total_shards=7, minimum_needed_shards=5)
     assert eval(s.gostring(), {"Shard": Shard}) == s
+
+
+def test_json_text_strictness_matches_protobuf_rules():
+    """Round-4 review hardening: range/type/escape errors surface as
+    WireError, never silent truncation or a foreign exception type."""
+    import pytest
+
+    from noise_ec_tpu.host.wire import Shard, WireError
+
+    # uint64 overflow in text format
+    with pytest.raises(WireError):
+        Shard.from_text(f"shard_number: {1 << 64}")
+    # non-integral / non-numeric JSON values
+    with pytest.raises(WireError):
+        Shard.from_json('{"shardNumber": 3.7}')
+    with pytest.raises(WireError):
+        Shard.from_json('{"shardNumber": "abc"}')
+    with pytest.raises(WireError):
+        Shard.from_json('{"shardNumber": true}')
+    # integral float accepted (json_format behavior)
+    assert Shard.from_json('{"shardNumber": 3.0}').shard_number == 3
+    # URL-safe base64 accepted; garbage rejected
+    import base64
+
+    raw = bytes(range(250, 256)) * 3
+    url = base64.urlsafe_b64encode(raw).decode()
+    assert Shard.from_json(f'{{"shardData": "{url}"}}').shard_data == raw
+    with pytest.raises(WireError):
+        Shard.from_json('{"shardData": "!!not base64!!"}')
+    # bad escapes in text strings
+    for bad in (r'shard_data: "\8"', r'shard_data: "\777"'):
+        with pytest.raises(WireError):
+            Shard.from_text(bad)

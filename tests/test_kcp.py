@@ -311,3 +311,27 @@ def test_kcp_three_node_discovery_transitive():
     finally:
         for net in nets:
             net.close()
+
+
+def test_write_after_start_close_raises():
+    """After start_close() announces the FIN sequence number, further
+    writes must fail loudly (TCP shutdown(SHUT_WR) semantics): the peer
+    drops post-FIN segments unacked, so queued bytes would silently
+    vanish."""
+    import asyncio
+
+    from noise_ec_tpu.host.kcp import KcpSession
+
+    import pytest
+
+    async def run():
+        sent = []
+        a = KcpSession(7, None, lambda d, _: sent.append(d),
+                       asyncio.get_running_loop())
+        a.write(b"before close")
+        a.start_close()
+        with pytest.raises(ConnectionError):
+            a.write(b"after close")
+        a.close()
+
+    asyncio.run(run())

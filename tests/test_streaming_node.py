@@ -366,3 +366,49 @@ def test_stream_device_backend_loopback():
     nodes[0].plugins[0].stream_and_broadcast(nodes[0], data, chunk_bytes=1 << 16)
     assert [m for m, _ in inboxes[1]] == [data]
     assert not any(n.errors for n in nodes)
+
+
+def test_stream_state_scoped_per_sender():
+    """Stream reassembly is keyed by (signature, sender): an interloper
+    replaying shards under its own identity — even RACING the first shard
+    — merely opens a separate stream that can never verify (main.go:85
+    binds verify to the transport sender), while the true sender's object
+    completes untouched. This also keeps each reassembly buffer
+    single-writer (per-sender serialized dispatch)."""
+    _, nodes, inboxes = make_cluster(3)
+    sender, receiver, interloper = nodes
+    plugin = receiver.plugins[0]
+    rng = np.random.default_rng(11)
+    data = bytes(rng.integers(0, 256, 150_000).astype(np.uint8))
+    shards = _capture_stream_shards(sender, data, 1 << 16)
+    # Interloper races the very first shard for this signature...
+    plugin.receive(_Ctx(shards[0], interloper))
+    # ...and keeps injecting every third shard under its identity.
+    for i, s in enumerate(shards):
+        plugin.receive(_Ctx(s, sender))
+        if i % 3 == 0:
+            plugin.receive(_Ctx(s, interloper))
+    assert [m for m, _ in inboxes[1]] == [data]  # no hijack, one delivery
+
+
+def test_stream_file_change_between_passes_raises(tmp_path):
+    """stream_and_broadcast_file signs in pass 1 and chunks in pass 2; a
+    file modified in between must surface as an error on the sender, not
+    a silent success with an unverifiable object at every receiver."""
+    import os
+
+    _, nodes, _ = make_cluster(2)
+    sender = nodes[0]
+    plugin = sender.plugins[0]
+    path = tmp_path / "payload.bin"
+    path.write_bytes(b"a" * 200_000)
+    orig_emit = plugin._emit_stream
+
+    def emit_after_mutation(*args, **kwargs):
+        path.write_bytes(b"b" * 200_000)  # same size, new mtime
+        os.utime(path, ns=(1, 1))  # force a distinct mtime_ns deterministically
+        return orig_emit(*args, **kwargs)
+
+    plugin._emit_stream = emit_after_mutation
+    with pytest.raises(RuntimeError, match="changed while streaming"):
+        plugin.stream_and_broadcast_file(sender, str(path), chunk_bytes=1 << 16)

@@ -12,7 +12,7 @@ protobuf dependency, so the suite must keep collecting without it.)
 import numpy as np
 import pytest
 
-from noise_ec_tpu.host.wire import Shard
+from noise_ec_tpu.host.wire import Shard, WireError
 
 pytest.importorskip("google.protobuf")
 from google.protobuf import descriptor_pb2, descriptor_pool, message_factory  # noqa: E402
@@ -108,3 +108,110 @@ def test_unknown_fields_skipped_both_ways(ShardMsg):
     theirs = ShardMsg.FromString(buf)
     assert ours.shard_data == theirs.shard_data == b"data"
     assert ours.total_shards == theirs.total_shards == 6
+
+
+# -- JSON / text-format representations (shardpb_test.go:84-137) ------------
+
+
+@pytest.fixture(scope="module")
+def ShardMsgFull():
+    """Runtime protobuf Shard WITH the streaming extension fields, for
+    JSON/text cross-checks over the full schema."""
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "shard_interop_full.proto"
+    fdp.package = "erasurecode_full"
+    fdp.syntax = "proto3"
+    m = fdp.message_type.add()
+    m.name = "Shard"
+    T = descriptor_pb2.FieldDescriptorProto
+    fields = [
+        ("file_signature", T.TYPE_BYTES),
+        ("shard_data", T.TYPE_BYTES),
+        ("shard_number", T.TYPE_UINT64),
+        ("total_shards", T.TYPE_UINT64),
+        ("minimum_needed_shards", T.TYPE_UINT64),
+        ("stream_chunk_index", T.TYPE_UINT64),
+        ("stream_chunk_count", T.TYPE_UINT64),
+        ("stream_object_bytes", T.TYPE_UINT64),
+    ]
+    for num, (name, typ) in enumerate(fields, 1):
+        f = m.field.add()
+        f.name = name
+        f.number = num
+        f.type = typ
+        f.label = T.LABEL_OPTIONAL
+    pool = descriptor_pool.DescriptorPool()
+    pool.Add(fdp)
+    return message_factory.GetMessageClass(
+        pool.FindMessageTypeByName("erasurecode_full.Shard")
+    )
+
+
+def _sample_shards():
+    rng = np.random.default_rng(0xBEEF)
+    out = [Shard()]  # all defaults: empty JSON object, empty text
+    for _ in range(8):
+        out.append(Shard.populate(rng))
+    out.append(Shard(
+        file_signature=bytes(range(256)),  # every byte value -> escaping
+        shard_data=b'quote " backslash \\ nl \n tab \t nul \x00',
+        shard_number=(1 << 64) - 1,        # u64 max -> string in JSON
+        total_shards=6,
+        minimum_needed_shards=4,
+        stream_chunk_index=3,
+        stream_chunk_count=17,
+        stream_object_bytes=1 << 40,
+    ))
+    return out
+
+
+def test_json_round_trip_and_cross_runtime(ShardMsgFull):
+    from google.protobuf import json_format
+
+    for s in _sample_shards():
+        # own round trip
+        assert Shard.from_json(s.to_json()) == s
+        # google parses ours and produces an equal message
+        msg = ShardMsgFull()
+        json_format.Parse(s.to_json(), msg)
+        assert msg.SerializeToString(deterministic=True) == s.marshal()
+        # we parse google's output (uint64 emitted as strings there)
+        theirs = json_format.MessageToJson(msg, indent=None)
+        assert Shard.from_json(theirs) == s
+        # dict forms agree key-for-key (jsonpb camelCase, defaults omitted)
+        import json as _json
+
+        assert _json.loads(theirs or "{}") == s.to_json_dict()
+
+
+def test_text_round_trip_and_cross_runtime(ShardMsgFull):
+    from google.protobuf import text_format
+
+    for s in _sample_shards():
+        assert Shard.from_text(s.to_text()) == s
+        assert Shard.from_text(s.to_compact_text()) == s
+        # google parses our text
+        msg = ShardMsgFull()
+        text_format.Parse(s.to_text(), msg)
+        assert msg.SerializeToString(deterministic=True) == s.marshal()
+        # we parse google's text (both multi-line and one-line forms)
+        assert Shard.from_text(text_format.MessageToString(msg)) == s
+        assert Shard.from_text(
+            text_format.MessageToString(msg, as_one_line=True)
+        ) == s
+
+
+def test_json_rejects_garbage():
+    with pytest.raises(WireError):
+        Shard.from_json('{"noSuchField": 1}')
+    with pytest.raises(WireError):
+        Shard.from_json('{"shardNumber": "18446744073709551616"}')  # 2^64
+    with pytest.raises(Exception):
+        Shard.from_json('[1, 2, 3]')
+
+
+def test_text_rejects_garbage():
+    for bad in ("bogus_field: 1", 'shard_data: unquoted',
+                'shard_data: "unterminated', "shard_number: x"):
+        with pytest.raises(WireError):
+            Shard.from_text(bad)
