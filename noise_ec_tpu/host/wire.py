@@ -283,16 +283,17 @@ class Shard:
             if kind == "bytes":
                 if not isinstance(val, str):
                     raise WireError(f"{key}: bytes field must be base64 string")
-                # proto3 JSON parsers must accept BOTH the standard and
-                # URL-safe alphabets; strict validation either way (a
-                # lenient decode silently drops foreign characters).
+                # proto3 JSON parsers must accept the standard AND
+                # URL-safe alphabets, padded or not (json_format does the
+                # same normalize-then-decode); ONE strict decode after
+                # normalization so foreign characters raise instead of
+                # being silently dropped.
+                b64 = val.replace("-", "+").replace("_", "/")
+                b64 += "=" * (-len(b64) % 4)
                 try:
-                    kwargs[attr] = base64.b64decode(val, validate=True)
-                except Exception:
-                    try:
-                        kwargs[attr] = base64.urlsafe_b64decode(val)
-                    except Exception as exc:
-                        raise WireError(f"{key}: invalid base64") from exc
+                    kwargs[attr] = base64.b64decode(b64, validate=True)
+                except Exception as exc:
+                    raise WireError(f"{key}: invalid base64") from exc
             else:
                 if isinstance(val, bool):
                     raise WireError(f"{key}: uint64 field got a bool")

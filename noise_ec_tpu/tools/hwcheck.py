@@ -146,6 +146,57 @@ def _checks():
         np.array_equal(dev.matmul_stripes(Gp[k:], D), np.asarray(gold_p.encode(D))),
     )
 
+    # --- device syndrome route (round 4): the [A | I] augmented matmul
+    # behind FEC(bw_route="device") — the error-correcting decode's bad-
+    # column scan on the device codec, vs the host formulation.
+    from noise_ec_tpu.matrix.bw import _syndrome
+
+    m = k + r
+    D = data_for("gf256", k, 65536)
+    cw = np.concatenate([D, np.asarray(gold.encode(D))], axis=0)
+    cw[1] ^= 0xA5  # whole-share corruption
+    # basis = the k data rows, so A = G[extra] @ inv(I) = the parity rows.
+    A = np.ascontiguousarray(G[k:], dtype=np.uint8)
+    rows = [np.ascontiguousarray(cw[i]) for i in range(m)]
+    host_s, host_counts = _syndrome(dev.gf, A, rows, k)
+    dev_s, dev_counts = dev.syndrome_stripes(A, np.stack(rows))
+    yield (
+        "device syndrome gf256 RS(10,4) corrupt share",
+        np.array_equal(dev_s, host_s) and np.array_equal(dev_counts, host_counts),
+    )
+
+    # --- full corrupted-share decode with the device route end to end.
+    from noise_ec_tpu.codec.fec import FEC, Share
+
+    fec_dev = FEC(k, k + r, backend="device", bw_route="device")
+    payload = data_for("gf256", k, 8192)
+    shares = fec_dev.encode_shares(payload.tobytes())
+    bad = [
+        Share(s.number, bytes(b ^ 0x3C for b in s.data))
+        if s.number == 2 else s
+        for s in shares
+    ]
+    yield (
+        "device-route BW decode gf256 RS(10,4) corrupt share",
+        fec_dev.decode(bad) == payload.tobytes(),
+    )
+
+    # --- MXU int8 bit-plane encoder (round 4; the recorded wide-code
+    # formulation, BASELINE.md "MXU route measured").
+    from noise_ec_tpu.ops.mxu_gf2 import MxuCodec
+
+    mx = MxuCodec(dev.gf)
+    for mk, mr_ in ((10, 4), (50, 20)):
+        Gm = generator_matrix(dev.gf, mk, mk + mr_, "cauchy")
+        Dm = data_for("gf256", mk, 6000)  # non-tile-aligned: pad path
+        yield (
+            f"mxu int8 encode gf256 RS({mk},{mr_})",
+            np.array_equal(
+                mx.encode_stripes(Gm[mk:], Dm),
+                np.asarray(golden("gf256", mk, mk + mr_).encode(Dm)),
+            ),
+        )
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)

@@ -157,3 +157,23 @@ def test_json_text_strictness_matches_protobuf_rules():
     for bad in (r'shard_data: "\8"', r'shard_data: "\777"'):
         with pytest.raises(WireError):
             Shard.from_text(bad)
+
+
+def test_json_base64_alphabets_and_padding():
+    """proto3 JSON conformance: standard and URL-safe alphabets, padded or
+    unpadded, all accepted; whitespace/foreign characters rejected loudly
+    (never silently dropped)."""
+    import base64
+
+    import pytest
+
+    from noise_ec_tpu.host.wire import Shard, WireError
+
+    raw = bytes([0xFB, 0xEF, 0xBE, 1, 2, 3, 0xFF])  # exercises -_ vs +/
+    std = base64.b64encode(raw).decode()
+    url = base64.urlsafe_b64encode(raw).decode()
+    for enc in (std, url, std.rstrip("="), url.rstrip("=")):
+        assert Shard.from_json(f'{{"shardData": "{enc}"}}').shard_data == raw
+    for bad in ("YWJ j", "YQ=A", "a\nb="):
+        with pytest.raises(WireError):
+            Shard.from_json({"shardData": bad})  # dict form: raw newline ok
