@@ -19,6 +19,7 @@ matching the reference's SignaturePolicy/HashPolicy injection points
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import struct
 from dataclasses import dataclass
@@ -99,6 +100,22 @@ class Blake2bPolicy:
         return h.digest()
 
 
+@functools.lru_cache(maxsize=1024)
+def _parsed_public_key(public_key: bytes) -> Ed25519PublicKey:
+    """Parsed peer key, LRU-cached: reconstructing the object per verify
+    cost ~35 us/message and a node talks to a small stable peer set."""
+    return Ed25519PublicKey.from_public_bytes(public_key)
+
+
+@functools.lru_cache(maxsize=8)
+def _parsed_private_key(seed: bytes) -> Ed25519PrivateKey:
+    """Parsed signing key. The cache is TINY on purpose: it holds only
+    the process's own live identities (which the KeyPair already keeps in
+    memory), so discarded temporary seeds evict almost immediately
+    instead of being pinned for the process lifetime."""
+    return Ed25519PrivateKey.from_private_bytes(seed)
+
+
 class Ed25519Policy:
     """Ed25519 signature policy (noise/crypto/ed25519.New())."""
 
@@ -107,13 +124,13 @@ class Ed25519Policy:
     signature_size = 64
 
     def sign(self, private_key: bytes, message: bytes) -> bytes:
-        return Ed25519PrivateKey.from_private_bytes(private_key).sign(message)
+        return _parsed_private_key(bytes(private_key)).sign(message)
 
     def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
         if len(public_key) != self.public_key_size:
             return False
         try:
-            Ed25519PublicKey.from_public_bytes(public_key).verify(signature, message)
+            _parsed_public_key(bytes(public_key)).verify(signature, message)
             return True
         except (InvalidSignature, ValueError):
             return False
