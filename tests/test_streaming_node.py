@@ -412,3 +412,24 @@ def test_stream_file_change_between_passes_raises(tmp_path):
     plugin._emit_stream = emit_after_mutation
     with pytest.raises(RuntimeError, match="changed while streaming"):
         plugin.stream_and_broadcast_file(sender, str(path), chunk_bytes=1 << 16)
+
+
+def test_stream_chaos_soak_faulty_link():
+    """Multi-chunk stream over a seeded faulty link (drop + duplicate +
+    reorder): the direct-assembly fast path must interplay correctly with
+    the decode fallback (out-of-order pools) and per-chunk parity repair —
+    the object still delivers exactly once, bit-exact."""
+    faults = FaultInjector(seed=0xC4A05, drop=0.08, duplicate=0.1,
+                           reorder=0.3)
+    _, nodes, inboxes = make_cluster(
+        2, faults=faults, minimum_needed_shards=4, total_shards=8,
+    )
+    sender = nodes[0]
+    rng = np.random.default_rng(77)
+    for trial in range(3):
+        data = bytes(rng.integers(0, 256, 300_000 + trial).astype(np.uint8))
+        sender.plugins[0].stream_and_broadcast(
+            sender, data, chunk_bytes=1 << 16
+        )
+        assert [m for m, _ in inboxes[1][-1:]] == [data], f"trial {trial}"
+    assert len(inboxes[1]) == 3
