@@ -177,3 +177,42 @@ def test_json_base64_alphabets_and_padding():
     for bad in ("YWJ j", "YQ=A", "a\nb="):
         with pytest.raises(WireError):
             Shard.from_json({"shardData": bad})  # dict form: raw newline ok
+
+
+def test_json_text_parsers_never_crash_on_fuzz():
+    """from_json / from_text on malformed input must raise WireError (or
+    json's own decode error for invalid JSON) — never segfault, hang, or
+    escape with an unrelated exception type. Mirrors the binary
+    unmarshal's fuzz no-crash contract (shardpb_test.go:45-53)."""
+    import json as _json
+
+    import numpy as np
+
+    from noise_ec_tpu.host.wire import Shard, WireError
+
+    rng = np.random.default_rng(0xF022)
+    # Structured-ish corpus: mutate valid outputs byte-wise.
+    base = Shard(file_signature=b"\x01\x02\x03", shard_data=b"payload",
+                 shard_number=5, total_shards=9, minimum_needed_shards=4)
+    corpus = [base.to_json(), base.to_text(), base.to_compact_text()]
+    for seed_doc in corpus:
+        raw = seed_doc.encode()
+        for _ in range(300):
+            buf = bytearray(raw)
+            for _ in range(rng.integers(1, 4)):
+                buf[rng.integers(0, len(buf))] = rng.integers(0, 256)
+            for parse in (Shard.from_json, Shard.from_text):
+                try:
+                    parse(buf.decode("utf-8", "replace"))
+                except (WireError, _json.JSONDecodeError):
+                    pass
+    # Pure random garbage.
+    for _ in range(200):
+        garbage = bytes(rng.integers(0, 256, rng.integers(0, 80),
+                                     dtype=np.uint8))
+        text = garbage.decode("utf-8", "replace")
+        for parse in (Shard.from_json, Shard.from_text):
+            try:
+                parse(text)
+            except (WireError, _json.JSONDecodeError):
+                pass
