@@ -184,11 +184,7 @@ def test_json_text_parsers_never_crash_on_fuzz():
     json's own decode error for invalid JSON) — never segfault, hang, or
     escape with an unrelated exception type. Mirrors the binary
     unmarshal's fuzz no-crash contract (shardpb_test.go:45-53)."""
-    import json as _json
-
-    import numpy as np
-
-    from noise_ec_tpu.host.wire import Shard, WireError
+    import json
 
     rng = np.random.default_rng(0xF022)
     # Structured-ish corpus: mutate valid outputs byte-wise.
@@ -204,7 +200,7 @@ def test_json_text_parsers_never_crash_on_fuzz():
             for parse in (Shard.from_json, Shard.from_text):
                 try:
                     parse(buf.decode("utf-8", "replace"))
-                except (WireError, _json.JSONDecodeError):
+                except (WireError, json.JSONDecodeError):
                     pass
     # Pure random garbage.
     for _ in range(200):
@@ -214,5 +210,5 @@ def test_json_text_parsers_never_crash_on_fuzz():
         for parse in (Shard.from_json, Shard.from_text):
             try:
                 parse(text)
-            except (WireError, _json.JSONDecodeError):
+            except (WireError, json.JSONDecodeError):
                 pass
