@@ -114,6 +114,174 @@ def main() -> None:
 
     stats = {"backend": backend, "kernel": dev.kernel, "data_bytes": data_bytes}
 
+    # Host-path sections run FIRST, before the TPU kernel sections:
+    # the box has one CPU and the tunnel daemon's TPU-era activity
+    # adds ~10-40% load tails to host timing (measured: identical code
+    # read 6.3 ms before TPU work and 10.5 ms after on one run).
+    # --- config D: decode under corruption (the infectious Decode
+    # guarantee, SURVEY.md §2.3 D1 — error CORRECTION, not just erasure
+    # fill). 1 MiB shards, all n shares present, RS(10,4):
+    # (a) whole-share: one share entirely wrong (the BW decoder's
+    #     vectorized fast path — one interpolation + re-encode);
+    # (b) scattered: corrupt bytes sprinkled across two shares
+    #     (per-column Berlekamp-Welch on the affected columns).
+    try:
+        from noise_ec_tpu.codec.fec import FEC, Share
+
+        # bw_route="host" (the default): shares arrive as host bytes, so
+        # the syndrome decode's matmuls run on the native shim —
+        # re-shipping 14 MiB through the axon tunnel per decode costs
+        # seconds (memory: ~1 MB/s effective bulk). bw_route="device"
+        # exists for device-resident stripes (ops/dispatch.py
+        # syndrome_stripes) and is covered by tests + hwcheck.
+        fec = FEC(k, k + r, backend="numpy")
+        S1 = 1 << 20
+        stripes = rng.integers(0, 256, size=(k, S1)).astype(np.uint8)
+        shares = fec.encode_shares(stripes.tobytes())
+        cases: dict[str, list] = {}
+        for name in ("whole_share", "scattered"):
+            bad = [Share(s.number, s.data) for s in shares]
+            if name == "whole_share":
+                flip = np.frombuffer(bad[1].data, np.uint8) ^ 0xA5
+                bad[1] = Share(1, flip.tobytes())
+            else:
+                for j, pos_seed in ((1, 11), (2, 13)):
+                    arr = np.frombuffer(bad[j].data, np.uint8).copy()
+                    pos = np.random.default_rng(pos_seed).integers(0, S1, 32)
+                    arr[pos] ^= 0x5A
+                    bad[j] = Share(j, arr.tobytes())
+            got = fec.decode(bad)  # warm + correctness
+            check_smoke(got == stripes.tobytes(),
+                        f"corrupted-decode ({name}) wrong bytes")
+            cases[name] = bad
+        # INTERLEAVED timing: the single-core box has load epochs lasting
+        # seconds; alternating the two cases inside one loop exposes both
+        # to the same epochs (their p50 DIFFERENCE reflects code cost,
+        # not which one ran during a busy second), and the short sleeps
+        # stretch the 9 rounds across ~2 s so the p50 spans epochs
+        # instead of living entirely inside one.
+        samples: dict[str, list] = {name: [] for name in cases}
+        for round_i in range(9):
+            for name, bad in cases.items():
+                t0 = time.perf_counter()
+                fec.decode(bad)
+                samples[name].append(time.perf_counter() - t0)
+            if round_i < 8:
+                time.sleep(0.25)
+        for name, ts in samples.items():
+            stats[f"decode_corrupt_{name}_p50_ms"] = round(
+                sorted(ts)[4] * 1e3, 2
+            )
+            # min = the code's cost; p50 additionally carries whatever
+            # the box was doing that second.
+            stats[f"decode_corrupt_{name}_best_ms"] = round(
+                min(ts) * 1e3, 2
+            )
+    except SmokeMismatch:
+        raise  # deterministic correctness failure: fail the run
+    except Exception as exc:  # noqa: BLE001 — secondary stat only
+        stats["decode_corrupt_error"] = str(exc)[:80]
+
+    # --- host-runtime story: full node round trip on the in-process
+    # loopback peer set (sign -> shard -> proto marshal -> dispatch ->
+    # reassemble -> Ed25519 verify), the reference's actual workload
+    # (main.go:175-198 send side, main.go:52-107 receive side).
+    try:
+        from noise_ec_tpu.host.plugin import ShardPlugin
+        from noise_ec_tpu.host.transport import LoopbackHub, LoopbackNetwork, format_address
+
+        # numpy codec backend: this stat isolates the HOST runtime overhead
+        # (signing, proto, mempool, dispatch). Small single messages over
+        # the axon tunnel are RTT-bound (~5 msg/s at 64 KiB), which says
+        # nothing about either the host code or the kernels — the device
+        # throughput stats above cover the codec.
+        hub = LoopbackHub()
+        recv_count = [0]
+        nodes = []
+        for i in range(2):
+            node = LoopbackNetwork(hub, format_address("tcp", "localhost", 3000 + i))
+            node.add_plugin(ShardPlugin(
+                backend="numpy",
+                on_message=lambda m, s: recv_count.__setitem__(0, recv_count[0] + 1),
+            ))
+            nodes.append(node)
+        # Distinct payloads: identical bytes share a file signature and the
+        # receiver's replay protection would (correctly) drop the repeats.
+        base = rng.integers(0, 256, size=64 << 10).astype(np.uint8)  # 64 KiB
+        n_msgs = 20
+        payloads = []
+        for i in range(n_msgs + 1):
+            b = base.copy()
+            b[:8] = np.frombuffer(i.to_bytes(8, "little"), dtype=np.uint8)
+            payloads.append(bytes(b))
+        send = nodes[0].plugins[0]
+        send.shard_and_broadcast(nodes[0], payloads[0])  # warm (jit, pools)
+        t0 = time.perf_counter()
+        for p in payloads[1:]:
+            send.shard_and_broadcast(nodes[0], p)
+        t_host = (time.perf_counter() - t0) / n_msgs
+        if recv_count[0] != n_msgs + 1:
+            # Deterministic correctness failure: fail the bench run like
+            # the kernel smokes (not a stat, not retried).
+            raise SmokeMismatch(f"host roundtrip lost messages: {recv_count}")
+        payload = payloads[0]
+        stats["host_node_roundtrip_msgs_per_s"] = round(1.0 / t_host, 1)
+        stats["host_node_roundtrip_mb_per_s"] = round(len(payload) / t_host / 1e6, 1)
+
+        # --- large-object streaming: one 64 MiB object node-to-node as
+        # 4 MiB erasure-coded chunks (sign once -> chunked encode ->
+        # per-shard wire messages -> per-chunk reassembly -> one verify),
+        # the round-3 end-to-end fast path. Two backends: the host-only
+        # tier (numpy plugin + native C++ shim encode) and, on TPU, the
+        # device codec through the pipelined StreamingEncoder.
+        big = bytes(rng.integers(0, 256, size=64 << 20, dtype=np.uint8))
+        for backend in ("numpy",) + (("device",) if on_tpu else ()):
+            got = []
+            # Fresh hub: exactly two nodes see the stream (the small-message
+            # nodes above must not multiply the fan-out).
+            hub2 = LoopbackHub()
+            node_a = LoopbackNetwork(hub2, format_address("tcp", "localhost", 3100))
+            node_b = LoopbackNetwork(hub2, format_address("tcp", "localhost", 3101))
+            node_a.add_plugin(ShardPlugin(
+                backend=backend, minimum_needed_shards=10, total_shards=14,
+            ))
+            node_b.add_plugin(ShardPlugin(
+                backend=backend, minimum_needed_shards=10, total_shards=14,
+                # Zero-copy delivery (ownership of the reassembly buffer
+                # transfers) — the Go reference hands its decode []byte to
+                # the consumer without a copy too (main.go:92).
+                on_object=lambda m, s: got.append(len(m)),
+            ))
+            send_plugin = node_a.plugins[0]
+            # Warm with a FULL-SIZE pass (shim/kernels/pools and the
+            # allocator's high-water mark), then the timed trials below;
+            # payloads are distinct because identical bytes dedup by
+            # signature.
+            send_plugin.stream_and_broadcast(node_a, big[2:] + b"\x00\x00",
+                                             chunk_bytes=4 << 20)
+            t_big = float("inf")
+            # Best of 3 (distinct payloads — identical bytes dedup by
+            # signature): single-core host timing has ~10% load tails and
+            # this stat carries a hard round target.
+            for trial in range(3):
+                payload = big if trial == 0 else big[trial:] + bytes([trial]) * trial
+                got.clear()
+                t0 = time.perf_counter()
+                send_plugin.stream_and_broadcast(node_a, payload,
+                                                 chunk_bytes=4 << 20)
+                t_big = min(t_big, time.perf_counter() - t0)
+                if got != [len(payload)]:
+                    raise SmokeMismatch(f"stream bench lost the object: {got}")
+            suffix = "" if backend == "numpy" else "_device"
+            stats[f"host_node_large_object{suffix}_mb_per_s"] = round(
+                len(big) / t_big / 1e6, 1
+            )
+    except SmokeMismatch:
+        raise  # deterministic correctness failure: fail the run
+    except Exception as exc:  # noqa: BLE001 — secondary stat only
+        stats["host_node_error"] = str(exc)[:80]
+
+
     if dev.kernel == "pallas":
         # Correctness smoke BEFORE any timing: the bench must not be the
         # first time a shape runs on real hardware — one small fused encode
@@ -279,145 +447,6 @@ def main() -> None:
             dev.matmul_stripes(G[k:], shards)
         t_enc = (time.perf_counter() - t0) / 3
         gbps = data_bytes / t_enc / 1e9
-
-    # --- config D: decode under corruption (the infectious Decode
-    # guarantee, SURVEY.md §2.3 D1 — error CORRECTION, not just erasure
-    # fill). 1 MiB shards, all n shares present, RS(10,4):
-    # (a) whole-share: one share entirely wrong (the BW decoder's
-    #     vectorized fast path — one interpolation + re-encode);
-    # (b) scattered: corrupt bytes sprinkled across two shares
-    #     (per-column Berlekamp-Welch on the affected columns).
-    try:
-        from noise_ec_tpu.codec.fec import FEC, Share
-
-        # bw_route="host" (the default): shares arrive as host bytes, so
-        # the syndrome decode's matmuls run on the native shim —
-        # re-shipping 14 MiB through the axon tunnel per decode costs
-        # seconds (memory: ~1 MB/s effective bulk). bw_route="device"
-        # exists for device-resident stripes (ops/dispatch.py
-        # syndrome_stripes) and is covered by tests + hwcheck.
-        fec = FEC(k, k + r, backend="numpy")
-        S1 = 1 << 20
-        stripes = rng.integers(0, 256, size=(k, S1)).astype(np.uint8)
-        shares = fec.encode_shares(stripes.tobytes())
-        for name in ("whole_share", "scattered"):
-            bad = [Share(s.number, s.data) for s in shares]
-            if name == "whole_share":
-                flip = np.frombuffer(bad[1].data, np.uint8) ^ 0xA5
-                bad[1] = Share(1, flip.tobytes())
-            else:
-                for j, pos_seed in ((1, 11), (2, 13)):
-                    arr = np.frombuffer(bad[j].data, np.uint8).copy()
-                    pos = np.random.default_rng(pos_seed).integers(0, S1, 32)
-                    arr[pos] ^= 0x5A
-                    bad[j] = Share(j, arr.tobytes())
-            got = fec.decode(bad)  # warm + correctness
-            check_smoke(got == stripes.tobytes(),
-                        f"corrupted-decode ({name}) wrong bytes")
-            ts = []
-            for _ in range(5):  # p50 of 5: host timing is jittery in-bench
-                t0 = time.perf_counter()
-                fec.decode(bad)
-                ts.append(time.perf_counter() - t0)
-            stats[f"decode_corrupt_{name}_p50_ms"] = round(
-                sorted(ts)[2] * 1e3, 2
-            )
-    except Exception as exc:  # noqa: BLE001 — secondary stat only
-        stats["decode_corrupt_error"] = str(exc)[:80]
-
-    # --- host-runtime story: full node round trip on the in-process
-    # loopback peer set (sign -> shard -> proto marshal -> dispatch ->
-    # reassemble -> Ed25519 verify), the reference's actual workload
-    # (main.go:175-198 send side, main.go:52-107 receive side).
-    try:
-        from noise_ec_tpu.host.plugin import ShardPlugin
-        from noise_ec_tpu.host.transport import LoopbackHub, LoopbackNetwork, format_address
-
-        # numpy codec backend: this stat isolates the HOST runtime overhead
-        # (signing, proto, mempool, dispatch). Small single messages over
-        # the axon tunnel are RTT-bound (~5 msg/s at 64 KiB), which says
-        # nothing about either the host code or the kernels — the device
-        # throughput stats above cover the codec.
-        hub = LoopbackHub()
-        recv_count = [0]
-        nodes = []
-        for i in range(2):
-            node = LoopbackNetwork(hub, format_address("tcp", "localhost", 3000 + i))
-            node.add_plugin(ShardPlugin(
-                backend="numpy",
-                on_message=lambda m, s: recv_count.__setitem__(0, recv_count[0] + 1),
-            ))
-            nodes.append(node)
-        # Distinct payloads: identical bytes share a file signature and the
-        # receiver's replay protection would (correctly) drop the repeats.
-        base = rng.integers(0, 256, size=64 << 10).astype(np.uint8)  # 64 KiB
-        n_msgs = 20
-        payloads = []
-        for i in range(n_msgs + 1):
-            b = base.copy()
-            b[:8] = np.frombuffer(i.to_bytes(8, "little"), dtype=np.uint8)
-            payloads.append(bytes(b))
-        send = nodes[0].plugins[0]
-        send.shard_and_broadcast(nodes[0], payloads[0])  # warm (jit, pools)
-        t0 = time.perf_counter()
-        for p in payloads[1:]:
-            send.shard_and_broadcast(nodes[0], p)
-        t_host = (time.perf_counter() - t0) / n_msgs
-        if recv_count[0] != n_msgs + 1:
-            # Deterministic correctness failure: fail the bench run like
-            # the kernel smokes (not a stat, not retried).
-            raise SmokeMismatch(f"host roundtrip lost messages: {recv_count}")
-        payload = payloads[0]
-        stats["host_node_roundtrip_msgs_per_s"] = round(1.0 / t_host, 1)
-        stats["host_node_roundtrip_mb_per_s"] = round(len(payload) / t_host / 1e6, 1)
-
-        # --- large-object streaming: one 64 MiB object node-to-node as
-        # 4 MiB erasure-coded chunks (sign once -> chunked encode ->
-        # per-shard wire messages -> per-chunk reassembly -> one verify),
-        # the round-3 end-to-end fast path. Two backends: the host-only
-        # tier (numpy plugin + native C++ shim encode) and, on TPU, the
-        # device codec through the pipelined StreamingEncoder.
-        big = bytes(rng.integers(0, 256, size=64 << 20, dtype=np.uint8))
-        for backend in ("numpy",) + (("device",) if on_tpu else ()):
-            got = []
-            # Fresh hub: exactly two nodes see the stream (the small-message
-            # nodes above must not multiply the fan-out).
-            hub2 = LoopbackHub()
-            node_a = LoopbackNetwork(hub2, format_address("tcp", "localhost", 3100))
-            node_b = LoopbackNetwork(hub2, format_address("tcp", "localhost", 3101))
-            node_a.add_plugin(ShardPlugin(
-                backend=backend, minimum_needed_shards=10, total_shards=14,
-            ))
-            node_b.add_plugin(ShardPlugin(
-                backend=backend, minimum_needed_shards=10, total_shards=14,
-                # Zero-copy delivery (ownership of the reassembly buffer
-                # transfers) — the Go reference hands its decode []byte to
-                # the consumer without a copy too (main.go:92).
-                on_object=lambda m, s: got.append(len(m)),
-            ))
-            send_plugin = node_a.plugins[0]
-            # Warm with a FULL-SIZE pass (shim/kernels/pools and the
-            # allocator's high-water mark), then best of two timed passes;
-            # payloads are distinct because identical bytes dedup by
-            # signature.
-            send_plugin.stream_and_broadcast(node_a, big[2:] + b"\x00\x00",
-                                             chunk_bytes=4 << 20)
-            t_big = float("inf")
-            for trial in range(2):
-                payload = big if trial == 0 else big[1:] + b"\x00"
-                got.clear()
-                t0 = time.perf_counter()
-                send_plugin.stream_and_broadcast(node_a, payload,
-                                                 chunk_bytes=4 << 20)
-                t_big = min(t_big, time.perf_counter() - t0)
-                if got != [len(payload)]:
-                    raise SmokeMismatch(f"stream bench lost the object: {got}")
-            suffix = "" if backend == "numpy" else "_device"
-            stats[f"host_node_large_object{suffix}_mb_per_s"] = round(
-                len(big) / t_big / 1e6, 1
-            )
-    except Exception as exc:  # noqa: BLE001 — secondary stat only
-        stats["host_node_error"] = str(exc)[:80]
 
     stats["encode_s"] = t_enc
     print(

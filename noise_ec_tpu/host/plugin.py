@@ -599,20 +599,55 @@ class ShardPlugin:
             return
         import numpy as np
 
+        from noise_ec_tpu.shim import gf_matmul_rows
+
         shim = self._stream_shim(k, n)
         stride = B // k
+        parity_matrix = None
         for index, chunk in enumerate(chunks):
+            if shim is not None and len(chunk) == B:
+                # Full chunk: the k data shards ARE consecutive slices of
+                # the caller's payload — emit them as zero-copy views and
+                # compute only the parity, straight from those slices via
+                # the pointer-based shim matmul (no staging copy of the
+                # data into a codeword buffer; a 64 MiB object used to
+                # pay a full extra memcpy here). Parity rows get their
+                # OWN buffer per chunk (never reused): callers may hold a
+                # Shard past the broadcast call. NOTE the retention shape
+                # of the zero-copy data shards: their memoryviews pin the
+                # caller's WHOLE payload object, not one codeword buffer —
+                # fine for the normal lifecycle (broadcast marshals before
+                # the generator resumes, and the caller holds the payload
+                # for the duration of the call anyway), but a consumer
+                # that retains data Shards beyond the stream call keeps
+                # the full object alive with them.
+                if parity_matrix is None:
+                    from noise_ec_tpu.gf.field import GF256
+                    from noise_ec_tpu.matrix.generators import generator_matrix
+
+                    # Same Cauchy construction the shim's encoder bakes in
+                    # (byte-identical by tests/test_shim.py).
+                    parity_matrix = generator_matrix(GF256(), k, n, "cauchy")[k:]
+                view = memoryview(chunk)
+                rows = [
+                    np.frombuffer(view[j * stride : (j + 1) * stride],
+                                  dtype=np.uint8)
+                    for j in range(k)
+                ]
+                parity = gf_matmul_rows(parity_matrix, rows, stride)
+                if parity is not None:
+                    yield index, (
+                        [Share(j, view[j * stride : (j + 1) * stride])
+                         for j in range(k)]
+                        + [Share(k + i, parity[i].data)
+                           for i in range(n - k)]
+                    )
+                    continue
             if shim is not None:
-                # Native C++ codec (byte-identical to the golden matrices,
-                # tests/test_shim.py): zero-copy parity fill in one buffer.
-                # Each chunk gets its OWN buffer: the yielded Share rows
-                # are memoryviews into it, and callers may legitimately
-                # hold the Shard past the broadcast call (capture hooks,
-                # deferred transports) — a reused scratch would alias
-                # every held shard to the last chunk's bytes. np.empty,
-                # not zeros: data rows are fully overwritten and parity
-                # rows are outputs; only a short tail chunk needs the
-                # explicit pad.
+                # Tail chunk (or pointer-matmul unavailable): stage into a
+                # codeword buffer with explicit zero pad and use the
+                # in-place encode. np.empty: data rows are fully written
+                # below and parity rows are outputs.
                 buf = np.empty((n, stride), dtype=np.uint8)
                 flat = buf[:k].reshape(-1)
                 m = len(chunk)
