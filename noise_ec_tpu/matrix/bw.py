@@ -517,7 +517,15 @@ def syndrome_decode_rows(
     systematic = kind != "vandermonde_raw" and np.array_equal(
         np.asarray(G[:k]), np.eye(k, dtype=np.asarray(G).dtype)
     )
-    if systematic and all(j in pos_of for j in range(k)):
+    # The zero-copy passthrough requires every data share to sit in the
+    # BASIS (the first k received rows), not merely to be present: the
+    # clean-column argument proves error-free BASIS rows only (an error
+    # in a basis row forces counts > e), while an extra-block row can be
+    # wrong at a column whose count is still <= e — emitting such a data
+    # row untouched would return corrupt bytes inside the decoding
+    # radius. Data shares in the extra block take the general path, which
+    # decodes from the (error-free-at-clean-columns) corrected basis.
+    if systematic and all(pos_of.get(j, k) < k for j in range(k)):
         data_rows: list[np.ndarray] = []
         touched: list[bool] = []
         for j in range(k):

@@ -50,16 +50,44 @@ def shim_available() -> bool:
 _lib: Optional[ctypes.CDLL] = None
 
 
+def _reload_fresh(stale: ctypes.CDLL, path) -> ctypes.CDLL:
+    """Reopen ``path`` bypassing the dlopen pathname cache.
+
+    glibc dedups dlopen by pathname, so CDLL(path) after a rebuild hands
+    back the SAME stale handle. Drop our reference via dlclose first; if
+    the handle is pinned (some other refcount), load a temp copy instead.
+    """
+    try:
+        import _ctypes
+
+        _ctypes.dlclose(stale._handle)
+        fresh = ctypes.CDLL(str(path))
+        if hasattr(fresh, "b2b_new"):
+            return fresh
+    except Exception:  # noqa: BLE001 — fall through to the temp copy
+        pass
+    import shutil
+    import tempfile
+
+    tmp = tempfile.NamedTemporaryFile(
+        prefix="librs_shim_", suffix=".so", delete=False
+    )
+    tmp.close()
+    shutil.copyfile(path, tmp.name)
+    return ctypes.CDLL(tmp.name)
+
+
 def _load() -> ctypes.CDLL:
     global _lib
     if _lib is None:
         lib = ctypes.CDLL(str(build_shim()))
         if not hasattr(lib, "b2b_new"):
             # Stale prebuilt .so from before the ABI grew (build_shim only
-            # runs make when the file is MISSING): rebuild in place —
-            # otherwise registering the missing symbol below would fail
-            # the load and silently disable EVERY native path.
-            lib = ctypes.CDLL(str(build_shim(force=True)))
+            # runs make when the file is MISSING): rebuild, then reopen
+            # past the dlopen pathname cache — otherwise registering the
+            # missing symbol below would fail the load and silently
+            # disable EVERY native path.
+            lib = _reload_fresh(lib, build_shim(force=True))
         lib.rs_encoder_new.restype = ctypes.c_void_p
         lib.rs_encoder_new.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
         lib.rs_encoder_free.argtypes = [ctypes.c_void_p]

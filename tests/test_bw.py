@@ -615,3 +615,29 @@ def test_decode_plan_cache_keyed_by_generator_matrix(rng):
             gf, "cauchy", k, n, nums, rows, G=G
         )
         np.testing.assert_array_equal(np.stack(out), data)
+
+
+def test_syndrome_decode_unsorted_nums_data_share_in_extra_block(rng):
+    """Regression (round-4 holistic review): with UNSORTED share numbers a
+    data share can sit in the extra (non-basis) block; a corruption there
+    leaves the column's syndrome count <= e, and the old fast path emitted
+    the corrupt row zero-copy. Within the radius the decode must correct."""
+    from noise_ec_tpu.matrix.bw import syndrome_decode_rows
+
+    gf = GF256()
+    k, n, S = 3, 6, 512
+    gold = GoldenCodec(k, n)
+    data = rng.integers(0, 256, size=(k, S)).astype(np.uint8)
+    cw = gold.encode_all(data)
+    nums = [3, 4, 0, 1, 5, 2]  # data share 2 lands in the extra block
+    rows = [np.ascontiguousarray(cw[i]) for i in nums]
+    rows[5] = rows[5].copy()
+    rows[5][7] ^= 0x21  # one corrupted byte in data share 2; e = 1
+    out, touched, _ = syndrome_decode_rows(gf, "cauchy", k, n, nums, rows)
+    np.testing.assert_array_equal(np.stack(out), data)
+    # And the all-shares-sorted equivalent still takes the zero-copy path.
+    rows_sorted = [np.ascontiguousarray(cw[i]) for i in range(n)]
+    out2, touched2, corrected2 = syndrome_decode_rows(
+        gf, "cauchy", k, n, list(range(n)), rows_sorted
+    )
+    assert touched2 == [False] * k and not corrected2

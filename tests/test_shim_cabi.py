@@ -75,3 +75,39 @@ def test_c_consumer_links_and_round_trips(tmp_path):
                          timeout=60, text=True)
     assert "c-abi round-trip: OK" in out.stdout
     assert "gf256" in out.stdout  # version string identifies the field
+
+
+def test_reload_fresh_bypasses_dlopen_cache(tmp_path):
+    """Round-4 regression: glibc caches dlopen by pathname, so recovering
+    from a stale prebuilt .so must NOT just re-CDLL the same path.
+    Build v1 of a tiny library without the probe symbol, load it, rebuild
+    v2 WITH the symbol at the same path, and assert _reload_fresh hands
+    back a handle that sees it."""
+    import ctypes
+    import shutil
+    import subprocess
+
+    cc = shutil.which("cc") or shutil.which("gcc")
+    if cc is None:
+        pytest.skip("no C compiler")
+    src = tmp_path / "v.c"
+    so = tmp_path / "libv.so"
+    src.write_text("int rs_probe_old(void) { return 1; }\n")
+    subprocess.run([cc, "-shared", "-fPIC", "-o", str(so), str(src)],
+                   check=True, capture_output=True, timeout=120)
+    stale = ctypes.CDLL(str(so))
+    assert not hasattr(stale, "b2b_new")
+    src.write_text(
+        "int rs_probe_old(void) { return 1; }\n"
+        "int b2b_new(void) { return 42; }\n"
+    )
+    subprocess.run([cc, "-shared", "-fPIC", "-o", str(so), str(src)],
+                   check=True, capture_output=True, timeout=120)
+    # Plain re-CDLL of the same path demonstrates the cache problem the
+    # helper exists for (same handle, still missing the symbol) on glibc;
+    # on platforms that don't dedup this is vacuous and that's fine.
+    from noise_ec_tpu.shim.binding import _reload_fresh
+
+    fresh = _reload_fresh(stale, so)
+    assert hasattr(fresh, "b2b_new")
+    assert fresh.b2b_new() == 42
