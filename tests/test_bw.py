@@ -641,3 +641,38 @@ def test_syndrome_decode_unsorted_nums_data_share_in_extra_block(rng):
         gf, "cauchy", k, n, list(range(n)), rows_sorted
     )
     assert touched2 == [False] * k and not corrected2
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_syndrome_decode_property_random_order_and_corruption(seed):
+    """Property sweep over the syndrome decoder's whole input space: random
+    geometry, random SUBSET of shares in RANDOM ORDER (data shares may
+    land anywhere, including the extra block), random per-column
+    corruption within the radius e = floor((m-k)/2) — the decode must be
+    exact every time. Pins the round-4 unsorted-nums regression class."""
+    from noise_ec_tpu.matrix.bw import syndrome_decode_rows
+
+    rng = np.random.default_rng(seed + 0xA11)
+    gf = GF256()
+    k = int(rng.integers(2, 7))
+    extra = int(rng.integers(2, 7))
+    n = k + int(rng.integers(extra, extra + 3))
+    m = k + extra
+    S = int(rng.integers(16, 200))
+    gold = GoldenCodec(k, n)
+    data = rng.integers(0, 256, size=(k, S)).astype(np.uint8)
+    cw = gold.encode_all(data).astype(np.int64)
+    nums = rng.permutation(n)[:m].tolist()  # random subset, random order
+    received = cw[nums].copy()
+    e = (m - k) // 2
+    if e:
+        for col in range(S):
+            t = int(rng.integers(0, e + 1))
+            for row in rng.permutation(m)[:t]:
+                received[row, col] ^= int(rng.integers(1, 256))
+    out = syndrome_decode_rows(
+        gf, "cauchy", k, n, nums,
+        [np.ascontiguousarray(received[i].astype(np.uint8)) for i in range(m)],
+    )
+    assert out is not None, (k, n, m, nums)
+    np.testing.assert_array_equal(np.stack(out[0]), data)
