@@ -55,4 +55,29 @@ for b in range(B):
     want = np.asarray(g.encode(np.ascontiguousarray(words[b]).view(np.uint8)))
     got = np.ascontiguousarray(parity[b]).view(np.uint8)
     np.testing.assert_array_equal(got, want)
+
+# Decode side across the SAME cross-host mesh (round 4): the
+# error-correcting decode's bad-column scan is one augmented
+# [G_parity | I] matmul (matrix/bw.py); shard the received codewords over
+# the global batch axis, corrupt one share of one object, and the nonzero
+# syndrome must localize to it on every host.
+data_u8 = np.stack(
+    [np.ascontiguousarray(words[b]).view(np.uint8) for b in range(B)]
+)
+full = np.concatenate(
+    [data_u8, np.ascontiguousarray(parity).view(np.uint8).reshape(B, r, -1)],
+    axis=1,
+)
+full[1, 2] ^= 0x5A  # object 1, data share 2, every column
+aug = np.concatenate([bc.G[k:], np.eye(r, dtype=bc.G.dtype)], axis=1)
+mesh2 = multihost.global_mesh(("batch", "row"), (8, 1))
+syn = bc.make_sharded_matmul(mesh2, aug)
+gfull = multihost.replicate_to_global(
+    np.concatenate([full] * 4, axis=0), mesh2  # 8 objects: one per device
+)
+s_out = multihost.fetch_to_every_host(syn(gfull))
+bad_objects = np.nonzero(s_out.any(axis=(1, 2)))[0]
+np.testing.assert_array_equal(bad_objects, [1, 3, 5, 7])  # the corrupt copies
+assert not s_out[0].any() and s_out[1].all(axis=0).any()
+
 print(f"MULTIHOST-OK proc={proc_id} checksum={int(parity.sum())}", flush=True)
