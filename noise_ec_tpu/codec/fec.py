@@ -16,9 +16,9 @@ Contract reproduced from the reference's call sites (SURVEY.md §2.3 D1;
 - ``decode(shares)`` needs >= required distinct share numbers and performs
   error detection/correction when extra shares are present (infectious runs
   Berlekamp-Welch; so do we, per byte column — matrix/bw.py — for the MDS
-  GRS constructions; par1 falls back to the golden consistent-subset
-  search, which has the same unique-decoding radius for shard-level
-  corruption);
+  GRS constructions; par1 corrects through support-enumeration syndrome
+  decoding with the golden consistent-subset search kept only as its
+  fallback);
 - ``rebuild(shares, output)`` regenerates the missing shares (erasure-only).
 """
 
@@ -31,7 +31,11 @@ import numpy as np
 
 from noise_ec_tpu.codec.rs import ReedSolomon
 from noise_ec_tpu.golden.codec import GoldenCodec, NotEnoughShardsError, TooManyErrorsError
-from noise_ec_tpu.matrix.bw import grs_normalizers, syndrome_decode_rows
+from noise_ec_tpu.matrix.bw import (
+    grs_normalizers,
+    syndrome_decode_rows,
+    syndrome_decode_rows_any,
+)
 from noise_ec_tpu.matrix.linalg import gf_inv
 
 __all__ = ["FEC", "Share", "NotEnoughShardsError", "TooManyErrorsError"]
@@ -248,6 +252,24 @@ class FEC:
         if fast is not None:
             self.stats["fast_decodes"] += 1
             return np.ascontiguousarray(fast).tobytes()
+        # Non-MDS (par1): support-enumeration syndrome decode — the same
+        # agreement guarantee as the consistent-subset search (>= m - e
+        # received rows per column) in polynomial time; the exponential
+        # subset search remains only as the fallback for columns no small
+        # support explains (or a singular first-k basis).
+        res = syndrome_decode_rows_any(
+            self._golden.gf, self._golden.G, self.k, nums,
+            [dedup[i] for i in nums],
+        )
+        if res is not None:
+            rows, touched, corrected = res
+            self.stats["bw_decodes" if corrected else "fast_decodes"] += 1
+            return b"".join(
+                dedup_raw[j]
+                if not touched[j]
+                else memoryview(np.ascontiguousarray(rows[j]).view(np.uint8))
+                for j in range(self.k)
+            )
         pairs = [(i, dedup[i]) for i in nums]
         self.stats["subset_decodes"] += 1
         data = self._golden.decode_shares(pairs)  # (k, S) symbol rows

@@ -258,6 +258,53 @@ def _independent_rows(gf: GF, B: np.ndarray) -> Optional[list[int]]:
     return chosen
 
 
+def _solve_support_gathered(
+    gf: GF,
+    A: np.ndarray,
+    r2: int,
+    k: int,
+    T,
+    scols: np.ndarray,
+    cols: np.ndarray,
+) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    """Solve and verify error support ``T`` over gathered syndrome columns.
+
+    ``scols`` is the (r2, nbad) gathered syndrome; ``cols`` indexes the
+    still-unresolved subset to work on. Builds the signature matrix B_T
+    (A-columns for basis rows, unit vectors for extra rows), solves z from
+    |T| independent syndrome rows, verifies the remaining rows. Returns
+    (ok_mask over ``cols``, z) — or None when B_T is rank-deficient (its
+    reachable syndromes are covered by a strict subset of T). Shared by
+    the MDS decoder's shared-support rounds and the generic
+    support-enumeration decoder so the two stay in lockstep.
+    """
+    size = len(T)
+    B = np.zeros((r2, size), dtype=gf.dtype)
+    for ci, trow in enumerate(T):
+        if trow < k:
+            B[:, ci] = A[:, trow]
+        else:
+            B[trow - k, ci] = 1
+    P = _independent_rows(gf, B)
+    if P is None:
+        return None
+    W = gf_inv(gf, B[P])
+    z = _matmul_rows(
+        gf, W, [np.ascontiguousarray(scols[p][cols]) for p in P]
+    )
+    Q = [i for i in range(r2) if i not in set(P)]
+    if Q:
+        _, c2 = _syndrome(
+            gf, B[Q],
+            list(z) + [np.ascontiguousarray(scols[q][cols]) for q in Q],
+            size, want_s=False,
+        )
+        ok = c2 == 0
+    else:
+        ok = np.ones(len(cols), dtype=bool)
+    return ok, z
+
+
 def _column_error_support(
     gf: GF, kind: str, k: int, n: int, nums: list[int], colvals: np.ndarray
 ) -> Optional[frozenset]:
@@ -414,6 +461,25 @@ def syndrome_decode_rows(
                     break  # shared-support model exhausted -> per-column
                 T = new_T
                 t = len(T)
+                if nrem <= _GATHER_CAP:
+                    remaining = np.flatnonzero(rem_mask)
+                    scols = np.ascontiguousarray(s[:, remaining])
+                    solved = _solve_support_gathered(
+                        gf, A, r2, k, T, scols, np.arange(remaining.size)
+                    )
+                    if solved is None:
+                        break
+                    ok, z = solved
+                    if not ok.any():
+                        break
+                    okcols = remaining[ok]
+                    for ci, trow in enumerate(T):
+                        corrections.setdefault(trow, []).append(
+                            ("sparse", okcols, z[ci][ok].astype(gf.dtype))
+                        )
+                    rem_mask[okcols] = False
+                    nrem -= int(okcols.size)
+                    continue
                 B = np.zeros((r2, t), dtype=gf.dtype)
                 for ci, trow in enumerate(T):
                     if trow < k:
@@ -425,55 +491,33 @@ def syndrome_decode_rows(
                     break
                 W = gf_inv(gf, B[P])
                 Q = [i for i in range(r2) if i not in set(P)]
-                if nrem <= _GATHER_CAP:
-                    remaining = np.flatnonzero(rem_mask)
-                    scols = np.ascontiguousarray(s[:, remaining])
-                    z = _matmul_rows(gf, W, [scols[p] for p in P])
-                    if Q:
-                        _, c2 = _syndrome(
-                            gf, B[Q], list(z) + [scols[q] for q in Q], t,
-                            want_s=False,
-                        )
-                        ok = c2 == 0
-                    else:
-                        ok = np.ones(remaining.size, dtype=bool)
-                    if not ok.any():
-                        break
-                    okcols = remaining[ok]
-                    for ci, trow in enumerate(T):
-                        corrections.setdefault(trow, []).append(
-                            ("sparse", okcols, z[ci][ok].astype(gf.dtype))
-                        )
-                    rem_mask[okcols] = False
-                    nrem -= int(okcols.size)
+                # Full-width pass: index materialization over millions
+                # of bad columns (whole-share corruption makes every
+                # column bad) costs more than operating on the masks.
+                z = _matmul_rows(gf, W, [s[p] for p in P], device=device)
+                if Q:
+                    _, c2 = _syndrome(
+                        gf, B[Q], list(z) + [s[q] for q in Q], t,
+                        want_s=False, device=device,
+                    )
+                    apply_mask = rem_mask & (c2 == 0)
                 else:
-                    # Full-width pass: index materialization over millions
-                    # of bad columns (whole-share corruption makes every
-                    # column bad) costs more than operating on the masks.
-                    z = _matmul_rows(gf, W, [s[p] for p in P], device=device)
-                    if Q:
-                        _, c2 = _syndrome(
-                            gf, B[Q], list(z) + [s[q] for q in Q], t,
-                            want_s=False, device=device,
-                        )
-                        apply_mask = rem_mask & (c2 == 0)
-                    else:
-                        apply_mask = rem_mask.copy()
-                    napply = int(np.count_nonzero(apply_mask))
-                    if napply == 0:
-                        break
-                    for ci, trow in enumerate(T):
-                        delta = (
-                            z[ci].astype(gf.dtype, copy=False)
-                            if napply == S
-                            else np.where(apply_mask, z[ci], 0).astype(gf.dtype)
-                        )
-                        corrections.setdefault(trow, []).append(("full", delta))
-                    if napply == nrem:
-                        nrem = 0
-                    else:
-                        rem_mask &= ~apply_mask
-                        nrem -= napply
+                    apply_mask = rem_mask.copy()
+                napply = int(np.count_nonzero(apply_mask))
+                if napply == 0:
+                    break
+                for ci, trow in enumerate(T):
+                    delta = (
+                        z[ci].astype(gf.dtype, copy=False)
+                        if napply == S
+                        else np.where(apply_mask, z[ci], 0).astype(gf.dtype)
+                    )
+                    corrections.setdefault(trow, []).append(("full", delta))
+                if napply == nrem:
+                    nrem = 0
+                else:
+                    rem_mask &= ~apply_mask
+                    nrem -= napply
             # Columns no shared support explains: full per-column solves.
             if nrem:
                 N = grs_normalizers(gf, kind, k, n)
@@ -489,6 +533,39 @@ def syndrome_decode_rows(
                         return None
                     overrides[int(col)] = _data_from_coeffs(gf, kind, k, n, f)
 
+    systematic = kind != "vandermonde_raw" and np.array_equal(
+        np.asarray(G[:k]), np.eye(k, dtype=np.asarray(G).dtype)
+    )
+    return _emit_data_rows(
+        gf, k, nums, rows, corrections, overrides, Gb_inv, systematic,
+        device=device,
+    )
+
+
+def _emit_data_rows(
+    gf: GF,
+    k: int,
+    nums: list[int],
+    rows: list,
+    corrections: dict,
+    overrides: dict,
+    Gb_inv: np.ndarray,
+    systematic: bool,
+    *,
+    device=None,
+) -> tuple[list[np.ndarray], list[bool], bool]:
+    """Assemble the k output rows from received rows + pending fixes.
+
+    Shared by the MDS and generic syndrome decoders. The zero-copy
+    passthrough requires every data share to sit in the BASIS (the first
+    k received rows), not merely to be present: the clean-column argument
+    proves error-free BASIS rows only (an error in a basis row forces
+    counts > e), while an extra-block row can be wrong at a column whose
+    count is still <= e — emitting such a data row untouched would return
+    corrupt bytes inside the decoding radius. Data shares in the extra
+    block take the general path, which decodes from the
+    (error-free-at-clean-columns) corrected basis.
+    """
     ov_cols = ov_vals = None
     if overrides:
         ov_cols = np.fromiter(overrides.keys(), dtype=np.int64)
@@ -514,17 +591,6 @@ def syndrome_decode_rows(
     pos_of: dict[int, int] = {}
     for i, num in enumerate(nums):
         pos_of.setdefault(num, i)
-    systematic = kind != "vandermonde_raw" and np.array_equal(
-        np.asarray(G[:k]), np.eye(k, dtype=np.asarray(G).dtype)
-    )
-    # The zero-copy passthrough requires every data share to sit in the
-    # BASIS (the first k received rows), not merely to be present: the
-    # clean-column argument proves error-free BASIS rows only (an error
-    # in a basis row forces counts > e), while an extra-block row can be
-    # wrong at a column whose count is still <= e — emitting such a data
-    # row untouched would return corrupt bytes inside the decoding
-    # radius. Data shares in the extra block take the general path, which
-    # decodes from the (error-free-at-clean-columns) corrected basis.
     if systematic and all(pos_of.get(j, k) < k for j in range(k)):
         data_rows: list[np.ndarray] = []
         touched: list[bool] = []
@@ -545,6 +611,101 @@ def syndrome_decode_rows(
     if ov_cols is not None:
         data[:, ov_cols] = ov_vals
     return list(data), [True] * k, bool(corrections or overrides)
+
+
+def syndrome_decode_rows_any(
+    gf: GF,
+    G: np.ndarray,
+    k: int,
+    nums: list[int],
+    rows: list,
+    *,
+    max_support: Optional[int] = None,
+    device=None,
+) -> Optional[tuple[list[np.ndarray], list[bool], bool]]:
+    """Support-enumeration syndrome decode for ANY linear code.
+
+    The MDS decoder (:func:`syndrome_decode_rows`) discovers error
+    supports with a per-column Berlekamp-Welch solve, which needs the GRS
+    structure. Non-MDS constructions (par1 — the reason this exists) get
+    the same syndrome machinery with the support found by ENUMERATION:
+    for each candidate error-row set T with \\|T\\| <= ``max_support``,
+    solve ``B_T z = s`` from independent syndrome rows and verify the
+    rest — polynomial (C(m, max_support) small solves over the bad
+    columns) where the previous consistent-subset search was exponential
+    in k.
+
+    Guarantee matches the subset search it replaces, not unique decoding:
+    the returned word agrees with >= m - e received rows per column
+    (e = floor((m-k)/2)); a non-MDS code may admit several such words and
+    this picks one, exactly as the subset search did. Returns None when a
+    bad column has no explanation within ``max_support`` errors (or the
+    first-k basis is singular) — the caller falls back to the subset
+    search. ``max_support`` defaults to min(e, 2), covering the radius of
+    every geometry with up to 5 redundant shares.
+    """
+    import itertools
+
+    m = len(rows)
+    if m < k or len(nums) != m:
+        raise ValueError(f"need >= {k} rows with matching nums, got {m}")
+    S = rows[0].size
+    if any(r_.size != S for r_ in rows):
+        raise ValueError("stripe lengths differ")
+    nums = [int(x) for x in nums]
+    e = (m - k) // 2
+    r2 = m - k
+    if max_support is None:
+        max_support = min(e, 2)
+    try:
+        Gb_inv = gf_inv(gf, np.asarray(G)[nums[:k]])
+    except np.linalg.LinAlgError:
+        return None  # singular basis (possible off-MDS): caller falls back
+    corrections: dict[int, list] = {}
+    if r2:
+        A = gf.matvec_stripes(
+            np.asarray(np.asarray(G)[nums[k:]], dtype=np.int64),
+            np.asarray(Gb_inv, dtype=np.int64),
+        ).astype(gf.dtype)
+        s, counts = _syndrome(gf, A, rows, k, device=device)
+        bad_idx = np.flatnonzero(counts > e)
+        if bad_idx.size:
+            if e == 0:
+                return None
+            scols = np.ascontiguousarray(s[:, bad_idx])
+            unresolved = np.ones(bad_idx.size, dtype=bool)
+            for size in range(1, max_support + 1):
+                if not unresolved.any():
+                    break
+                for T in itertools.combinations(range(m), size):
+                    if not unresolved.any():
+                        break
+                    cols = np.flatnonzero(unresolved)
+                    solved = _solve_support_gathered(
+                        gf, A, r2, k, T, scols, cols
+                    )
+                    if solved is None:
+                        # rank-deficient support: its reachable syndromes
+                        # are covered by a strict subset already tried.
+                        continue
+                    ok, z = solved
+                    if not ok.any():
+                        continue
+                    okcols = bad_idx[cols[ok]]
+                    for ci, trow in enumerate(T):
+                        corrections.setdefault(trow, []).append(
+                            ("sparse", okcols, z[ci][ok].astype(gf.dtype))
+                        )
+                    unresolved[cols[ok]] = False
+            if unresolved.any():
+                return None
+    systematic = np.array_equal(
+        np.asarray(G)[:k], np.eye(k, dtype=np.asarray(G).dtype)
+    )
+    return _emit_data_rows(
+        gf, k, nums, rows, corrections, {}, Gb_inv, systematic,
+        device=device,
+    )
 
 
 def bw_decode_stripes(
