@@ -14,4 +14,9 @@ from noise_ec_tpu.matrix.generators import (  # noqa: F401
     vandermonde_systematic,
 )
 from noise_ec_tpu.matrix.linalg import gf_inv, gf_solve, reconstruction_matrix  # noqa: F401
-from noise_ec_tpu.matrix.bw import bw_decode_stripes, grs_normalizers  # noqa: F401
+from noise_ec_tpu.matrix.bw import (  # noqa: F401
+    bw_decode_stripes,
+    grs_normalizers,
+    syndrome_decode_rows,
+    syndrome_decode_rows_any,
+)
