@@ -730,3 +730,23 @@ def test_syndrome_decode_property_random_order_and_corruption(seed):
     )
     assert out is not None, (k, n, m, nums)
     np.testing.assert_array_equal(np.stack(out[0]), data)
+
+
+def test_syndrome_decode_any_gf65536(rng):
+    """The generic support-enumeration decoder is field-agnostic: par1
+    over GF(2^16) corrects a corrupt share through the NumPy syndrome
+    fallback (no shim for the wide field)."""
+    from noise_ec_tpu.matrix.bw import syndrome_decode_rows_any
+
+    gf = GF65536()
+    k, n, S = 3, 7, 96
+    gold = GoldenCodec(k, n, field="gf65536", matrix="par1")
+    data = rng.integers(0, 1 << 16, size=(k, S)).astype(np.uint16)
+    cw = gold.encode_all(data)
+    rows = [np.ascontiguousarray(cw[i]) for i in range(n)]
+    rows[2] = rows[2] ^ 0x0F0F
+    res = syndrome_decode_rows_any(gf, gold.G, k, list(range(n)), rows)
+    assert res is not None
+    out, _, corrected = res
+    assert corrected
+    np.testing.assert_array_equal(np.stack(out), data)

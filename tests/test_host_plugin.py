@@ -618,3 +618,45 @@ def test_geometry_flood_global_budget_resists_identity_rotation():
     assert len(delivered) == n_objects  # every object still decodes
     assert plugin.counters.get("geometry_rate_limited") >= 6
     assert len(plugin._fec_cache) <= plugin.NOVEL_GEOMETRY_GLOBAL_PER_WINDOW + 1
+
+
+def test_geometry_rate_limit_window_refills(monkeypatch):
+    """After the rate window rolls past, a sender's novel-geometry budget
+    refills and fresh geometries go back to the full backend."""
+    import time as _time
+
+    from noise_ec_tpu.host.crypto import KeyPair, PeerID
+
+    plugin = ShardPlugin(backend="device")
+    keys = KeyPair.from_seed(bytes([7]) * 32)
+    peer = PeerID.create("tcp://localhost:7100", keys.public_key)
+
+    class Ctx:
+        def message(self):
+            return None
+
+        def sender(self):
+            return peer
+
+        def client_public_key(self):
+            return peer.public_key
+
+    now = [1000.0]
+    monkeypatch.setattr(
+        "noise_ec_tpu.host.plugin.time",
+        type("T", (), {"monotonic": staticmethod(lambda: now[0]),
+                       "time": _time.time, "sleep": _time.sleep}),
+    )
+    ctx = Ctx()
+    # Exhaust the per-sender budget with fresh geometries.
+    for i in range(plugin.NOVEL_GEOMETRY_PER_WINDOW):
+        plugin._fec_receive(2, 3 + i, ctx)
+    assert plugin.counters.get("geometry_rate_limited") == 0
+    limited = plugin._fec_receive(2, 100, ctx)
+    assert plugin.counters.get("geometry_rate_limited") == 1
+    assert limited._rs.backend == "numpy"  # host-only fallback codec
+    # Window rolls: the budget refills, fresh geometry gets the backend.
+    now[0] += plugin.NOVEL_GEOMETRY_WINDOW_SECONDS + 1
+    refreshed = plugin._fec_receive(2, 101, ctx)
+    assert plugin.counters.get("geometry_rate_limited") == 1
+    assert refreshed._rs.backend == plugin.backend
