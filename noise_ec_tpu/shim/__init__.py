@@ -11,6 +11,7 @@ from noise_ec_tpu.shim.binding import (
     NativeBlake2b,
     native_blake2b,
     build_shim,
+    gf_decode1_fused,
     gf_matmul_rows,
     gf_matmul_stripes,
     gf_scale_rows,
@@ -23,6 +24,7 @@ __all__ = [
     "NativeBlake2b",
     "native_blake2b",
     "build_shim",
+    "gf_decode1_fused",
     "gf_matmul_rows",
     "gf_matmul_stripes",
     "gf_scale_rows",

@@ -62,7 +62,7 @@ def _reload_fresh(stale: ctypes.CDLL, path) -> ctypes.CDLL:
 
         _ctypes.dlclose(stale._handle)
         fresh = ctypes.CDLL(str(path))
-        if hasattr(fresh, "b2b_new"):
+        if hasattr(fresh, "rs_decode1_fused"):
             return fresh
     except Exception:  # noqa: BLE001 — fall through to the temp copy
         pass
@@ -81,7 +81,7 @@ def _load() -> ctypes.CDLL:
     global _lib
     if _lib is None:
         lib = ctypes.CDLL(str(build_shim()))
-        if not hasattr(lib, "b2b_new"):
+        if not hasattr(lib, "rs_decode1_fused"):
             # Stale prebuilt .so from before the ABI grew (build_shim only
             # runs make when the file is MISSING): rebuild, then reopen
             # past the dlopen pathname cache — otherwise registering the
@@ -127,6 +127,14 @@ def _load() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
             ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_size_t,
+        ]
+        lib.rs_decode1_fused.restype = ctypes.c_int
+        lib.rs_decode1_fused.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_size_t,
         ]
         lib.b2b_new.restype = ctypes.c_void_p
@@ -254,6 +262,41 @@ def gf_syndrome_rows(
     if rc != 0:
         raise RuntimeError(f"rs_syndrome_rows failed: {rc}")
     return s, counts
+
+
+def gf_decode1_fused(
+    A: np.ndarray,
+    basis: Sequence[np.ndarray],
+    extra: Sequence[np.ndarray],
+    j: int,
+    e: int,
+    length: int,
+) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    """Fused single-corrupt-row decode (see rs_decode1_fused): one pass
+    computes the syndrome, verifies the single-support hypothesis
+    {basis row j} per column, and returns (corrected_row_j, state) with
+    state 0 = clean, 1 = corrected, 2 = needs the general path. None when
+    the shim is unavailable or the hypothesis cannot be verified (check
+    column j identically zero — impossible for MDS checks)."""
+    lib = _fast_lib()
+    if lib is None:
+        return None
+    Ab = np.ascontiguousarray(A, dtype=np.uint8)
+    r2, k = Ab.shape
+    out = np.empty(length, dtype=np.uint8)
+    state = np.empty(length, dtype=np.uint8)
+    b_ptrs, b_keep = _row_ptrs(basis)
+    e_ptrs, e_keep = _row_ptrs(extra)
+    rc = lib.rs_decode1_fused(
+        _as_u8_ptr(Ab), r2, k, b_ptrs, e_ptrs, int(j), int(e),
+        _as_u8_ptr(out), _as_u8_ptr(state), length,
+    )
+    del b_keep, e_keep
+    if rc == -2:
+        return None
+    if rc != 0:
+        raise RuntimeError(f"rs_decode1_fused failed: {rc}")
+    return out, state
 
 
 def gf_scale_rows(consts: np.ndarray, D: np.ndarray) -> Optional[np.ndarray]:

@@ -321,6 +321,81 @@ int rs_syndrome_rows(const uint8_t* A, int r2, int k,
   return 0;
 }
 
+// Speculative single-corrupt-row decode, fully fused (matrix/bw.py's
+// whole-share fast path): ONE tiled pass over the m = k + r2 received rows
+// computes the parity-check syndrome, solves the single-support error
+// magnitude, verifies every check row, and applies the correction — the
+// syndrome is never materialized in memory, so whole-share corruption
+// costs one read of the received rows plus one written row instead of
+// syndrome + solve + verify + apply round trips. Per column:
+//
+//   s_i  = (sum_c A[i][c] * basis[c]) ^ extra[i]       i in [0, r2)
+//   z    = s_p0 * inv(A[p0][j])     (p0 = first row with A[p0][j] != 0)
+//   ok   = all_i (s_i == A[i][j] * z)   — rank-1 consistency with col j
+//   out_row = basis[j] ^ ((count > e && ok) ? z : 0)
+//   state   = 0 clean (count <= e), 1 corrected, 2 unexplained
+//
+// The per-column guarantee is the generic syndrome decoder's: count <= e
+// columns already hold the unique codeword; count > e columns that verify
+// rank-1 against check column j become a codeword differing from the
+// received word in one row <= e. state == 2 columns need the general
+// path (the Python caller gathers and re-decodes just those columns).
+// Requires 0 <= j < k and e >= 1. Returns 0 on success, -2 when check
+// column j is all zero (never true for an MDS parity check).
+int rs_decode1_fused(const uint8_t* A, int r2, int k,
+                     const uint8_t* const* basis, const uint8_t* const* extra,
+                     int j, int e, uint8_t* out_row, uint8_t* state,
+                     size_t len) {
+  if (!A || !basis || !extra || !out_row || !state) return -1;
+  if (r2 < 1 || k < 1 || j < 0 || j >= k || e < 1) return -1;
+  int p0 = -1;
+  for (int i = 0; i < r2; ++i)
+    if (A[static_cast<size_t>(i) * k + j]) { p0 = i; break; }
+  if (p0 < 0) return -2;
+  const uint8_t inv_p0 = gf_inv(A[static_cast<size_t>(p0) * k + j]);
+  // Small tiles: tmp + z + cnt + bad must stay L1-resident while the
+  // basis/extra streams pass through (they re-stream from L2 per check
+  // row, same as rs_syndrome_rows).
+  constexpr size_t kTile = 8 << 10;
+  std::vector<uint8_t> tmp(kTile), z(kTile), cnt(kTile), bad(kTile);
+  const uint8_t ecap = static_cast<uint8_t>(e < 255 ? e : 255);
+  for (size_t off = 0; off < len; off += kTile) {
+    const size_t t = len - off < kTile ? len - off : kTile;
+    // Check row p0 first: its syndrome defines the candidate magnitude z
+    // (and is consistent with column j by construction).
+    std::memcpy(tmp.data(), extra[p0] + off, t);
+    for (int c = 0; c < k; ++c)
+      mul_add_row(tmp.data(), basis[c] + off,
+                  A[static_cast<size_t>(p0) * k + c], t);
+    for (size_t q = 0; q < t; ++q) cnt[q] = tmp[q] != 0;
+    std::memset(z.data(), 0, t);
+    mul_add_row(z.data(), tmp.data(), inv_p0, t);
+    std::memset(bad.data(), 0, t);
+    for (int i = 0; i < r2; ++i) {
+      if (i == p0) continue;
+      std::memcpy(tmp.data(), extra[i] + off, t);
+      for (int c = 0; c < k; ++c)
+        mul_add_row(tmp.data(), basis[c] + off,
+                    A[static_cast<size_t>(i) * k + c], t);
+      for (size_t q = 0; q < t; ++q) cnt[q] += tmp[q] != 0;
+      // tmp ^= A[i][j] * z: zero exactly where row i is consistent with
+      // the single-support hypothesis, so OR-folding flags violations.
+      mul_add_row(tmp.data(), z.data(), A[static_cast<size_t>(i) * k + j], t);
+      for (size_t q = 0; q < t; ++q) bad[q] |= tmp[q];
+    }
+    const uint8_t* bj = basis[j] + off;
+    uint8_t* oj = out_row + off;
+    uint8_t* st = state + off;
+    for (size_t q = 0; q < t; ++q) {
+      const bool isbad = cnt[q] > ecap;
+      const bool fix = isbad && bad[q] == 0;
+      oj[q] = static_cast<uint8_t>(bj[q] ^ (fix ? z[q] : 0));
+      st[q] = static_cast<uint8_t>(isbad ? (fix ? 1 : 2) : 0);
+    }
+  }
+  return 0;
+}
+
 // In-place per-row scale: buf row i *= consts[i] (rows x len, contiguous).
 int rs_scale_rows(const uint8_t* consts, uint8_t* buf, int rows, size_t len) {
   if (!consts || !buf || rows < 1) return -1;

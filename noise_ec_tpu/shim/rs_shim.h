@@ -70,6 +70,17 @@ int rs_syndrome_rows(const uint8_t* A, int r2, int k,
                      const uint8_t* const* basis, const uint8_t* const* extra,
                      uint8_t* const* s_out, uint8_t* counts, size_t len);
 
+/* Fused speculative single-corrupt-row decode: one tiled pass computes
+ * the syndrome, verifies the single-support hypothesis {basis row j}
+ * column-wise, and writes the corrected row j into out_row. state[col]:
+ * 0 = clean (count <= e), 1 = corrected, 2 = unexplained (caller must
+ * re-decode those columns generally). Requires 0 <= j < k, e >= 1.
+ * Returns 0 on success, -2 when check column j is identically zero. */
+int rs_decode1_fused(const uint8_t* A, int r2, int k,
+                     const uint8_t* const* basis, const uint8_t* const* extra,
+                     int j, int e, uint8_t* out_row, uint8_t* state,
+                     size_t len);
+
 #ifdef __cplusplus
 } /* extern "C" */
 #endif

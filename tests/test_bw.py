@@ -750,3 +750,175 @@ def test_syndrome_decode_any_gf65536(rng):
     out, _, corrected = res
     assert corrected
     np.testing.assert_array_equal(np.stack(out), data)
+
+
+# -- speculative fused single-row decode (shim rs_decode1_fused) ------------
+
+
+def _fused_case(rng, k, n, kind="cauchy", S=300_000):
+    gf = GF256()
+    gold = GoldenCodec(k, n, matrix=kind)
+    data = rng.integers(0, 256, size=(k, S), dtype=np.int64).astype(np.uint8)
+    cw = gold.encode_all(data)
+    return gf, gold, data, cw.astype(np.uint8)
+
+
+def test_fused_whole_share_hits_one_pass_kernel(rng, monkeypatch):
+    """Whole-share corruption above the speculation threshold must run
+    the fused kernel (probe -> rs_decode1_fused), never materializing the
+    full syndrome: _matmul_rows must not be called at full stripe width,
+    and the result must match the data exactly."""
+    import noise_ec_tpu.matrix.bw as bw
+
+    gf, gold, data, cw = _fused_case(rng, 10, 14)
+    S = data.shape[1]
+    rows = [np.ascontiguousarray(cw[i]) for i in range(14)]
+    rows[1] = rows[1] ^ np.uint8(0xA5)
+    calls = []
+    orig = bw._matmul_rows
+    monkeypatch.setattr(
+        bw, "_matmul_rows",
+        lambda gf_, M, rws, **kw: calls.append(rws[0].size) or orig(gf_, M, rws, **kw),
+    )
+    res = bw.syndrome_decode_rows(gf, "cauchy", 10, 14, list(range(14)), rows)
+    assert res is not None
+    out, touched, corrected = res
+    assert corrected
+    assert touched == [False, True] + [False] * 8
+    np.testing.assert_array_equal(np.stack(out), data)
+    assert all(w < S for w in calls), f"full-width matmul ran: {calls}"
+
+
+def test_fused_leftover_columns_recurse_exactly(rng):
+    """Mixed corruption: one share corrupt everywhere plus a second share
+    corrupt at scattered columns — the fused pass fixes the single-support
+    columns and the two-error columns come back through the gathered
+    general path, all exact."""
+    import noise_ec_tpu.matrix.bw as bw
+
+    gf, gold, data, cw = _fused_case(rng, 10, 14)
+    S = data.shape[1]
+    rows = [np.ascontiguousarray(cw[i]) for i in range(14)]
+    rows[1] = rows[1] ^ np.uint8(0xA5)
+    r2c = rows[2].copy()
+    scatter = rng.permutation(S)[:97]
+    r2c[scatter] ^= 0x3C
+    rows[2] = r2c
+    res = bw.syndrome_decode_rows(gf, "cauchy", 10, 14, list(range(14)), rows)
+    assert res is not None
+    np.testing.assert_array_equal(np.stack(res[0]), data)
+
+
+def test_fused_disjoint_whole_share_regions(rng):
+    """Two shares each wholly corrupt on disjoint column ranges: the fused
+    pass fixes one support, the recursion (generic machinery) fixes the
+    other region."""
+    import noise_ec_tpu.matrix.bw as bw
+
+    gf, gold, data, cw = _fused_case(rng, 10, 14)
+    S = data.shape[1]
+    rows = [np.ascontiguousarray(cw[i]) for i in range(14)]
+    r1 = rows[1].copy(); r1[: S // 2] ^= 0x5A; rows[1] = r1
+    r3 = rows[3].copy(); r3[S // 2 :] ^= 0x77; rows[3] = r3
+    res = bw.syndrome_decode_rows(gf, "cauchy", 10, 14, list(range(14)), rows)
+    assert res is not None
+    np.testing.assert_array_equal(np.stack(res[0]), data)
+
+
+def test_fused_beyond_radius_still_raises(rng):
+    """Three wholly corrupt shares with e = 2: the probe may fire but the
+    decode must land on None (beyond the unique-decoding radius), exactly
+    like the generic path."""
+    import noise_ec_tpu.matrix.bw as bw
+
+    gf, gold, data, cw = _fused_case(rng, 10, 14)
+    S = data.shape[1]
+    rows = [np.ascontiguousarray(cw[i]) for i in range(14)]
+    for j in (1, 2, 3):
+        rows[j] = rows[j] ^ np.frombuffer(
+            rng.integers(1, 256, size=S, dtype=np.int64).astype(np.uint8).tobytes(),
+            np.uint8,
+        )
+    assert bw.syndrome_decode_rows(
+        gf, "cauchy", 10, 14, list(range(14)), rows
+    ) is None
+
+
+def test_fused_vandermonde_raw_coefficients(rng):
+    """The fused path must honor non-systematic kinds: vandermonde_raw
+    returns message coefficients via the general emission path."""
+    import noise_ec_tpu.matrix.bw as bw
+
+    gf = GF256()
+    k, n, S = 6, 10, 300_000
+    gold = GoldenCodec(k, n, matrix="vandermonde_raw")
+    data = rng.integers(0, 256, size=(k, S), dtype=np.int64).astype(np.uint8)
+    cw = gold.encode_all(data).astype(np.uint8)
+    rows = [np.ascontiguousarray(cw[i]) for i in range(n)]
+    rows[2] = rows[2] ^ np.uint8(0x42)
+    res = bw.syndrome_decode_rows(gf, "vandermonde_raw", k, n, list(range(n)), rows)
+    assert res is not None
+    np.testing.assert_array_equal(np.stack(res[0]), data)
+
+
+def test_fused_par1_whole_share(rng):
+    """par1 (non-MDS) whole-share corruption above the threshold runs the
+    same fused pass through syndrome_decode_rows_any."""
+    from noise_ec_tpu.matrix.bw import syndrome_decode_rows_any
+
+    gf = GF256()
+    k, n, S = 5, 9, 300_000
+    gold = GoldenCodec(k, n, matrix="par1")
+    data = rng.integers(0, 256, size=(k, S), dtype=np.int64).astype(np.uint8)
+    cw = gold.encode_all(data).astype(np.uint8)
+    rows = [np.ascontiguousarray(cw[i]) for i in range(n)]
+    rows[3] = rows[3] ^ np.uint8(0x99)
+    res = syndrome_decode_rows_any(gf, gold.G, k, list(range(n)), rows)
+    assert res is not None
+    out, _, corrected = res
+    assert corrected
+    np.testing.assert_array_equal(np.stack(out), data)
+
+
+def test_fused_matches_generic_on_random_patterns(rng):
+    """Property check: for random within-radius corruption patterns at
+    speculation width, the speculative decode and the generic decode
+    (_speculate=False) agree exactly."""
+    import noise_ec_tpu.matrix.bw as bw
+
+    gf, gold, data, cw = _fused_case(rng, 6, 10, S=280_000)
+    S = data.shape[1]
+    for trial in range(3):
+        rows = [np.ascontiguousarray(cw[i]) for i in range(10)]
+        j = int(rng.integers(0, 6))
+        rows[j] = rows[j] ^ np.uint8(int(rng.integers(1, 256)))
+        extra_cols = rng.permutation(S)[:31]
+        other = (j + 1 + int(rng.integers(0, 5))) % 10
+        ro = rows[other].copy()
+        ro[extra_cols] ^= int(rng.integers(1, 256))
+        rows[other] = ro
+        spec = bw.syndrome_decode_rows(gf, "cauchy", 6, 10, list(range(10)), rows)
+        gen = bw.syndrome_decode_rows(
+            gf, "cauchy", 6, 10, list(range(10)), rows, _speculate=False
+        )
+        assert spec is not None and gen is not None
+        np.testing.assert_array_equal(np.stack(spec[0]), np.stack(gen[0]))
+        np.testing.assert_array_equal(np.stack(spec[0]), data)
+
+
+def test_fused_respects_max_support_zero(rng):
+    """max_support=0 forbids corrections: the speculative path must not
+    fire, and the decode must return None exactly like the generic path
+    (contract regression from the round-5 fused path)."""
+    from noise_ec_tpu.matrix.bw import syndrome_decode_rows_any
+
+    gf = GF256()
+    k, n, S = 5, 9, 300_000
+    gold = GoldenCodec(k, n, matrix="par1")
+    data = rng.integers(0, 256, size=(k, S), dtype=np.int64).astype(np.uint8)
+    cw = gold.encode_all(data).astype(np.uint8)
+    rows = [np.ascontiguousarray(cw[i]) for i in range(n)]
+    rows[3] = rows[3] ^ np.uint8(0x99)
+    assert syndrome_decode_rows_any(
+        gf, gold.G, k, list(range(n)), rows, max_support=0
+    ) is None
