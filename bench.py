@@ -318,6 +318,45 @@ def main() -> None:
             )
             stats[f"reconstruct{e}_1mib_p50_ms"] = round(t_rec * 1e3, 3)
 
+        # --- config D, device route: the decode-under-corruption hot loop
+        # (infectious Decode, main.go:77) on DEVICE-RESIDENT stripes — the
+        # natural state in the batch/mesh story. The single-corrupt-row
+        # correction folds into ONE generator-shaped fused matmul
+        # (DeviceCodec.decode1_words: corrected row + consistency rows),
+        # so the decode rides the same kernel class as encode. Host-route
+        # numbers for the same contract are the decode_corrupt_* stats
+        # above (shares arriving as host bytes).
+        try:
+            from noise_ec_tpu.matrix.linalg import gf_inv as _gf_inv
+
+            data14 = rng.integers(0, 256, size=(k, 1 << 20)).astype(np.uint8)
+            cw14 = np.asarray(GoldenCodec(k, k + r).encode_all(data14))
+            cw14[1] ^= 0xA5  # whole-share corruption of data share 1
+            A14 = gf.matmul(
+                G[k:].astype(np.int64),
+                _gf_inv(gf, G[:k]).astype(np.int64),
+            ).astype(np.uint8)
+            w14 = jnp.asarray(np.ascontiguousarray(cw14).view("<u4"))
+            got_c, got_bad = dev.decode1_words(A14, 1, w14)
+            check_smoke(
+                np.array_equal(
+                    np.asarray(got_c)[None].view(np.uint8)[0], data14[1]
+                )
+                and not np.asarray(got_bad).any(),
+                "device decode1 != corrupted row truth",
+            )
+            t_d1 = chained_seconds_per_iter(
+                lambda s: (lambda c, b: c[:128] ^ b[:128])(
+                    *dev.decode1_words(A14, 1, s)
+                ),
+                w14,
+            )
+            stats["decode_corrupt_device_ms"] = round(t_d1 * 1e3, 3)
+        except SmokeMismatch:
+            raise
+        except Exception as exc:  # noqa: BLE001 — secondary stat only
+            stats["decode_corrupt_device_error"] = str(exc)[:80]
+
         # --- config 3: high-rate RS(17,3) and wide RS(50,20) streaming
         # encode (HBM-resident chunked stream, stripe axis folded). Each
         # geometry gets its own correctness smoke: wide codes exercise

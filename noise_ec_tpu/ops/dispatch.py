@@ -292,6 +292,80 @@ class DeviceCodec:
         s = self.matmul_stripes(aug, rows)
         return s, np.count_nonzero(s, axis=0)
 
+    def decode1_matrix(self, A: np.ndarray, j: int) -> np.ndarray:
+        """(r2, m) matrix folding the single-corrupt-row decode into ONE
+        generator-shaped product (the device analogue of the host shim's
+        rs_decode1_fused; same per-column guarantee as matrix/bw.py).
+
+        With aug = [A | I] the parity check over the m received rows and
+        p0 the first check row seeing basis column j:
+
+        - row 0 = e_j ^ inv(A[p0,j]) * aug[p0]  — applied to the received
+          rows this is rows[j] ^ inv(A[p0,j]) * s_p0, i.e. row j with the
+          single-support correction applied (the e_j and aug terms cancel
+          at column j, so the corrupted row is reconstructed from the
+          others — correcting a fully-corrupt row IS reconstruction);
+        - rows 1.. = aug[q] ^ (A[q,j]/A[p0,j]) * aug[p0] for q != p0 —
+          each is s_q ^ c_q * s_p0, zero exactly where check row q is
+          consistent with the hypothesis "only row j is in error". A
+          column with ANY nonzero verify byte must be re-decoded by the
+          general host path; columns that verify (including clean columns,
+          where s_p0 = 0 makes the correction a no-op) are exact.
+        """
+        A = np.asarray(A, dtype=self.gf.dtype)
+        r2, k = A.shape
+        if r2 < 2:
+            # One parity row leaves NO consistency rows: the mask would
+            # claim every column verified with zero verification behind
+            # it. Matches the host kernel's e >= 1 requirement (a single
+            # redundant share cannot correct anyway).
+            raise ValueError(
+                f"single-support decode needs >= 2 check rows, got {r2}"
+            )
+        if not 0 <= j < k:
+            raise ValueError(f"j must index a basis row, got {j}")
+        nz = np.flatnonzero(A[:, j])
+        if nz.size == 0:
+            raise ValueError(f"check column {j} is identically zero")
+        p0 = int(nz[0])
+        gf = self.gf
+        aug = np.concatenate([A, np.eye(r2, dtype=self.gf.dtype)], axis=1)
+        inv_c = int(gf.inv(int(A[p0, j])))
+        D = np.zeros((r2, k + r2), dtype=self.gf.dtype)
+        D[0, j] = 1
+        D[0] ^= gf.mul(inv_c, aug[p0].astype(np.int64)).astype(self.gf.dtype)
+        out_i = 1
+        for q in range(r2):
+            if q == p0:
+                continue
+            c_q = int(gf.mul(int(A[q, j]), inv_c))
+            D[out_i] = aug[q] ^ gf.mul(
+                c_q, aug[p0].astype(np.int64)
+            ).astype(self.gf.dtype)
+            out_i += 1
+        return D
+
+    def decode1_words(
+        self, A: np.ndarray, j: int, rows_words
+    ) -> tuple:
+        """Device-resident single-corrupt-row decode step.
+
+        ``rows_words``: (m, TW) uint32 device words of all m received
+        stripes. Returns (corrected_row_j_words (TW,), verify_or (TW,))
+        — verify_or is the OR-fold of the consistency rows; a byte of it
+        nonzero means that byte column defeated the single-support
+        hypothesis and must go through the general path. One fused
+        generator-shaped matmul (same kernel and rate class as encode)
+        plus an elementwise OR — jit-composable for chained timing.
+        """
+        D = self.decode1_matrix(A, j)  # raises for r2 < 2 (no verify rows)
+        out = self.matmul_words(D, rows_words)
+        corrected = out[0]
+        bad = out[1]
+        for q in range(2, out.shape[0]):
+            bad = bad | out[q]
+        return corrected, bad
+
     def _bytesliced_words(self, M: np.ndarray, Db: np.ndarray,
                           r2: int) -> np.ndarray:
         """(2k, S) uint8 byte rows x the gf65536 matrix -> (2r, S) uint8.
