@@ -107,15 +107,6 @@ def _parsed_public_key(public_key: bytes) -> Ed25519PublicKey:
     return Ed25519PublicKey.from_public_bytes(public_key)
 
 
-@functools.lru_cache(maxsize=8)
-def _parsed_private_key(seed: bytes) -> Ed25519PrivateKey:
-    """Parsed signing key. The cache is TINY on purpose: it holds only
-    the process's own live identities (which the KeyPair already keeps in
-    memory), so discarded temporary seeds evict almost immediately
-    instead of being pinned for the process lifetime."""
-    return Ed25519PrivateKey.from_private_bytes(seed)
-
-
 class Ed25519Policy:
     """Ed25519 signature policy (noise/crypto/ed25519.New())."""
 
@@ -123,8 +114,24 @@ class Ed25519Policy:
     public_key_size = 32
     signature_size = 64
 
+    def __init__(self) -> None:
+        # Parsed signing keys cached PER POLICY INSTANCE, not in a module
+        # global: a global cache keyed by the raw seed pins key material
+        # beyond the owning KeyPair's lifetime and leaves it reachable via
+        # cache introspection (r4 advisor). Discarding the policy (the
+        # plugin holds it) releases the parsed keys with it. Tiny bound:
+        # a node signs with its own few identities.
+        self._parsed_priv: dict[bytes, Ed25519PrivateKey] = {}
+
     def sign(self, private_key: bytes, message: bytes) -> bytes:
-        return _parsed_private_key(bytes(private_key)).sign(message)
+        seed = bytes(private_key)
+        pk = self._parsed_priv.get(seed)
+        if pk is None:
+            if len(self._parsed_priv) >= 8:
+                self._parsed_priv.clear()
+            pk = Ed25519PrivateKey.from_private_bytes(seed)
+            self._parsed_priv[seed] = pk
+        return pk.sign(message)
 
     def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
         if len(public_key) != self.public_key_size:

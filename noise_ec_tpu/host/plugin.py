@@ -857,7 +857,15 @@ class ShardPlugin:
                         st["buf"][lo + seg_lo : lo + seg_lo + seg] = (
                             memoryview(by_num[j])[:seg]
                         )
-                    st["done"][index] = distinct
+                    # Record k, not distinct: direct assembly used only
+                    # the k data shards and checked NO parity, so a later
+                    # verify failure must re-decode this chunk whenever
+                    # the pool holds ANY redundancy beyond k —
+                    # _repair_stream's "pool grew" gate compares against
+                    # this value (r4 advisor: recording distinct > k here
+                    # made a repairable corrupt chunk permanently
+                    # undeliverable).
+                    st["done"][index] = k
                     self.counters.add("decodes", 1)
                     if len(st["done"]) < st["count"]:
                         return None

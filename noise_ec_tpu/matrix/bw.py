@@ -818,10 +818,14 @@ def syndrome_decode_rows_any(
     this picks one, exactly as the subset search did. Returns None when a
     bad column has no explanation within ``max_support`` errors (or the
     first-k basis is singular) — the caller falls back to the subset
-    search. ``max_support`` defaults to min(e, 2), covering the radius of
-    every geometry with up to 5 redundant shares.
+    search. ``max_support`` defaults adaptively: the largest t with
+    C(m, 1) + ... + C(m, t) candidate supports under ~10k solves (never
+    below min(e, 2)), so geometries with many redundant shares correct
+    within their full radius in polynomial time instead of silently
+    capping at 2 (r4 verdict).
     """
     import itertools
+    import math
 
     m = len(rows)
     if m < k or len(nums) != m:
@@ -833,7 +837,14 @@ def syndrome_decode_rows_any(
     e = (m - k) // 2
     r2 = m - k
     if max_support is None:
-        max_support = min(e, 2)
+        max_support, total = 0, 0
+        while max_support < e:
+            c = math.comb(m, max_support + 1)
+            if total + c > 10_000:
+                break
+            total += c
+            max_support += 1
+        max_support = max(max_support, min(e, 2))
     try:
         Gb_inv = gf_inv(gf, np.asarray(G)[nums[:k]])
     except np.linalg.LinAlgError:

@@ -177,6 +177,28 @@ def _fused_words16_fn(r: int, bits_rows: tuple, interpret: bool):
     return _fused_words_pipeline(r, 16, bits_rows, interpret)
 
 
+# Baked XOR-network kernels scale with the generator's set-bit count:
+# Mosaic program size is O(XORs) and Paar factoring is super-linear in
+# terms, so near-field-limit geometries (k -> 256 is first-class contract,
+# main.go:248; RS(200,56) expands to ~350k raw XORs) must not even attempt
+# them — factoring alone ran >9 min there. Above this raw-XOR budget the
+# dense MXU bit-plane kernel (ops/mxu_gf2.py) runs the product instead:
+# fixed 64*r*k int8 MACs per byte on the systolic array, no per-geometry
+# network to plan or compile, and MXU utilization *improves* with size
+# (the (8r, 8k) operand at k=200 fills the 128x128 array; the RS(50,20)
+# measurement's 49% tile-padding floor does not apply). RS(50,20)
+# (~32k raw XORs, the widest code the VPU network wins) stays baked.
+_BAKED_XOR_BUDGET = 60_000
+
+# The baked pipeline's pack/unpack stages hold (rows, 8, 2*TL) u32 tiles in
+# VMEM regardless of the XOR cost, so a matrix with many INPUT or OUTPUT
+# rows OOMs even when its network is tiny (measured: a (3, 200)
+# reconstruction matrix — 19k XORs — died in pallas_pack at 24.8M scoped
+# vs the 16M VMEM limit). RS(50,20) (70 rows total) is measured-good; 96
+# keeps ~2x VMEM margin on the pack tile model (96*8*1024*4 = 3.1 MiB).
+_BAKED_MAX_ROWS = 96
+
+
 class DeviceCodec:
     """Runs GF matrix x stripes products on the default JAX device.
 
@@ -195,6 +217,8 @@ class DeviceCodec:
             raise ValueError(f"unknown kernel {self.kernel!r}")
         self._mask_dev_cache: dict[bytes, jnp.ndarray] = {}
         self._rows_cache: dict[bytes, tuple] = {}
+        self._cost_cache: dict[bytes, int] = {}
+        self._mxu = None
 
     def _key(self, M: np.ndarray) -> bytes:
         return M.tobytes() + M.shape[1].to_bytes(4, "little")
@@ -216,6 +240,65 @@ class DeviceCodec:
             self._rows_cache[key] = hit
         return hit
 
+    def _xor_cost_for(self, M: np.ndarray) -> int:
+        """Raw two-input XOR count of M's GF(2) bit-network (set bits
+        minus output rows), cached — the route_for decision input."""
+        M = np.ascontiguousarray(np.asarray(M, dtype=self.gf.dtype))
+        key = self._key(M)
+        hit = self._cost_cache.get(key)
+        if hit is None:
+            bits = expand_generator_bits(self.gf, M)
+            hit = int(np.count_nonzero(bits)) - bits.shape[0]
+            if len(self._cost_cache) > 4096:
+                self._cost_cache.clear()
+            self._cost_cache[key] = hit
+        return hit
+
+    def route_for(self, M: np.ndarray) -> str:
+        """Which kernel family runs this matrix: "baked" (planned
+        XOR-network VPU kernels) or "mxu" (dense int8 bit-plane matmul).
+        Exposed so tests can pin the near-field-limit fallback."""
+        if self.gf.degree != 8:
+            return "baked"  # no MXU formulation for the wide field yet
+        r, k = np.asarray(M).shape
+        if max(r, k) > _BAKED_MAX_ROWS:
+            return "mxu"
+        if self._xor_cost_for(M) > _BAKED_XOR_BUDGET:
+            return "mxu"
+        return "baked"
+
+    def _mxu_for(self):
+        if self._mxu is None:
+            from noise_ec_tpu.ops.mxu_gf2 import MxuCodec
+
+            self._mxu = MxuCodec(
+                self.gf, interpret=self.kernel == "pallas_interpret"
+            )
+        return self._mxu
+
+    def _guard_wide_field(self, M: np.ndarray) -> None:
+        """Refuse near-field-limit GF(2^16) matrices with a clear error.
+
+        The wide field has no MXU formulation yet, and its byte-sliced
+        networks hit BOTH baked-kernel walls: Paar factoring on a ~1M-XOR
+        network (minutes) and the pack stage's per-row VMEM (2k byte rows
+        for k symbol rows). A NotImplementedError beats a silent
+        multi-minute hang or a Mosaic OOM.
+        """
+        r, k = np.asarray(M).shape
+        if 2 * max(r, k) > _BAKED_MAX_ROWS:
+            raise NotImplementedError(
+                f"GF(2^16) geometry ({r}, {k}) exceeds the baked kernels' "
+                f"row budget ({_BAKED_MAX_ROWS} byte rows); use GF(2^8) "
+                "for near-field-limit codes"
+            )
+        if self._xor_cost_for(M) > 4 * _BAKED_XOR_BUDGET:
+            raise NotImplementedError(
+                "geometry too large for the baked GF(2^16) kernels "
+                f"({self._xor_cost_for(M)} raw XORs); use GF(2^8) for "
+                "near-field-limit codes"
+            )
+
     def matmul_stripes(self, M: np.ndarray, D) -> np.ndarray:
         """(r, k) GF matrix x (k, S) stripes -> (r, S), computed on device."""
         M = np.asarray(M)
@@ -233,6 +316,7 @@ class DeviceCodec:
             # not a read-only view of the device buffer.
             return np.array(out)
         if m == 16:
+            self._guard_wide_field(M)  # no MXU fallback for gf65536 yet
             # BYTE-SLICED GF(2^16): each u16 symbol splits into (lo, hi)
             # byte rows (2k rows of S bytes), and the device runs the
             # GF(2^8)-shaped m=8 pipeline — the expanded bit matrix needs
@@ -251,6 +335,12 @@ class DeviceCodec:
             return np.ascontiguousarray(
                 out_b.reshape(r, 2, S).transpose(0, 2, 1)
             ).view("<u2").reshape(r, S)
+        if self.route_for(M) == "mxu":
+            # Near-field-limit geometries: dense MXU bit-plane product
+            # (no XOR network to plan/compile — see _BAKED_XOR_BUDGET).
+            # Already charged to matmul_stripes_{kernel} above; a second
+            # record here would double-count the traffic.
+            return self._mxu_for().encode_stripes(M, D)
         TWp = pad_words(-(-S // 4))
         if 4 * TWp != S:
             buf = np.zeros((k, 4 * TWp), dtype=self.gf.dtype)
@@ -435,12 +525,23 @@ class DeviceCodec:
                 "use matmul_stripes (or BatchCodec.encode_batch) on the XLA path"
             )
         record_kernel("matmul_words", 4 * int(np.prod(words.shape)))
-        mk = _fused_words_fn if self.gf.degree == 8 else _fused_words16_fn
-        fn = mk(
-            M.shape[0], self.bits_rows_for(M), self.kernel == "pallas_interpret"
-        )
         TW = words.shape[2]
         TWp = pad_words(TW) if self.gf.degree == 8 else pad_words16(TW)
+        if self.route_for(M) == "mxu":
+            # Near-field-limit geometries (see _BAKED_XOR_BUDGET): the
+            # dense MXU product, same words contract. WORD_QUANTUM is a
+            # multiple of the MXU lane tile, so the padding below fits
+            # both kernel families.
+            mx = self._mxu_for()
+            fn = functools.partial(mx.encode_words, M)
+        else:
+            if self.gf.degree != 8:
+                self._guard_wide_field(M)
+            mk = _fused_words_fn if self.gf.degree == 8 else _fused_words16_fn
+            fn = mk(
+                M.shape[0], self.bits_rows_for(M),
+                self.kernel == "pallas_interpret",
+            )
         if TWp != TW:
             words = jnp.pad(words, ((0, 0), (0, 0), (0, TWp - TW)))
         if words.shape[0] == 1:

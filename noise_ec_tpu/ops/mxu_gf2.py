@@ -119,11 +119,13 @@ class MxuCodec:
         self.gf = gf
         self.tile_words = tile_words
         self.interpret = interpret
-        self._m2_cache: dict[bytes, object] = {}
+        self._m2_cache: dict = {}
 
     def _m2_for(self, M: np.ndarray):
         M = np.ascontiguousarray(np.asarray(M, dtype=self.gf.dtype))
-        key = M.tobytes() + bytes([M.shape[1] & 0xFF])
+        # Full shape in the key: same bytes under congruent-mod-256 column
+        # counts must not collide (r4 advisor finding).
+        key = (M.shape, M.tobytes())
         hit = self._m2_cache.get(key)
         if hit is None:
             hit = expand_generator_bits(self.gf, M).astype(np.int8)

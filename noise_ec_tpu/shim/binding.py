@@ -74,7 +74,16 @@ def _reload_fresh(stale: ctypes.CDLL, path) -> ctypes.CDLL:
     )
     tmp.close()
     shutil.copyfile(path, tmp.name)
-    return ctypes.CDLL(tmp.name)
+    lib = ctypes.CDLL(tmp.name)
+    # The dlopen handle keeps the inode alive on Linux; unlinking now
+    # avoids leaking one temp file per stale-shim recovery (r4 advisor).
+    try:
+        import os
+
+        os.unlink(tmp.name)
+    except OSError:
+        pass
+    return lib
 
 
 def _load() -> ctypes.CDLL:
@@ -246,6 +255,11 @@ def gf_syndrome_rows(
         return None
     Ab = np.ascontiguousarray(A, dtype=np.uint8)
     r2, k = Ab.shape
+    if r2 > 255:
+        # counts is uint8 in the C ABI; more extra rows would silently
+        # wrap the bad-column scan (r4 advisor). Unreachable for deduped
+        # GF(2^8) geometries (m <= n <= 256, k >= 1), so NumPy fallback.
+        return None
     counts = np.empty(length, dtype=np.uint8)
     b_ptrs, b_keep = _row_ptrs(basis)
     e_ptrs, e_keep = _row_ptrs(extra)

@@ -181,6 +181,68 @@ def _checks():
         fec_dev.decode(bad) == payload.tobytes(),
     )
 
+    # --- near-field-limit geometry (round 5): k <= n <= 256 is first-class
+    # contract (reference NewFEC, main.go:248, and the runtime geometry
+    # adjustment mints large prime k — main.go:185-191). RS(200,56) routes
+    # to the dense MXU kernel (dispatch._BAKED_XOR_BUDGET /
+    # _BAKED_MAX_ROWS: its ~361k-XOR network cannot be planned or
+    # compiled), exercised here through the public dispatch on hardware:
+    # encode vs golden, erasure reconstruct, device syndrome, and a
+    # corrupted-share FEC decode.
+    kL, rL = 200, 56
+    t_plan = time.time()
+    GL = generator_matrix(dev.gf, kL, kL + rL, "cauchy")
+    routes = (
+        dev.route_for(GL[kL:]),
+        dev.route_for(np.ascontiguousarray(GL[:3, :kL])),
+    )
+    t_plan = time.time() - t_plan
+    yield (
+        "near-limit RS(200,56) route=mxu, planning bounded",
+        # routes[1] is the (3, 200) many-rows/tiny-network reconstruction
+        # shape that OOMed the pack stage — it must route to MXU too.
+        routes == ("mxu", "mxu") and t_plan < 30.0,
+    )
+    goldL = golden("gf256", kL, kL + rL)
+    DL = data_for("gf256", kL, 8192)
+    yield (
+        "near-limit encode gf256 RS(200,56)",
+        np.array_equal(
+            dev.matmul_stripes(GL[kL:], DL), np.asarray(goldL.encode(DL))
+        ),
+    )
+    fullL = np.concatenate([DL, np.asarray(goldL.encode(DL))], axis=0)
+    erasedL = [0, 100, 199]
+    presentL = [i for i in range(kL + rL) if i not in erasedL][:kL]
+    RL = reconstruction_matrix(dev.gf, GL, presentL, erasedL)
+    yield (
+        "near-limit reconstruct 3 erasures gf256 RS(200,56)",
+        np.array_equal(
+            dev.matmul_stripes(RL, fullL[presentL]), DL[erasedL]
+        ),
+    )
+    cwL = fullL.copy()
+    cwL[7] ^= 0x2D  # corrupt data share 7 wholly
+    AL = np.ascontiguousarray(GL[kL:], dtype=np.uint8)
+    rowsL = [np.ascontiguousarray(cwL[i]) for i in range(kL + rL)]
+    host_sL, host_cL = _syndrome(dev.gf, AL, rowsL, kL)
+    dev_sL, dev_cL = dev.syndrome_stripes(AL, np.stack(rowsL))
+    yield (
+        "near-limit device syndrome gf256 RS(200,56)",
+        np.array_equal(dev_sL, host_sL) and np.array_equal(dev_cL, host_cL),
+    )
+    fecL = FEC(kL, kL + rL, backend="numpy")
+    sharesL = fecL.encode_shares(DL.tobytes())
+    badL = [
+        Share(s.number, bytes(b ^ 0x3C for b in s.data))
+        if s.number == 13 else s
+        for s in sharesL
+    ]
+    yield (
+        "near-limit FEC corrupted-share decode gf256 RS(200,56)",
+        fecL.decode(badL) == DL.tobytes(),
+    )
+
     # --- MXU int8 bit-plane encoder (round 4; the recorded wide-code
     # formulation, BASELINE.md "MXU route measured").
     from noise_ec_tpu.ops.mxu_gf2 import MxuCodec

@@ -978,3 +978,22 @@ def test_device_decode1_rejects_single_check_row(rng):
     A = rng.integers(1, 256, size=(1, 4)).astype(np.uint8)
     with pytest.raises(ValueError, match="check rows"):
         dev.decode1_matrix(A, 2)
+
+
+def test_adaptive_par1_three_corrupt_shares(rng):
+    """par1 with 8 redundant shares and THREE corrupted shares corrects
+    through the adaptive support enumeration (r4 capped max_support at 2
+    and silently fell to the exponential subset search here)."""
+    from noise_ec_tpu.codec.fec import FEC, Share
+
+    k, n = 8, 16
+    fec = FEC(k, n, matrix="par1", backend="numpy")
+    rng2 = np.random.default_rng(77)
+    data = rng2.integers(0, 256, size=k * 256, dtype=np.int64).astype(np.uint8).tobytes()
+    shares = fec.encode_shares(data)
+    bad = [Share(s.number, s.data) for s in shares]
+    for j in (1, 5, 11):
+        bad[j] = Share(j, (np.frombuffer(bad[j].data, np.uint8) ^ (0x20 + j)).tobytes())
+    assert fec.decode(bad) == data
+    assert fec.stats["subset_decodes"] == 0, "fell back to the subset search"
+    assert fec.stats["bw_decodes"] == 1

@@ -226,3 +226,111 @@ def test_mxu_codec_interpret_bit_exact(rng):
         got = mx.encode_stripes(G[k:], D)
         want = np.asarray(GoldenCodec(k, k + r).encode(D))
         np.testing.assert_array_equal(got, want)
+
+
+# -- near-field-limit geometries (k -> 256; VERDICT r4 missing #2) ----------
+
+
+def test_route_for_pins_kernel_family():
+    """The dispatch route gate: wide-but-bounded codes stay on the baked
+    XOR-network kernels; near-field-limit matrices (big networks OR many
+    rows, which OOM the pack stage's VMEM regardless of network size) go
+    to the dense MXU bit-plane kernel."""
+    from noise_ec_tpu.matrix.generators import generator_matrix
+    from noise_ec_tpu.ops.dispatch import DeviceCodec
+
+    dev = DeviceCodec(field="gf256", kernel="pallas_interpret")
+    g50 = generator_matrix(dev.gf, 50, 70, "cauchy")
+    assert dev.route_for(g50[50:]) == "baked"
+    g200 = generator_matrix(dev.gf, 200, 256, "cauchy")
+    assert dev.route_for(g200[200:]) == "mxu"
+    # Tiny network, many input rows: the (3, 200) reconstruction shape
+    # that OOMed pallas_pack on hardware must also route to the MXU.
+    import numpy as np
+    small = np.zeros((3, 200), dtype=np.uint8)
+    small[:, :3] = np.eye(3, dtype=np.uint8)
+    assert dev.route_for(small) == "mxu"
+
+
+def test_near_limit_encode_matches_golden_interpret():
+    """RS(200,56) through the public dispatch (MXU route, interpret mode)
+    is bit-exact vs the golden codec — the near-field-limit contract
+    (k <= n <= 256 is first-class, reference NewFEC)."""
+    import numpy as np
+
+    from noise_ec_tpu.golden.codec import GoldenCodec
+    from noise_ec_tpu.matrix.generators import generator_matrix
+    from noise_ec_tpu.ops.dispatch import DeviceCodec
+
+    k, r = 200, 56
+    dev = DeviceCodec(field="gf256", kernel="pallas_interpret")
+    G = generator_matrix(dev.gf, k, k + r, "cauchy")
+    rng = np.random.default_rng(11)
+    D = rng.integers(0, 256, size=(k, 2048)).astype(np.uint8)
+    got = dev.matmul_stripes(G[k:], D)
+    want = np.asarray(GoldenCodec(k, k + r).encode(D))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_near_limit_planning_time_bounded():
+    """Route decision + plan inputs for RS(200,56) must be seconds, not
+    the >9 min Paar factoring would take — the gate must decide BEFORE
+    any factoring runs, and the decision must be cached."""
+    import time
+
+    import numpy as np
+
+    from noise_ec_tpu.matrix.generators import generator_matrix
+    from noise_ec_tpu.ops.dispatch import DeviceCodec
+
+    dev = DeviceCodec(field="gf256", kernel="pallas_interpret")
+    G = generator_matrix(dev.gf, 200, 256, "cauchy")
+    t0 = time.monotonic()
+    assert dev.route_for(G[200:]) == "mxu"
+    first = time.monotonic() - t0
+    assert first < 10.0, f"route decision took {first:.1f}s"
+    t0 = time.monotonic()
+    dev.route_for(G[200:])
+    assert time.monotonic() - t0 < 0.05, "route decision not cached"
+
+
+def test_near_limit_fec_corrupted_decode_host():
+    """End-to-end FEC decode at RS(200,256) with a corrupted share on the
+    host path: the syndrome decoder's plan (200x200 inversion + 56x200
+    check product) must be bounded and the correction exact."""
+    import numpy as np
+
+    from noise_ec_tpu.codec.fec import FEC, Share
+
+    k, n = 200, 256
+    fec = FEC(k, n, backend="numpy")
+    rng = np.random.default_rng(12)
+    data = rng.integers(0, 256, size=k * 512, dtype=np.int64).astype(np.uint8).tobytes()
+    shares = fec.encode_shares(data)
+    bad = [Share(s.number, s.data) for s in shares]
+    bad[17] = Share(17, (np.frombuffer(bad[17].data, np.uint8) ^ 0x5C).tobytes())
+    bad[201] = Share(201, (np.frombuffer(bad[201].data, np.uint8) ^ 0x77).tobytes())
+    assert fec.decode(bad) == data
+    assert fec.stats["bw_decodes"] == 1
+
+
+def test_wide_field_near_limit_refuses_clearly():
+    """GF(2^16) near-field-limit matrices must raise NotImplementedError
+    (no MXU formulation for the wide field yet) instead of hanging in
+    Paar factoring or OOMing the pack stage — on BOTH stripe and words
+    entries, for both failure classes (big network, many rows)."""
+    import numpy as np
+    import pytest
+
+    from noise_ec_tpu.ops.dispatch import DeviceCodec
+
+    dev = DeviceCodec(field="gf65536", kernel="pallas_interpret")
+    rng = np.random.default_rng(13)
+    big = rng.integers(0, 1 << 16, size=(56, 200)).astype(np.uint16)
+    D = rng.integers(0, 1 << 16, size=(200, 64)).astype(np.uint16)
+    with pytest.raises(NotImplementedError):
+        dev.matmul_stripes(big, D)
+    many_rows = np.zeros((3, 200), dtype=np.uint16)
+    many_rows[:, :3] = np.eye(3, dtype=np.uint16)
+    with pytest.raises(NotImplementedError):
+        dev.matmul_stripes(many_rows, D)
