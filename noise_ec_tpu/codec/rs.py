@@ -103,7 +103,13 @@ class ReedSolomon:
 
     def _mul(self, M: np.ndarray, D: np.ndarray) -> np.ndarray:
         if self._dev is not None:
-            return self._dev.matmul_stripes(M, D)
+            try:
+                return self._dev.matmul_stripes(M, D)
+            except NotImplementedError:
+                # Wide-field near-limit geometries have no device kernel
+                # (dispatch._guard_wide_field); the native host tier is
+                # the designed fallback, not an error, for codec callers.
+                pass
         return host_matvec(self.gf, M, D)
 
     def _to_sym(self, buf: Buffer, name: str) -> np.ndarray:

@@ -128,7 +128,9 @@ class Ed25519Policy:
         pk = self._parsed_priv.get(seed)
         if pk is None:
             if len(self._parsed_priv) >= 8:
-                self._parsed_priv.clear()
+                # FIFO-evict one entry: clearing everything would dump
+                # the hot identities whenever a 9th transient seed lands.
+                self._parsed_priv.pop(next(iter(self._parsed_priv)))
             pk = Ed25519PrivateKey.from_private_bytes(seed)
             self._parsed_priv[seed] = pk
         return pk.sign(message)

@@ -193,17 +193,21 @@ def _syndrome(
     """s = A @ rows[:k] ^ rows[k:], plus per-column nonzero-row counts.
 
     Dispatch: DeviceCodec (one augmented-matrix device matmul) when a
-    device is supplied, the native shim's fused tiled kernel for GF(2^8)
-    on host, row-blocked NumPy otherwise. Row buffers are consumed in
-    place (no stacking copy on the shim path).
+    device is supplied, the native shim's fused tiled kernels on host
+    (GF(2^8) and, since round 5, GF(2^16)), row-blocked NumPy otherwise.
+    Row buffers are consumed in place (no stacking copy on the shim path).
     """
     if device is not None:
-        return device.syndrome_stripes(A, np.stack(rows))
-    if gf.degree == 8:
         try:
-            from noise_ec_tpu.shim import gf_syndrome_rows
+            return device.syndrome_stripes(A, np.stack(rows))
+        except NotImplementedError:
+            pass  # wide-field near-limit: host tier is the designed path
+    if gf.degree in (8, 16):
+        try:
+            from noise_ec_tpu.shim import gf16_syndrome_rows, gf_syndrome_rows
 
-            out = gf_syndrome_rows(
+            fn = gf_syndrome_rows if gf.degree == 8 else gf16_syndrome_rows
+            out = fn(
                 np.asarray(A), rows[:k], rows[k:], rows[0].size,
                 want_syndrome=want_s,
             )
@@ -219,12 +223,18 @@ def _syndrome(
 def _matmul_rows(gf: GF, M: np.ndarray, rows: list, *, device=None) -> np.ndarray:
     """M @ rows over GF on the fastest available backend (see _syndrome)."""
     if device is not None:
-        return np.asarray(device.matmul_stripes(np.asarray(M), np.stack(rows)))
-    if gf.degree == 8:
         try:
-            from noise_ec_tpu.shim import gf_matmul_rows
+            return np.asarray(
+                device.matmul_stripes(np.asarray(M), np.stack(rows))
+            )
+        except NotImplementedError:
+            pass  # wide-field near-limit: host tier is the designed path
+    if gf.degree in (8, 16):
+        try:
+            from noise_ec_tpu.shim import gf16_matmul_rows, gf_matmul_rows
 
-            out = gf_matmul_rows(np.asarray(M), rows, rows[0].size)
+            fn = gf_matmul_rows if gf.degree == 8 else gf16_matmul_rows
+            out = fn(np.asarray(M), rows, rows[0].size)
             if out is not None:
                 return out
         except Exception:  # noqa: BLE001
@@ -424,7 +434,7 @@ def _try_fused_single_row(
     beyond the decoding radius, or the (data_rows, touched, corrected)
     result.
     """
-    from noise_ec_tpu.shim import gf_decode1_fused
+    from noise_ec_tpu.shim import gf16_decode1_fused, gf_decode1_fused
 
     S = rows[0].size
     probe = min(_PROBE_S, S)
@@ -442,7 +452,8 @@ def _try_fused_single_row(
         if cand >= k or (j is not None and cand != j):
             return NotImplemented
         j = cand
-    fused = gf_decode1_fused(A, rows[:k], rows[k:], j, e, S)
+    fused_fn = gf_decode1_fused if gf.degree == 8 else gf16_decode1_fused
+    fused = fused_fn(A, rows[:k], rows[k:], j, e, S)
     if fused is None:
         return NotImplemented
     out_row, state = fused
@@ -475,12 +486,12 @@ def _maybe_fused_single_row(
     speculate: bool,
 ):
     """One owner for the speculation gate shared by both decoders: arm the
-    fused path only on wide host-tier GF(2^8) decodes with correction
-    actually permitted (callers fold contract knobs like max_support into
-    ``speculate``). NotImplemented = run the generic path."""
+    fused path only on wide host-tier decodes (both shim fields) with
+    correction actually permitted (callers fold contract knobs like
+    max_support into ``speculate``). NotImplemented = generic path."""
     if not (
         speculate and e >= 1 and device is None
-        and gf.degree == 8 and rows[0].size >= _SPECULATE_MIN_S
+        and gf.degree in (8, 16) and rows[0].size >= _SPECULATE_MIN_S
     ):
         return NotImplemented
     try:

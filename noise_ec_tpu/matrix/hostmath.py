@@ -7,7 +7,10 @@ codec, the Berlekamp-Welch interpolation/re-encode products — should go
 through these wrappers instead, which dispatch to the native C++ codec's
 split-nibble/GFNI kernels (noise_ec_tpu/shim, klauspost-class throughput)
 when the shared library is available and fall back to NumPy otherwise.
-GF(2^16) always takes the NumPy path (the shim is GF(2^8) only).
+Round 5 adds the GF(2^16) shim tier (nibble-shuffle ``mul_add_row16``),
+so the wide field's matmuls are native too; only ``host_scale_rows``
+keeps a NumPy wide-field path (no 16-bit scale kernel yet — it is not on
+any hot path).
 """
 
 from __future__ import annotations
@@ -27,6 +30,16 @@ def host_matvec(gf: GF, M: np.ndarray, D: np.ndarray) -> np.ndarray:
             if out is not None:
                 return out
         except Exception:  # noqa: BLE001 — any shim failure -> NumPy
+            pass
+    elif gf.degree == 16:
+        try:
+            from noise_ec_tpu.shim import gf16_matmul_rows
+
+            D16 = np.ascontiguousarray(D, dtype=np.uint16)
+            out = gf16_matmul_rows(np.asarray(M), list(D16), D16.shape[1])
+            if out is not None:
+                return out
+        except Exception:  # noqa: BLE001
             pass
     return gf.matvec_stripes(M, D)
 

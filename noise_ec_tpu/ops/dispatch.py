@@ -229,7 +229,22 @@ class DeviceCodec:
 
     def bits_rows_for(self, M: np.ndarray) -> tuple:
         """(r, k) GF matrix -> hashable per-row term tuples for the sparse
-        kernel (cached)."""
+        kernel (cached).
+
+        The shared choke point for EVERY baked-kernel entry (words,
+        planes, byte-sliced), so the near-field-limit guard lives here:
+        a matrix past the baked budget must never reach Paar factoring
+        (>9 min measured) or the pack stage (VMEM OOM) through any path.
+        matmul_stripes/matmul_words route such matrices to the MXU before
+        ever calling this; direct callers get the clear error.
+        """
+        if self.gf.degree == 8 and self.route_for(M) == "mxu":
+            raise NotImplementedError(
+                "matrix exceeds the baked-kernel budget; use "
+                "matmul_stripes/matmul_words (MXU route)"
+            )
+        if self.gf.degree == 16:
+            self._guard_wide_field(M)
         M = np.ascontiguousarray(np.asarray(M, dtype=self.gf.dtype))
         key = self._key(M)
         hit = self._rows_cache.get(key)
@@ -286,17 +301,24 @@ class DeviceCodec:
         multi-minute hang or a Mosaic OOM.
         """
         r, k = np.asarray(M).shape
-        if 2 * max(r, k) > _BAKED_MAX_ROWS:
+        # Two bounds, matching the gf256 budgets (Paar planning time is
+        # field-blind — it sees terms — and the pack stage sees byte
+        # rows): raw XORs <= _BAKED_XOR_BUDGET, byte rows <= 128 (the
+        # measured scoped-VMEM model: 200 input rows OOMed at 24.8M vs
+        # the 16M limit, ~linear in rows -> failure near ~129; refusal
+        # can sit at the model limit because codec callers fall back to
+        # the native host tier, unlike gf256's cautious-96 MXU routing).
+        if 2 * max(r, k) > 128:
             raise NotImplementedError(
                 f"GF(2^16) geometry ({r}, {k}) exceeds the baked kernels' "
-                f"row budget ({_BAKED_MAX_ROWS} byte rows); use GF(2^8) "
-                "for near-field-limit codes"
+                "row budget (128 byte rows); the native host tier "
+                "(hostmath/shim) is the supported wide-field path there"
             )
-        if self._xor_cost_for(M) > 4 * _BAKED_XOR_BUDGET:
+        if self._xor_cost_for(M) > _BAKED_XOR_BUDGET:
             raise NotImplementedError(
                 "geometry too large for the baked GF(2^16) kernels "
-                f"({self._xor_cost_for(M)} raw XORs); use GF(2^8) for "
-                "near-field-limit codes"
+                f"({self._xor_cost_for(M)} raw XORs); the native host "
+                "tier (hostmath/shim) is the supported wide-field path"
             )
 
     def matmul_stripes(self, M: np.ndarray, D) -> np.ndarray:

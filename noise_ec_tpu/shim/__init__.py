@@ -11,6 +11,9 @@ from noise_ec_tpu.shim.binding import (
     NativeBlake2b,
     native_blake2b,
     build_shim,
+    gf16_decode1_fused,
+    gf16_matmul_rows,
+    gf16_syndrome_rows,
     gf_decode1_fused,
     gf_matmul_rows,
     gf_matmul_stripes,
@@ -24,6 +27,9 @@ __all__ = [
     "NativeBlake2b",
     "native_blake2b",
     "build_shim",
+    "gf16_decode1_fused",
+    "gf16_matmul_rows",
+    "gf16_syndrome_rows",
     "gf_decode1_fused",
     "gf_matmul_rows",
     "gf_matmul_stripes",

@@ -62,7 +62,7 @@ def _reload_fresh(stale: ctypes.CDLL, path) -> ctypes.CDLL:
 
         _ctypes.dlclose(stale._handle)
         fresh = ctypes.CDLL(str(path))
-        if hasattr(fresh, "rs_decode1_fused"):
+        if hasattr(fresh, "rs16_decode1_fused"):
             return fresh
     except Exception:  # noqa: BLE001 — fall through to the temp copy
         pass
@@ -90,7 +90,7 @@ def _load() -> ctypes.CDLL:
     global _lib
     if _lib is None:
         lib = ctypes.CDLL(str(build_shim()))
-        if not hasattr(lib, "rs_decode1_fused"):
+        if not hasattr(lib, "rs16_decode1_fused"):
             # Stale prebuilt .so from before the ABI grew (build_shim only
             # runs make when the file is MISSING): rebuild, then reopen
             # past the dlopen pathname cache — otherwise registering the
@@ -145,6 +145,26 @@ def _load() -> ctypes.CDLL:
             ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_size_t,
+        ]
+        u16p = ctypes.POINTER(ctypes.c_uint16)
+        lib.rs16_matmul_rows.restype = ctypes.c_int
+        lib.rs16_matmul_rows.argtypes = [
+            u16p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_size_t,
+        ]
+        lib.rs16_syndrome_rows.restype = ctypes.c_int
+        lib.rs16_syndrome_rows.argtypes = [
+            u16p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_void_p), u16p, ctypes.c_size_t,
+        ]
+        lib.rs16_decode1_fused.restype = ctypes.c_int
+        lib.rs16_decode1_fused.argtypes = [
+            u16p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_int, ctypes.c_int,
+            u16p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
         ]
         lib.b2b_new.restype = ctypes.c_void_p
         lib.b2b_new.argtypes = [ctypes.c_int]
@@ -310,6 +330,101 @@ def gf_decode1_fused(
         return None
     if rc != 0:
         raise RuntimeError(f"rs_decode1_fused failed: {rc}")
+    return out, state
+
+
+def _as_u16_ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+
+
+def _row_ptrs16(rows: Sequence[np.ndarray]):
+    """ctypes void* array over per-row uint16 buffers (see _row_ptrs)."""
+    keep = [np.ascontiguousarray(r, dtype=np.uint16) for r in rows]
+    arr = (ctypes.c_void_p * len(keep))(*[r.ctypes.data for r in keep])
+    return arr, keep
+
+
+def gf16_matmul_rows(
+    M: np.ndarray, rows: Sequence[np.ndarray], length: int
+) -> Optional[np.ndarray]:
+    """GF(2^16) tier of gf_matmul_rows: M (r, k) uint16 @ rows (k uint16
+    buffers of ``length`` symbols) -> (r, length) uint16; None when the
+    shim is unavailable."""
+    lib = _fast_lib()
+    if lib is None:
+        return None
+    Mb = np.ascontiguousarray(M, dtype=np.uint16)
+    r, k = Mb.shape
+    out = np.empty((r, length), dtype=np.uint16)
+    in_ptrs, in_keep = _row_ptrs16(rows)
+    out_ptrs, out_keep = _row_ptrs16(list(out))
+    rc = lib.rs16_matmul_rows(_as_u16_ptr(Mb), r, k, in_ptrs, out_ptrs, length)
+    del in_keep, out_keep
+    if rc != 0:
+        raise RuntimeError(f"rs16_matmul_rows failed: {rc}")
+    return out
+
+
+def gf16_syndrome_rows(
+    A: np.ndarray,
+    basis: Sequence[np.ndarray],
+    extra: Sequence[np.ndarray],
+    length: int,
+    want_syndrome: bool = True,
+) -> Optional[tuple[Optional[np.ndarray], np.ndarray]]:
+    """GF(2^16) tier of gf_syndrome_rows; counts come back uint16 (the
+    wide field admits more than 255 extra rows). Lengths in symbols."""
+    lib = _fast_lib()
+    if lib is None:
+        return None
+    Ab = np.ascontiguousarray(A, dtype=np.uint16)
+    r2, k = Ab.shape
+    counts = np.empty(length, dtype=np.uint16)
+    b_ptrs, b_keep = _row_ptrs16(basis)
+    e_ptrs, e_keep = _row_ptrs16(extra)
+    s = np.empty((r2, length), dtype=np.uint16) if want_syndrome else None
+    if s is not None:
+        s_ptrs, s_keep = _row_ptrs16(list(s))
+    else:
+        s_ptrs, s_keep = None, None
+    rc = lib.rs16_syndrome_rows(
+        _as_u16_ptr(Ab), r2, k, b_ptrs, e_ptrs, s_ptrs, _as_u16_ptr(counts),
+        length,
+    )
+    del b_keep, e_keep, s_keep
+    if rc != 0:
+        raise RuntimeError(f"rs16_syndrome_rows failed: {rc}")
+    return s, counts
+
+
+def gf16_decode1_fused(
+    A: np.ndarray,
+    basis: Sequence[np.ndarray],
+    extra: Sequence[np.ndarray],
+    j: int,
+    e: int,
+    length: int,
+) -> Optional[tuple[np.ndarray, np.ndarray]]:
+    """GF(2^16) tier of gf_decode1_fused (lengths in symbols; state is
+    one byte per column as in the GF(2^8) kernel)."""
+    lib = _fast_lib()
+    if lib is None:
+        return None
+    Ab = np.ascontiguousarray(A, dtype=np.uint16)
+    r2, k = Ab.shape
+    out = np.empty(length, dtype=np.uint16)
+    state = np.empty(length, dtype=np.uint8)
+    b_ptrs, b_keep = _row_ptrs16(basis)
+    e_ptrs, e_keep = _row_ptrs16(extra)
+    rc = lib.rs16_decode1_fused(
+        _as_u16_ptr(Ab), r2, k, b_ptrs, e_ptrs, int(j), int(e),
+        _as_u16_ptr(out), _as_u8_ptr(state), length,
+    )
+    del b_keep, e_keep
+    if rc == -2:
+        return None
+    if rc != 0:
+        raise RuntimeError(f"rs16_decode1_fused failed: {rc}")
     return out, state
 
 

@@ -194,6 +194,116 @@ void mul_add_row(uint8_t* out, const uint8_t* in, uint8_t c, size_t len) {
     out[i] = static_cast<uint8_t>(out[i] ^ lo[in[i] & 0x0F] ^ hi[in[i] >> 4]);
 }
 
+// ---------------------------------------------------------------------------
+// GF(2^16) tier (poly 0x1100B — gf/field.py POLY_GF65536). Mirrors the
+// GF(2^8) hot kernels on uint16 symbols so the wide field's host decode
+// (syndrome scan, magnitude solves, fused single-row decode) runs native
+// instead of NumPy table gathers (~12-16x slower measured at equal bytes).
+// The mul-by-constant kernel is the nibble-shuffle scheme (klauspost
+// galois16-style): c * x = T0[x&15] ^ T1[x>>4&15] ^ T2[x>>8&15] ^
+// T3[x>>12], four 16-entry uint16 tables built per coefficient; on AVX2
+// each table runs as two pshufb byte-lookups (lo/hi result bytes) with
+// the nibble index duplicated into both bytes of each 16-bit lane.
+
+constexpr int kPoly16 = 0x1100B;
+constexpr int kOrder16 = 1 << 16;
+
+struct Tables16 {
+  std::vector<uint16_t> exp;
+  std::vector<int32_t> log;
+  Tables16() : exp(2 * (kOrder16 - 1)), log(kOrder16) {
+    int x = 1;
+    for (int i = 0; i < kOrder16 - 1; ++i) {
+      exp[i] = static_cast<uint16_t>(x);
+      log[x] = i;
+      x <<= 1;
+      if (x & kOrder16) x ^= kPoly16;
+    }
+    log[0] = 0;  // never used: mul16 guards zero operands
+    for (int i = 0; i < kOrder16 - 1; ++i) exp[kOrder16 - 1 + i] = exp[i];
+  }
+};
+
+const Tables16& tables16() {
+  static const Tables16 t;
+  return t;
+}
+
+inline uint16_t gf16_mul(uint16_t a, uint16_t b) {
+  if (a == 0 || b == 0) return 0;
+  const Tables16& t = tables16();
+  return t.exp[t.log[a] + t.log[b]];
+}
+
+inline uint16_t gf16_inv_sym(uint16_t a) {
+  const Tables16& t = tables16();
+  return t.exp[kOrder16 - 1 - t.log[a]];
+}
+
+// out[len] ^= c * in[len] over GF(2^16); len in SYMBOLS.
+void mul_add_row16(uint16_t* out, const uint16_t* in, uint16_t c, size_t len) {
+  if (c == 0) return;
+  if (c == 1) {
+    size_t i = 0;
+    for (; i + 4 <= len; i += 4) {
+      uint64_t a, b;
+      std::memcpy(&a, out + i, 8);
+      std::memcpy(&b, in + i, 8);
+      a ^= b;
+      std::memcpy(out + i, &a, 8);
+    }
+    for (; i < len; ++i) out[i] ^= in[i];
+    return;
+  }
+  alignas(32) uint16_t tab[4][16];
+  for (int n = 0; n < 4; ++n)
+    for (int v = 0; v < 16; ++v)
+      tab[n][v] = gf16_mul(c, static_cast<uint16_t>(v << (4 * n)));
+  size_t i = 0;
+#if defined(__AVX2__)
+  {
+    __m256i tl[4], th[4];
+    for (int n = 0; n < 4; ++n) {
+      alignas(32) uint8_t lo[16], hi[16];
+      for (int v = 0; v < 16; ++v) {
+        lo[v] = static_cast<uint8_t>(tab[n][v] & 0xFF);
+        hi[v] = static_cast<uint8_t>(tab[n][v] >> 8);
+      }
+      tl[n] = _mm256_broadcastsi128_si256(
+          _mm_load_si128(reinterpret_cast<const __m128i*>(lo)));
+      th[n] = _mm256_broadcastsi128_si256(
+          _mm_load_si128(reinterpret_cast<const __m128i*>(hi)));
+    }
+    const __m256i m4 = _mm256_set1_epi16(0x000F);
+    const __m256i m00ff = _mm256_set1_epi16(0x00FF);
+    for (; i + 16 <= len; i += 16) {
+      __m256i x = _mm256_loadu_si256(reinterpret_cast<const __m256i*>(in + i));
+      __m256i acc = _mm256_setzero_si256();
+      for (int n = 0; n < 4; ++n) {
+        __m256i idx = _mm256_and_si256(_mm256_srli_epi16(x, 4 * n), m4);
+        // Duplicate the nibble index into both bytes of each u16 lane so
+        // one pshufb serves the lo-byte table and one the hi-byte table.
+        __m256i dup = _mm256_or_si256(idx, _mm256_slli_epi16(idx, 8));
+        __m256i lo = _mm256_shuffle_epi8(tl[n], dup);
+        __m256i hi = _mm256_shuffle_epi8(th[n], dup);
+        __m256i term = _mm256_or_si256(_mm256_and_si256(lo, m00ff),
+                                       _mm256_andnot_si256(m00ff, hi));
+        acc = _mm256_xor_si256(acc, term);
+      }
+      __m256i y = _mm256_loadu_si256(reinterpret_cast<__m256i*>(out + i));
+      _mm256_storeu_si256(reinterpret_cast<__m256i*>(out + i),
+                          _mm256_xor_si256(y, acc));
+    }
+  }
+#endif
+  for (; i < len; ++i) {
+    uint16_t x = in[i];
+    out[i] = static_cast<uint16_t>(
+        out[i] ^ tab[0][x & 15] ^ tab[1][(x >> 4) & 15] ^
+        tab[2][(x >> 8) & 15] ^ tab[3][x >> 12]);
+  }
+}
+
 struct Encoder {
   int k;
   int r;
@@ -390,6 +500,107 @@ int rs_decode1_fused(const uint8_t* A, int r2, int k,
       const bool isbad = cnt[q] > ecap;
       const bool fix = isbad && bad[q] == 0;
       oj[q] = static_cast<uint8_t>(bj[q] ^ (fix ? z[q] : 0));
+      st[q] = static_cast<uint8_t>(isbad ? (fix ? 1 : 2) : 0);
+    }
+  }
+  return 0;
+}
+
+// GF(2^16) tier of rs_matmul_rows: out[i] = sum_j M[i][j] * in[j] over
+// uint16 symbols; M row-major (r x k) uint16, len in SYMBOLS.
+int rs16_matmul_rows(const uint16_t* M, int r, int k,
+                     const uint16_t* const* in, uint16_t* const* out,
+                     size_t len) {
+  if (!M || !in || !out || r < 1 || k < 1) return -1;
+  constexpr size_t kTile = 16 << 10;  // symbols: 32 KiB per row tile
+  for (size_t off = 0; off < len || off == 0; off += kTile) {
+    size_t t = len - off < kTile ? len - off : kTile;
+    for (int i = 0; i < r; ++i) {
+      std::memset(out[i] + off, 0, 2 * t);
+      for (int j = 0; j < k; ++j)
+        mul_add_row16(out[i] + off, in[j] + off,
+                      M[static_cast<size_t>(i) * k + j], t);
+    }
+    if (len == 0) break;
+  }
+  return 0;
+}
+
+// GF(2^16) tier of rs_syndrome_rows; counts is uint16 per column (the
+// wide field admits r2 > 255 — total shards bound is the field order).
+int rs16_syndrome_rows(const uint16_t* A, int r2, int k,
+                       const uint16_t* const* basis,
+                       const uint16_t* const* extra,
+                       uint16_t* const* s_out, uint16_t* counts, size_t len) {
+  if (!A || !basis || !extra || r2 < 1 || k < 1) return -1;
+  if (!s_out && !counts) return -1;
+  constexpr size_t kTile = 16 << 10;
+  std::vector<uint16_t> tmp(kTile);
+  if (counts) std::memset(counts, 0, 2 * len);
+  for (size_t off = 0; off < len; off += kTile) {
+    size_t t = len - off < kTile ? len - off : kTile;
+    for (int i = 0; i < r2; ++i) {
+      std::memcpy(tmp.data(), extra[i] + off, 2 * t);
+      for (int j = 0; j < k; ++j)
+        mul_add_row16(tmp.data(), basis[j] + off,
+                      A[static_cast<size_t>(i) * k + j], t);
+      if (counts) {
+        uint16_t* cnt = counts + off;
+        for (size_t c = 0; c < t; ++c) cnt[c] += tmp[c] != 0;
+      }
+      if (s_out) std::memcpy(s_out[i] + off, tmp.data(), 2 * t);
+    }
+  }
+  return 0;
+}
+
+// GF(2^16) tier of rs_decode1_fused (same per-column state machine;
+// lengths in SYMBOLS, state stays one byte per column).
+int rs16_decode1_fused(const uint16_t* A, int r2, int k,
+                       const uint16_t* const* basis,
+                       const uint16_t* const* extra,
+                       int j, int e, uint16_t* out_row, uint8_t* state,
+                       size_t len) {
+  if (!A || !basis || !extra || !out_row || !state) return -1;
+  if (r2 < 1 || k < 1 || j < 0 || j >= k || e < 1) return -1;
+  int p0 = -1;
+  for (int i = 0; i < r2; ++i)
+    if (A[static_cast<size_t>(i) * k + j]) { p0 = i; break; }
+  if (p0 < 0) return -2;
+  const uint16_t inv_p0 = gf16_inv_sym(A[static_cast<size_t>(p0) * k + j]);
+  constexpr size_t kTile = 4 << 10;  // symbols: 8 KiB tiles like gf256
+  std::vector<uint16_t> tmp(kTile), z(kTile), bad(kTile);
+  std::vector<uint16_t> cnt(kTile);
+  const uint16_t ecap =
+      static_cast<uint16_t>(e < 0xFFFF ? e : 0xFFFF);
+  for (size_t off = 0; off < len; off += kTile) {
+    const size_t t = len - off < kTile ? len - off : kTile;
+    std::memcpy(tmp.data(), extra[p0] + off, 2 * t);
+    for (int c = 0; c < k; ++c)
+      mul_add_row16(tmp.data(), basis[c] + off,
+                    A[static_cast<size_t>(p0) * k + c], t);
+    for (size_t q = 0; q < t; ++q) cnt[q] = tmp[q] != 0;
+    std::memset(z.data(), 0, 2 * t);
+    mul_add_row16(z.data(), tmp.data(), inv_p0, t);
+    std::memset(bad.data(), 0, 2 * t);
+    for (int i = 0; i < r2; ++i) {
+      if (i == p0) continue;
+      std::memcpy(tmp.data(), extra[i] + off, 2 * t);
+      for (int c = 0; c < k; ++c)
+        mul_add_row16(tmp.data(), basis[c] + off,
+                      A[static_cast<size_t>(i) * k + c], t);
+      for (size_t q = 0; q < t; ++q) cnt[q] += tmp[q] != 0;
+      mul_add_row16(tmp.data(), z.data(),
+                    A[static_cast<size_t>(i) * k + j], t);
+      for (size_t q = 0; q < t; ++q) bad[q] |= tmp[q];
+    }
+    const uint16_t* bj = basis[j] + off;
+    uint16_t* oj = out_row + off;
+    uint8_t* st = state + off;
+    for (size_t q = 0; q < t; ++q) {
+      const bool isbad = cnt[q] > ecap;
+      const bool fix = isbad && bad[q] == 0;
+      oj[q] = static_cast<uint16_t>(bj[q] ^ (fix ? z[q] : 0));
       st[q] = static_cast<uint8_t>(isbad ? (fix ? 1 : 2) : 0);
     }
   }

@@ -70,6 +70,24 @@ int rs_syndrome_rows(const uint8_t* A, int r2, int k,
                      const uint8_t* const* basis, const uint8_t* const* extra,
                      uint8_t* const* s_out, uint8_t* counts, size_t len);
 
+/* GF(2^16) tier (poly 0x1100B), mirroring the three decode hot kernels
+ * on uint16 symbols; all lengths are in SYMBOLS, matrices row-major
+ * uint16. counts is uint16 per column (the wide field admits more than
+ * 255 extra rows). Same return conventions as the GF(2^8) versions. */
+int rs16_matmul_rows(const uint16_t* M, int r, int k,
+                     const uint16_t* const* in, uint16_t* const* out,
+                     size_t len);
+int rs16_syndrome_rows(const uint16_t* A, int r2, int k,
+                       const uint16_t* const* basis,
+                       const uint16_t* const* extra,
+                       uint16_t* const* s_out, uint16_t* counts,
+                       size_t len);
+int rs16_decode1_fused(const uint16_t* A, int r2, int k,
+                       const uint16_t* const* basis,
+                       const uint16_t* const* extra,
+                       int j, int e, uint16_t* out_row, uint8_t* state,
+                       size_t len);
+
 /* Fused speculative single-corrupt-row decode: one tiled pass computes
  * the syndrome, verifies the single-support hypothesis {basis row j}
  * column-wise, and writes the corrected row j into out_row. state[col]:

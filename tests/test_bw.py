@@ -549,8 +549,9 @@ def test_syndrome_decode_missing_data_share_with_corruption(rng):
 
 
 def test_syndrome_decode_gf65536_numpy_fallback(rng):
-    """GF(2^16) has no native shim: the NumPy syndrome path must correct
-    a corrupted share identically."""
+    """GF(2^16) decode below the shim tile/speculation sizes (and when
+    the shim is absent) must correct a corrupted share identically on
+    the NumPy syndrome path."""
     from noise_ec_tpu.matrix.bw import syndrome_decode_rows
 
     gf = GF65536()
@@ -997,3 +998,58 @@ def test_adaptive_par1_three_corrupt_shares(rng):
     assert fec.decode(bad) == data
     assert fec.stats["subset_decodes"] == 0, "fell back to the subset search"
     assert fec.stats["bw_decodes"] == 1
+
+
+def test_gf16_shim_syndrome_and_matmul_match_numpy(rng):
+    """The GF(2^16) shim tier (rs16_matmul_rows / rs16_syndrome_rows,
+    nibble-shuffle kernels over 0x1100B) is bit-exact vs the NumPy field
+    at sizes spanning the AVX2 vector width and the scalar tail."""
+    from noise_ec_tpu.shim import gf16_matmul_rows, gf16_syndrome_rows
+
+    gf = GF65536()
+    for S in (5, 16, 33, 4096, 4099):
+        r, k = 3, 5
+        M = rng.integers(0, 1 << 16, size=(r, k)).astype(np.uint16)
+        rows = [
+            rng.integers(0, 1 << 16, size=S).astype(np.uint16)
+            for _ in range(k)
+        ]
+        extra = [
+            rng.integers(0, 1 << 16, size=S).astype(np.uint16)
+            for _ in range(r)
+        ]
+        got = gf16_matmul_rows(M, rows, S)
+        if got is None:
+            import pytest
+
+            pytest.skip("shim unavailable")
+        want = gf.matvec_stripes(
+            M.astype(np.int64), np.stack(rows)
+        ).astype(np.uint16)
+        np.testing.assert_array_equal(got, want)
+        s, counts = gf16_syndrome_rows(M, rows, extra, S)
+        want_s = (want ^ np.stack(extra)).astype(np.uint16)
+        np.testing.assert_array_equal(s, want_s)
+        np.testing.assert_array_equal(counts, np.count_nonzero(want_s, axis=0))
+
+
+def test_fused_gf65536_whole_share(rng):
+    """GF(2^16) whole-share corruption at speculation width runs the
+    16-bit fused kernel and matches the generic decode exactly."""
+    import noise_ec_tpu.matrix.bw as bw
+
+    gf = GF65536()
+    k, n, S = 6, 10, 300_000  # symbols
+    gold = GoldenCodec(k, n, field="gf65536")
+    data = rng.integers(0, 1 << 16, size=(k, S)).astype(np.uint16)
+    cw = gold.encode_all(data).astype(np.uint16)
+    rows = [np.ascontiguousarray(cw[i]) for i in range(n)]
+    rows[2] = rows[2] ^ np.uint16(0xA5A5)
+    r7 = rows[7].copy(); r7[rng.integers(0, S, 21)] ^= 0x777; rows[7] = r7
+    spec = bw.syndrome_decode_rows(gf, "cauchy", k, n, list(range(n)), rows)
+    gen = bw.syndrome_decode_rows(
+        gf, "cauchy", k, n, list(range(n)), rows, _speculate=False
+    )
+    assert spec is not None and gen is not None
+    np.testing.assert_array_equal(np.stack(spec[0]), np.stack(gen[0]))
+    np.testing.assert_array_equal(np.stack(spec[0]), data)

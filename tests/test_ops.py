@@ -334,3 +334,21 @@ def test_wide_field_near_limit_refuses_clearly():
     many_rows[:, :3] = np.eye(3, dtype=np.uint16)
     with pytest.raises(NotImplementedError):
         dev.matmul_stripes(many_rows, D)
+    # The guard sits in bits_rows_for, the shared choke point, so the
+    # planes / byte-sliced / direct entries are covered too.
+    with pytest.raises(NotImplementedError):
+        dev.bits_rows_for(big)
+    dev8 = DeviceCodec(field="gf256", kernel="pallas_interpret")
+    big8 = np.arange(56 * 200, dtype=np.int64).astype(np.uint8).reshape(56, 200)
+    with pytest.raises(NotImplementedError):
+        dev8.bits_rows_for(big8)
+    # Codec callers are not broken by the refusal: ReedSolomon's device
+    # backend falls back to the native host tier and still matches golden.
+    from noise_ec_tpu.codec.rs import ReedSolomon
+    from noise_ec_tpu.golden.codec import GoldenCodec
+
+    rs = ReedSolomon(40, 16, field="gf65536", backend="device")
+    Dm = rng.integers(0, 1 << 16, size=(40, 512)).astype(np.uint16)
+    got = np.stack(rs.encode(list(Dm))[40:]).view("<u2")
+    want = np.asarray(GoldenCodec(40, 56, field="gf65536").encode(Dm))
+    np.testing.assert_array_equal(got, want)
