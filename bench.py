@@ -408,6 +408,35 @@ def main() -> None:
             )
             stats[f"rs{k3}_{r3}_encode_gbps"] = round(k3 * S3 * 4 / t3 / 1e9, 2)
 
+        # --- config 3b (round 5): near-field-limit RS(200,56) — routed to
+        # the dense MXU kernel (the XOR-network family cannot plan or
+        # compile ~361k XORs; dispatch.route_for). MACs/byte scale with r
+        # (64*56 = 3584), so the int8 roofline is ~110 GB/s; the (448,
+        # 1600) operand fills the MXU tiles (~84% vs RS(50,20)'s 49%).
+        try:
+            kN, rN = 200, 56
+            GN = generator_matrix(gf, kN, kN + rN, "cauchy")
+            smN = rng.integers(0, 256, size=(kN, 4096)).astype(np.uint8)
+            check_smoke(
+                np.array_equal(
+                    dev.matmul_stripes(GN[kN:], smN),
+                    np.asarray(GoldenCodec(kN, kN + rN).encode(smN)),
+                ),
+                "TPU RS(200,56) encode != golden codec",
+            )
+            SN = 64 << 10  # words/shard: 256 KiB -> 50 MiB object
+            wN = jnp.asarray(
+                rng.integers(0, 1 << 32, size=(kN, SN), dtype=np.uint64).astype(np.uint32)
+            )
+            tN = chained_seconds_per_iter(
+                lambda s: dev.matmul_words(GN[kN:], s), wN, n_hi=60
+            )
+            stats["rs200_56_encode_gbps"] = round(kN * SN * 4 / tN / 1e9, 2)
+        except SmokeMismatch:
+            raise
+        except Exception as exc:  # noqa: BLE001 — secondary stat only
+            stats["rs200_56_error"] = str(exc)[:80]
+
         # --- config 4a: Cauchy vs PAR1-Vandermonde generator, RS(10,4).
         Gp = generator_matrix(gf, k, k + r, "par1")
         tp = chained_seconds_per_iter(

@@ -128,11 +128,14 @@ class Ed25519Policy:
         pk = self._parsed_priv.get(seed)
         if pk is None:
             if len(self._parsed_priv) >= 8:
-                # FIFO-evict one entry: clearing everything would dump
-                # the hot identities whenever a 9th transient seed lands.
+                # Evict the LEAST-recently-used entry (dicts are
+                # insertion-ordered and hits below re-append), so churning
+                # transient seeds cannot push out the node's hot identity.
                 self._parsed_priv.pop(next(iter(self._parsed_priv)))
             pk = Ed25519PrivateKey.from_private_bytes(seed)
-            self._parsed_priv[seed] = pk
+        else:
+            del self._parsed_priv[seed]  # re-append: mark most-recent
+        self._parsed_priv[seed] = pk
         return pk.sign(message)
 
     def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:

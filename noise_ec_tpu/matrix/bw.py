@@ -197,11 +197,17 @@ def _syndrome(
     (GF(2^8) and, since round 5, GF(2^16)), row-blocked NumPy otherwise.
     Row buffers are consumed in place (no stacking copy on the shim path).
     """
-    if device is not None:
-        try:
-            return device.syndrome_stripes(A, np.stack(rows))
-        except NotImplementedError:
-            pass  # wide-field near-limit: host tier is the designed path
+    if device is not None and device.supports_matrix(
+        np.concatenate(
+            [np.asarray(A, dtype=gf.dtype),
+             np.eye(len(rows) - k, dtype=gf.dtype)],
+            axis=1,
+        )
+    ):
+        # supports_matrix first (tiny matrix algebra only): refusing
+        # AFTER np.stack would copy every multi-MiB row just to throw
+        # the stack away on the wide-field fallback path.
+        return device.syndrome_stripes(A, np.stack(rows))
     if gf.degree in (8, 16):
         try:
             from noise_ec_tpu.shim import gf16_syndrome_rows, gf_syndrome_rows
@@ -222,13 +228,8 @@ def _syndrome(
 
 def _matmul_rows(gf: GF, M: np.ndarray, rows: list, *, device=None) -> np.ndarray:
     """M @ rows over GF on the fastest available backend (see _syndrome)."""
-    if device is not None:
-        try:
-            return np.asarray(
-                device.matmul_stripes(np.asarray(M), np.stack(rows))
-            )
-        except NotImplementedError:
-            pass  # wide-field near-limit: host tier is the designed path
+    if device is not None and device.supports_matrix(np.asarray(M)):
+        return np.asarray(device.matmul_stripes(np.asarray(M), np.stack(rows)))
     if gf.degree in (8, 16):
         try:
             from noise_ec_tpu.shim import gf16_matmul_rows, gf_matmul_rows

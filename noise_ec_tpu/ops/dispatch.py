@@ -303,15 +303,17 @@ class DeviceCodec:
         r, k = np.asarray(M).shape
         # Two bounds, matching the gf256 budgets (Paar planning time is
         # field-blind — it sees terms — and the pack stage sees byte
-        # rows): raw XORs <= _BAKED_XOR_BUDGET, byte rows <= 128 (the
-        # measured scoped-VMEM model: 200 input rows OOMed at 24.8M vs
-        # the 16M limit, ~linear in rows -> failure near ~129; refusal
-        # can sit at the model limit because codec callers fall back to
-        # the native host tier, unlike gf256's cautious-96 MXU routing).
-        if 2 * max(r, k) > 128:
+        # rows): raw XORs <= _BAKED_XOR_BUDGET, byte rows <= 112. The
+        # measured scoped-VMEM model (200 input rows OOMed at 24.8M vs
+        # the 16M limit, ~linear in rows) puts failure near ~129 rows;
+        # 112 keeps ~13% margin, because an admitted-at-the-limit matrix
+        # fails at RUNTIME with a Mosaic OOM that the NotImplementedError
+        # fallbacks in codec/bw cannot catch — the refusal must fire
+        # strictly before the model limit, not at it.
+        if 2 * max(r, k) > 112:
             raise NotImplementedError(
                 f"GF(2^16) geometry ({r}, {k}) exceeds the baked kernels' "
-                "row budget (128 byte rows); the native host tier "
+                "row budget (112 byte rows); the native host tier "
                 "(hostmath/shim) is the supported wide-field path there"
             )
         if self._xor_cost_for(M) > _BAKED_XOR_BUDGET:
@@ -320,6 +322,22 @@ class DeviceCodec:
                 f"({self._xor_cost_for(M)} raw XORs); the native host "
                 "tier (hostmath/shim) is the supported wide-field path"
             )
+
+    def supports_matrix(self, M: np.ndarray) -> bool:
+        """Cheap predicate: does a device kernel exist for ``M``?
+
+        False means the caller should take the host tier without building
+        any row data (no stacking copies — the decode dispatch consults
+        this BEFORE materializing multi-MiB stacks it would then throw
+        away on the refusal path).
+        """
+        if self.gf.degree != 16:
+            return True  # gf256 always has a route (baked or MXU)
+        try:
+            self._guard_wide_field(M)
+            return True
+        except NotImplementedError:
+            return False
 
     def matmul_stripes(self, M: np.ndarray, D) -> np.ndarray:
         """(r, k) GF matrix x (k, S) stripes -> (r, S), computed on device."""

@@ -96,3 +96,17 @@ def test_native_blake2b_hashlib_semantics():
         ref.update(part)
         assert h.digest() == ref.digest()  # mid-stream digest
         assert h.digest() == ref.digest()  # repeated digest
+
+
+def test_signing_key_cache_is_lru_not_fifo():
+    """A cache hit refreshes recency: churning 8+ transient seeds must
+    not evict the hot identity that keeps signing in between."""
+    from noise_ec_tpu.host.crypto import Ed25519Policy, KeyPair
+
+    pol = Ed25519Policy()
+    hot = KeyPair.random()
+    pol.sign(hot.private_key, b"x")  # inserted first
+    for i in range(20):  # transient seeds churn past the bound of 8
+        pol.sign(KeyPair.random().private_key, b"x")
+        pol.sign(hot.private_key, b"x")  # hot key used in between
+        assert bytes(hot.private_key) in pol._parsed_priv, i

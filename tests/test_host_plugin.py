@@ -575,10 +575,12 @@ def test_geometry_rate_limit_spares_repeat_geometries():
     assert plugin.counters.get("geometry_rate_limited") == 0
 
 
-def test_geometry_flood_global_budget_resists_identity_rotation():
-    """Rotating sender identities must not bypass the compile budget: the
-    GLOBAL novel-geometry cap throttles the aggregate regardless of how
-    many fresh keys the flood mints."""
+def test_geometry_flood_identity_rotation_bounded_by_inflight_compiles():
+    """Rotating sender identities cannot monopolize compiles: the global
+    cap bounds admissions whose first decode is still pending. With
+    instant decodes (CPU test env) no slot stays occupied, so a rotating
+    flood is NOT rate limited (bystander-friendly: demotion only under
+    real compile pressure) — and every object still decodes."""
     from noise_ec_tpu.codec.fec import FEC
     from noise_ec_tpu.host.crypto import KeyPair, PeerID, serialize_message
     from noise_ec_tpu.host.wire import Shard as WireShard
@@ -586,7 +588,7 @@ def test_geometry_flood_global_budget_resists_identity_rotation():
     plugin = ShardPlugin(backend="device")
     delivered = []
     plugin.on_message = lambda m, s: delivered.append(m)
-    n_objects = plugin.NOVEL_GEOMETRY_GLOBAL_PER_WINDOW + 6
+    n_objects = 12
     for i in range(n_objects):
         keys = KeyPair.from_seed(bytes([i]) * 32)  # fresh identity each time
         peer = PeerID.create(f"tcp://localhost:{6000 + i}", keys.public_key)
@@ -616,8 +618,64 @@ def test_geometry_flood_global_budget_resists_identity_rotation():
                 total_shards=n, minimum_needed_shards=k,
             )))
     assert len(delivered) == n_objects  # every object still decodes
-    assert plugin.counters.get("geometry_rate_limited") >= 6
-    assert len(plugin._fec_cache) <= plugin.NOVEL_GEOMETRY_GLOBAL_PER_WINDOW + 1
+    # Each decode completed synchronously, freeing its slot before the
+    # next admission: no bystander-hostile global-window demotion.
+    assert plugin.counters.get("geometry_rate_limited") == 0
+    assert not plugin._novel_inflight
+
+
+def test_inflight_compile_cap_limits_and_releases(monkeypatch):
+    """Direct _fec_receive semantics: while NOVEL_COMPILES_INFLIGHT_MAX
+    first-decodes are pending, further novel geometries (even from fresh
+    identities) fall to the host codec; _geometry_ready frees a slot, and
+    the grace timeout reclaims slots whose decode never completed."""
+    import time as _time
+
+    from noise_ec_tpu.host.crypto import KeyPair, PeerID
+
+    plugin = ShardPlugin(backend="device")
+
+    def ctx_for(i):
+        keys = KeyPair.from_seed(bytes([40 + i]) * 32)
+        peer = PeerID.create(f"tcp://localhost:{6500 + i}", keys.public_key)
+
+        class Ctx:
+            def message(self):
+                return None
+
+            def sender(self):
+                return peer
+
+            def client_public_key(self):
+                return peer.public_key
+
+        return Ctx()
+
+    cap = plugin.NOVEL_COMPILES_INFLIGHT_MAX
+    for i in range(cap):
+        fec = plugin._fec_receive(2, 3 + i, ctx_for(i))
+        assert fec._rs.backend == "device", i
+    assert len(plugin._novel_inflight) == cap
+    # Slots saturated: a fresh identity's novel geometry is demoted.
+    fec = plugin._fec_receive(2, 3 + cap, ctx_for(cap))
+    assert fec._rs.backend == "numpy"
+    assert plugin.counters.get("geometry_rate_limited") == 1
+    # One first-decode completes -> the slot frees -> next novel admits.
+    plugin._geometry_ready(2, 3)
+    fec = plugin._fec_receive(2, 30, ctx_for(cap + 1))
+    assert fec._rs.backend == "device"
+    # Grace expiry reclaims stuck slots.
+    real = _time.monotonic()
+    monkeypatch.setattr(
+        "noise_ec_tpu.host.plugin.time",
+        type("T", (), {"monotonic": staticmethod(
+            lambda: real + plugin.NOVEL_COMPILE_GRACE_SECONDS + 1
+        ), "time": _time.time, "sleep": _time.sleep}),
+    )
+    fec = plugin._fec_receive(2, 31, ctx_for(cap + 2))
+    assert fec._rs.backend == "device"
+    assert (2, 31) in plugin._novel_inflight
+    assert (2, 30) not in plugin._novel_inflight  # reclaimed
 
 
 def test_geometry_rate_limit_window_refills(monkeypatch):
@@ -648,9 +706,12 @@ def test_geometry_rate_limit_window_refills(monkeypatch):
                        "time": _time.time, "sleep": _time.sleep}),
     )
     ctx = Ctx()
-    # Exhaust the per-sender budget with fresh geometries.
+    # Exhaust the per-sender budget with fresh geometries; complete each
+    # first decode (_geometry_ready) so the global in-flight cap stays
+    # out of the way — this test isolates the per-sender WINDOW.
     for i in range(plugin.NOVEL_GEOMETRY_PER_WINDOW):
         plugin._fec_receive(2, 3 + i, ctx)
+        plugin._geometry_ready(2, 3 + i)
     assert plugin.counters.get("geometry_rate_limited") == 0
     limited = plugin._fec_receive(2, 100, ctx)
     assert plugin.counters.get("geometry_rate_limited") == 1
@@ -660,3 +721,48 @@ def test_geometry_rate_limit_window_refills(monkeypatch):
     refreshed = plugin._fec_receive(2, 101, ctx)
     assert plugin.counters.get("geometry_rate_limited") == 1
     assert refreshed._rs.backend == plugin.backend
+
+
+def test_failed_decode_releases_inflight_slot():
+    """A poisoned novel geometry whose decode RAISES must still free its
+    in-flight compile slot (the compile happened either way): 2 poisoned
+    objects per grace window must not demote every bystander."""
+    from noise_ec_tpu.codec.fec import FEC
+    from noise_ec_tpu.host.crypto import KeyPair, PeerID, serialize_message
+    from noise_ec_tpu.host.wire import Shard as WireShard
+
+    plugin = ShardPlugin(backend="device")
+    keys = KeyPair.from_seed(bytes([90]) * 32)
+    peer = PeerID.create("tcp://localhost:7300", keys.public_key)
+
+    class Ctx:
+        def __init__(self, msg):
+            self._msg = msg
+
+        def message(self):
+            return self._msg
+
+        def sender(self):
+            return peer
+
+        def client_public_key(self):
+            return peer.public_key
+
+    k, n = 2, 4
+    payload = bytes(range(16))
+    sig = keys.sign(plugin.signature_policy, plugin.hash_policy,
+                    serialize_message(peer, payload))
+    shares = FEC(k, n, backend="numpy").encode_shares(payload)
+    # Ship ALL n share numbers but with every share's bytes garbled
+    # differently: beyond any correction radius, decode raises, the
+    # object is unrecoverable (CorruptionError) — and the slot must free.
+    try:
+        for i, s in enumerate(shares):
+            bad = bytes(b ^ (0x11 * (i + 1)) for b in s.data)
+            plugin.receive(Ctx(WireShard(
+                file_signature=sig, shard_data=bad, shard_number=s.number,
+                total_shards=n, minimum_needed_shards=k,
+            )))
+    except Exception:
+        pass
+    assert (k, n) not in plugin._novel_inflight
