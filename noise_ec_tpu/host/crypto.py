@@ -22,6 +22,7 @@ from __future__ import annotations
 import functools
 import hashlib
 import struct
+import threading
 from dataclasses import dataclass
 from typing import Optional
 
@@ -120,22 +121,25 @@ class Ed25519Policy:
         # beyond the owning KeyPair's lifetime and leaves it reachable via
         # cache introspection (r4 advisor). Discarding the policy (the
         # plugin holds it) releases the parsed keys with it. Tiny bound:
-        # a node signs with its own few identities.
+        # a node signs with its own few identities. Locked: one policy
+        # instance signs from the transport's asyncio thread AND the
+        # dispatch worker pool concurrently, and the LRU re-append
+        # mutates the dict on every call.
         self._parsed_priv: dict[bytes, Ed25519PrivateKey] = {}
+        self._priv_lock = threading.Lock()
 
     def sign(self, private_key: bytes, message: bytes) -> bytes:
         seed = bytes(private_key)
-        pk = self._parsed_priv.get(seed)
-        if pk is None:
-            if len(self._parsed_priv) >= 8:
-                # Evict the LEAST-recently-used entry (dicts are
-                # insertion-ordered and hits below re-append), so churning
-                # transient seeds cannot push out the node's hot identity.
-                self._parsed_priv.pop(next(iter(self._parsed_priv)))
-            pk = Ed25519PrivateKey.from_private_bytes(seed)
-        else:
-            del self._parsed_priv[seed]  # re-append: mark most-recent
-        self._parsed_priv[seed] = pk
+        with self._priv_lock:
+            pk = self._parsed_priv.pop(seed, None)
+            if pk is None:
+                if len(self._parsed_priv) >= 8:
+                    # Evict the LEAST-recently-used entry (insertion
+                    # order + re-append-on-hit), so churning transient
+                    # seeds cannot push out the node's hot identity.
+                    self._parsed_priv.pop(next(iter(self._parsed_priv)))
+                pk = Ed25519PrivateKey.from_private_bytes(seed)
+            self._parsed_priv[seed] = pk
         return pk.sign(message)
 
     def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:

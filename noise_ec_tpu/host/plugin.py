@@ -189,6 +189,8 @@ class ShardPlugin:
         # geometry -> admission time, while its first decode (the kernel
         # compile) is still pending; see NOVEL_COMPILES_INFLIGHT_MAX.
         self._novel_inflight: dict[tuple, float] = {}
+        # Admission timestamps for the global window backstop.
+        self._novel_global: list = []
         self._novel_lock = threading.Lock()
         self._fec_host_cache: OrderedDict[tuple[int, int], FEC] = OrderedDict()
 
@@ -227,6 +229,16 @@ class ShardPlugin:
     # the grace timeout when one never does).
     NOVEL_COMPILES_INFLIGHT_MAX = 2
     NOVEL_COMPILE_GRACE_SECONDS = 60.0
+    # Aggregate WINDOW backstop on top of the in-flight cap: the in-flight
+    # cap alone bounds concurrency, not total work — a flooder whose
+    # geometries compile fast could keep both slots perpetually owned and
+    # churn the codec LRU. This ceiling bounds compiles + cache insertions
+    # per window. It is deliberately HIGH (2x the old global cap): the
+    # in-flight cap is the primary control, and a window ceiling demotes
+    # bystanders once exhausted — an inherent tension under identity
+    # rotation (attacker and bystander are indistinguishable), so the
+    # backstop should only engage under a genuinely heavy flood.
+    NOVEL_GEOMETRY_GLOBAL_PER_WINDOW = 64
 
     @staticmethod
     def _sender_key(ctx: PluginContext) -> bytes:
@@ -278,14 +290,19 @@ class ShardPlugin:
             stale = now - self.NOVEL_COMPILE_GRACE_SECONDS
             for g in [g for g, t0 in self._novel_inflight.items() if t0 < stale]:
                 del self._novel_inflight[g]
+            while self._novel_global and self._novel_global[0] < cutoff:
+                self._novel_global.pop(0)
             limited = (
                 len(dq) >= self.NOVEL_GEOMETRY_PER_WINDOW
                 or len(self._novel_inflight)
                 >= self.NOVEL_COMPILES_INFLIGHT_MAX
+                or len(self._novel_global)
+                >= self.NOVEL_GEOMETRY_GLOBAL_PER_WINDOW
             )
             if not limited:
                 dq.append(now)
                 self._novel_inflight[(k, n)] = now
+                self._novel_global.append(now)
         if not limited:
             return self._fec(k, n)
         self.counters.add("geometry_rate_limited", 1)

@@ -197,16 +197,10 @@ def _syndrome(
     (GF(2^8) and, since round 5, GF(2^16)), row-blocked NumPy otherwise.
     Row buffers are consumed in place (no stacking copy on the shim path).
     """
-    if device is not None and device.supports_matrix(
-        np.concatenate(
-            [np.asarray(A, dtype=gf.dtype),
-             np.eye(len(rows) - k, dtype=gf.dtype)],
-            axis=1,
-        )
-    ):
-        # supports_matrix first (tiny matrix algebra only): refusing
-        # AFTER np.stack would copy every multi-MiB row just to throw
-        # the stack away on the wide-field fallback path.
+    if device is not None and device.supports_syndrome(np.asarray(A)):
+        # Predicate first (tiny matrix algebra only): refusing AFTER
+        # np.stack would copy every multi-MiB row just to throw the
+        # stack away on the wide-field fallback path.
         return device.syndrome_stripes(A, np.stack(rows))
     if gf.degree in (8, 16):
         try:

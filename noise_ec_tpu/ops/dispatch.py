@@ -199,6 +199,62 @@ _BAKED_XOR_BUDGET = 60_000
 _BAKED_MAX_ROWS = 96
 
 
+def decode1_fold_matrix(gf: GF, A: np.ndarray, j: int) -> np.ndarray:
+    """(r2, m) matrix folding the single-corrupt-row decode into ONE
+    generator-shaped product (the device analogue of the host shim's
+    rs_decode1_fused; same per-column guarantee as matrix/bw.py).
+
+    With aug = [A | I] the parity check over the m received rows and
+    p0 the first check row seeing basis column j:
+
+    - row 0 = e_j ^ inv(A[p0,j]) * aug[p0]  — applied to the received
+      rows this is rows[j] ^ inv(A[p0,j]) * s_p0, i.e. row j with the
+      single-support correction applied (the e_j and aug terms cancel
+      at column j, so the corrupted row is reconstructed from the
+      others — correcting a fully-corrupt row IS reconstruction);
+    - rows 1.. = aug[q] ^ (A[q,j]/A[p0,j]) * aug[p0] for q != p0 —
+      each is s_q ^ c_q * s_p0, zero exactly where check row q is
+      consistent with the hypothesis "only row j is in error". A
+      column with ANY nonzero verify byte must be re-decoded by the
+      general host path; columns that verify (including clean columns,
+      where s_p0 = 0 makes the correction a no-op) are exact.
+
+    Module-level so the parallel layer can build the fold for mesh-
+    sharded decode steps without constructing a DeviceCodec.
+    """
+    A = np.asarray(A, dtype=gf.dtype)
+    r2, k = A.shape
+    if r2 < 2:
+        # One parity row leaves NO consistency rows: the mask would
+        # claim every column verified with zero verification behind
+        # it. Matches the host kernel's e >= 1 requirement (a single
+        # redundant share cannot correct anyway).
+        raise ValueError(
+            f"single-support decode needs >= 2 check rows, got {r2}"
+        )
+    if not 0 <= j < k:
+        raise ValueError(f"j must index a basis row, got {j}")
+    nz = np.flatnonzero(A[:, j])
+    if nz.size == 0:
+        raise ValueError(f"check column {j} is identically zero")
+    p0 = int(nz[0])
+    aug = np.concatenate([A, np.eye(r2, dtype=gf.dtype)], axis=1)
+    inv_c = int(gf.inv(int(A[p0, j])))
+    D = np.zeros((r2, k + r2), dtype=gf.dtype)
+    D[0, j] = 1
+    D[0] ^= gf.mul(inv_c, aug[p0].astype(np.int64)).astype(gf.dtype)
+    out_i = 1
+    for q in range(r2):
+        if q == p0:
+            continue
+        c_q = int(gf.mul(int(A[q, j]), inv_c))
+        D[out_i] = aug[q] ^ gf.mul(
+            c_q, aug[p0].astype(np.int64)
+        ).astype(gf.dtype)
+        out_i += 1
+    return D
+
+
 class DeviceCodec:
     """Runs GF matrix x stripes products on the default JAX device.
 
@@ -339,6 +395,19 @@ class DeviceCodec:
         except NotImplementedError:
             return False
 
+    def supports_syndrome(self, A: np.ndarray) -> bool:
+        """supports_matrix for the syndrome route, owning the [A | I]
+        augmentation that syndrome_stripes will build — so the refusal
+        condition is encoded ONCE and callers never duplicate the aug
+        shape. Short-circuits before any allocation for gf256."""
+        if self.gf.degree != 16:
+            return True
+        A = np.asarray(A, dtype=self.gf.dtype)
+        aug = np.concatenate(
+            [A, np.eye(A.shape[0], dtype=self.gf.dtype)], axis=1
+        )
+        return self.supports_matrix(aug)
+
     def matmul_stripes(self, M: np.ndarray, D) -> np.ndarray:
         """(r, k) GF matrix x (k, S) stripes -> (r, S), computed on device."""
         M = np.asarray(M)
@@ -423,57 +492,8 @@ class DeviceCodec:
         return s, np.count_nonzero(s, axis=0)
 
     def decode1_matrix(self, A: np.ndarray, j: int) -> np.ndarray:
-        """(r2, m) matrix folding the single-corrupt-row decode into ONE
-        generator-shaped product (the device analogue of the host shim's
-        rs_decode1_fused; same per-column guarantee as matrix/bw.py).
-
-        With aug = [A | I] the parity check over the m received rows and
-        p0 the first check row seeing basis column j:
-
-        - row 0 = e_j ^ inv(A[p0,j]) * aug[p0]  — applied to the received
-          rows this is rows[j] ^ inv(A[p0,j]) * s_p0, i.e. row j with the
-          single-support correction applied (the e_j and aug terms cancel
-          at column j, so the corrupted row is reconstructed from the
-          others — correcting a fully-corrupt row IS reconstruction);
-        - rows 1.. = aug[q] ^ (A[q,j]/A[p0,j]) * aug[p0] for q != p0 —
-          each is s_q ^ c_q * s_p0, zero exactly where check row q is
-          consistent with the hypothesis "only row j is in error". A
-          column with ANY nonzero verify byte must be re-decoded by the
-          general host path; columns that verify (including clean columns,
-          where s_p0 = 0 makes the correction a no-op) are exact.
-        """
-        A = np.asarray(A, dtype=self.gf.dtype)
-        r2, k = A.shape
-        if r2 < 2:
-            # One parity row leaves NO consistency rows: the mask would
-            # claim every column verified with zero verification behind
-            # it. Matches the host kernel's e >= 1 requirement (a single
-            # redundant share cannot correct anyway).
-            raise ValueError(
-                f"single-support decode needs >= 2 check rows, got {r2}"
-            )
-        if not 0 <= j < k:
-            raise ValueError(f"j must index a basis row, got {j}")
-        nz = np.flatnonzero(A[:, j])
-        if nz.size == 0:
-            raise ValueError(f"check column {j} is identically zero")
-        p0 = int(nz[0])
-        gf = self.gf
-        aug = np.concatenate([A, np.eye(r2, dtype=self.gf.dtype)], axis=1)
-        inv_c = int(gf.inv(int(A[p0, j])))
-        D = np.zeros((r2, k + r2), dtype=self.gf.dtype)
-        D[0, j] = 1
-        D[0] ^= gf.mul(inv_c, aug[p0].astype(np.int64)).astype(self.gf.dtype)
-        out_i = 1
-        for q in range(r2):
-            if q == p0:
-                continue
-            c_q = int(gf.mul(int(A[q, j]), inv_c))
-            D[out_i] = aug[q] ^ gf.mul(
-                c_q, aug[p0].astype(np.int64)
-            ).astype(self.gf.dtype)
-            out_i += 1
-        return D
+        """See :func:`decode1_fold_matrix` (instance sugar over self.gf)."""
+        return decode1_fold_matrix(self.gf, A, j)
 
     def decode1_words(
         self, A: np.ndarray, j: int, rows_words

@@ -270,6 +270,28 @@ class BatchCodec:
             mesh, self.parity_matrix, batch_axis=batch_axis, row_axis=row_axis
         )
 
+    def make_sharded_decode1(self, mesh: Mesh, j: int, *,
+                             batch_axis: str = "batch",
+                             row_axis: Optional[str] = None):
+        """Compiled batched single-corrupt-row decode step over the mesh.
+
+        (B, n, S) received codewords (all n shares, systematic order) ->
+        (B, n-k, S): output row 0 is received row ``j`` with the
+        single-support correction applied, rows 1.. are the rank-1
+        consistency checks — zero exactly where the hypothesis "only row
+        j is in error" holds; nonzero columns must go through the general
+        host decode (matrix/bw.py). The decode1 fold
+        (ops/dispatch.decode1_fold_matrix) under shard_map: DP over
+        objects, optionally output rows over ``row_axis`` (ICI
+        all-gather) — the decode analogue of the sharded encoder.
+        """
+        from noise_ec_tpu.ops.dispatch import decode1_fold_matrix
+
+        D = decode1_fold_matrix(self.gf, self.parity_matrix, j)
+        return self.make_sharded_matmul(
+            mesh, D, batch_axis=batch_axis, row_axis=row_axis
+        )
+
     # -- mesh-sharded words ops (the TPU hot path) -------------------------
 
     def make_sharded_matmul_words(self, mesh: Mesh, M: np.ndarray, *,

@@ -80,4 +80,15 @@ bad_objects = np.nonzero(s_out.any(axis=(1, 2)))[0]
 np.testing.assert_array_equal(bad_objects, [1, 3, 5, 7])  # the corrupt copies
 assert not s_out[0].any() and s_out[1].all(axis=0).any()
 
+# Round 5: the single-corrupt-row decode FOLD across the cross-host mesh —
+# corrected row + rank-1 consistency rows as one generator-shaped matmul
+# (BatchCodec.make_sharded_decode1). The corrupted copies' share 2 must
+# come back equal to the true data row with zero consistency rows
+# everywhere (clean objects correct to a no-op), on every host.
+dec1 = bc.make_sharded_decode1(mesh2, 2)
+d_out = multihost.fetch_to_every_host(dec1(gfull))
+data8 = np.concatenate([data_u8] * 4, axis=0)
+np.testing.assert_array_equal(d_out[:, 0], data8[:, 2])
+assert not d_out[:, 1:].any()
+
 print(f"MULTIHOST-OK proc={proc_id} checksum={int(parity.sum())}", flush=True)

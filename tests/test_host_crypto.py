@@ -110,3 +110,33 @@ def test_signing_key_cache_is_lru_not_fifo():
         pol.sign(KeyPair.random().private_key, b"x")
         pol.sign(hot.private_key, b"x")  # hot key used in between
         assert bytes(hot.private_key) in pol._parsed_priv, i
+
+
+def test_signing_key_cache_thread_safe():
+    """One policy instance signs from the transport's asyncio thread and
+    the dispatch pool concurrently; the LRU cache mutates on every call
+    and must not crash or corrupt under that (r5 review: an unlocked
+    get+del raced to RuntimeError/KeyError with 8 threads)."""
+    import threading
+
+    from noise_ec_tpu.host.crypto import Ed25519Policy, KeyPair
+
+    pol = Ed25519Policy()
+    hot = KeyPair.random()
+    seeds = [KeyPair.random().private_key for _ in range(12)]
+    errors = []
+
+    def worker(idx):
+        try:
+            for i in range(200):
+                pol.sign(hot.private_key, b"m")
+                pol.sign(seeds[(idx + i) % len(seeds)], b"m")
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors

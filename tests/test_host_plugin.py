@@ -766,3 +766,38 @@ def test_failed_decode_releases_inflight_slot():
     except Exception:
         pass
     assert (k, n) not in plugin._novel_inflight
+
+
+def test_global_window_backstop_bounds_fast_compile_floods(monkeypatch):
+    """Even when every first decode completes instantly (freeing its
+    in-flight slot), the aggregate window ceiling bounds how many novel
+    geometries a rotating flood can admit per window."""
+    from noise_ec_tpu.host.crypto import KeyPair, PeerID
+
+    plugin = ShardPlugin(backend="device")
+
+    def ctx_for(i):
+        keys = KeyPair.from_seed(bytes([i % 250]) * 32)
+        peer = PeerID.create(f"tcp://localhost:{7500 + i}", keys.public_key)
+
+        class Ctx:
+            def message(self):
+                return None
+
+            def sender(self):
+                return peer
+
+            def client_public_key(self):
+                return peer.public_key
+
+        return Ctx()
+
+    cap = plugin.NOVEL_GEOMETRY_GLOBAL_PER_WINDOW
+    admitted = 0
+    for i in range(cap + 10):
+        fec = plugin._fec_receive(2, 3 + i, ctx_for(i))
+        plugin._geometry_ready(2, 3 + i)  # instant decode frees the slot
+        if fec._rs.backend == "device":
+            admitted += 1
+    assert admitted == cap
+    assert plugin.counters.get("geometry_rate_limited") == 10
