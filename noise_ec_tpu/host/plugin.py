@@ -218,26 +218,27 @@ class ShardPlugin:
     # no kernel compile) until a geometry recurs or the window rolls.
     NOVEL_GEOMETRY_WINDOW_SECONDS = 60.0
     NOVEL_GEOMETRY_PER_WINDOW = 8
-    # Aggregate backstop across ALL senders: identities are cheap to mint,
-    # so the per-sender budget alone is bypassed by key rotation. Instead
-    # of a global WINDOW count (r4: one key-rotating flooder exhausted it
-    # and demoted every bystander's novel geometries for a full window —
-    # verdict weak #6), the global cap bounds compiles IN FLIGHT —
-    # admissions whose first full-backend decode has not completed yet.
-    # Bystanders fall to the host codec only while the compile pipeline is
-    # actually saturated; slots free as each first decode lands (or after
-    # the grace timeout when one never does).
+    # Aggregate control across ALL senders (identities are cheap to mint,
+    # so the per-sender budget alone is bypassed by key rotation) — TWO
+    # mechanisms, primary + backstop. Primary: a cap on compiles IN
+    # FLIGHT (admissions whose first full-backend decode has not
+    # completed), so bystanders fall to the host codec only while the
+    # compile pipeline is actually saturated; slots free as each first
+    # decode lands, or after the grace timeout when one never does. This
+    # replaced r4's TIGHT global window count (32), which let one
+    # key-rotating flooder demote every bystander for a full window
+    # (verdict weak #6).
     NOVEL_COMPILES_INFLIGHT_MAX = 2
     NOVEL_COMPILE_GRACE_SECONDS = 60.0
-    # Aggregate WINDOW backstop on top of the in-flight cap: the in-flight
+    # Backstop: a LOOSE window ceiling on total admissions. The in-flight
     # cap alone bounds concurrency, not total work — a flooder whose
     # geometries compile fast could keep both slots perpetually owned and
     # churn the codec LRU. This ceiling bounds compiles + cache insertions
-    # per window. It is deliberately HIGH (2x the old global cap): the
-    # in-flight cap is the primary control, and a window ceiling demotes
-    # bystanders once exhausted — an inherent tension under identity
-    # rotation (attacker and bystander are indistinguishable), so the
-    # backstop should only engage under a genuinely heavy flood.
+    # per window. Deliberately HIGH (2x r4's 32): any window ceiling
+    # demotes bystanders once exhausted — an inherent tension under
+    # identity rotation (attacker and bystander are indistinguishable) —
+    # so it should engage only under a genuinely heavy flood, with the
+    # in-flight cap doing the everyday work.
     NOVEL_GEOMETRY_GLOBAL_PER_WINDOW = 64
 
     @staticmethod

@@ -433,3 +433,32 @@ def test_stream_chaos_soak_faulty_link():
         )
         assert [m for m, _ in inboxes[1][-1:]] == [data], f"trial {trial}"
     assert len(inboxes[1]) == 3
+
+
+def test_stream_whole_share_corruption_fused_path_end_to_end():
+    """A WHOLLY corrupt share on a wide chunk (shares above the
+    speculation threshold) drives the round-5 fused one-pass decode
+    through the full stream receive + repair flow, delivering the object
+    intact. This is the r5 host decode architecture exercised end to end
+    rather than at the matrix layer."""
+    import noise_ec_tpu.matrix.bw as bw
+
+    _, nodes, inboxes = make_cluster(2)
+    sender, receiver = nodes
+    plugin = receiver.plugins[0]
+    rng = np.random.default_rng(55)
+    # One 4 MiB chunk with RS(10,14): shares are ~420 KB, comfortably
+    # above _SPECULATE_MIN_S (256 KiB), so the repair decode runs the
+    # fused kernel.
+    data = bytes(rng.integers(0, 256, 4 << 20).astype(np.uint8))
+    shards = _capture_stream_shards(sender, data, 4 << 20)
+    assert len({s.stream_chunk_index for s in shards}) == 1
+    share_len = len(shards[0].shard_data)
+    assert share_len >= bw._SPECULATE_MIN_S, share_len
+    for s in shards:
+        if s.shard_number == 2:
+            flipped = (np.frombuffer(s.shard_data, np.uint8) ^ 0xB7).tobytes()
+            s = _reshard(s, flipped)
+        plugin.receive(_Ctx(s, sender))
+    assert [m for m, _ in inboxes[1]] == [data]
+    assert plugin.counters.get("verified") == 1
