@@ -260,6 +260,48 @@ void mul_add_row16(uint16_t* out, const uint16_t* in, uint16_t c, size_t len) {
     for (int v = 0; v < 16; ++v)
       tab[n][v] = gf16_mul(c, static_cast<uint16_t>(v << (4 * n)));
   size_t i = 0;
+#if defined(__GFNI__) && defined(__AVX512BW__)
+  // Multiplication by c over GF(2^16) is GF(2)-linear: a 16x16 bit
+  // matrix, i.e. four 8x8 blocks over the (lo, hi) bytes of each symbol
+  // (out_lo = A00*lo ^ A01*hi; out_hi = A10*lo ^ A11*hi). gf2p8affineqb
+  // applies an 8x8 block to every byte lane, so no deinterleave is
+  // needed: u16 shifts place the wanted source byte in the wanted lane
+  // and byte masks keep the half each block contributes — ~12 vector ops
+  // per 64 bytes vs ~140 for the nibble-shuffle path below.
+  {
+    auto block_aff = [&](int outhalf, int inhalf) -> __m512i {
+      uint64_t aff = 0;
+      for (int j = 0; j < 8; ++j) {  // output bit j of the out byte
+        uint64_t row = 0;
+        for (int b = 0; b < 8; ++b) {  // input bit b of the in byte
+          uint16_t col = gf16_mul(c, static_cast<uint16_t>(1u << (b + 8 * inhalf)));
+          row |= static_cast<uint64_t>((col >> (j + 8 * outhalf)) & 1) << b;
+        }
+        aff |= row << (8 * (7 - j));
+      }
+      return _mm512_set1_epi64(static_cast<long long>(aff));
+    };
+    const __m512i a00 = block_aff(0, 0), a01 = block_aff(0, 1);
+    const __m512i a10 = block_aff(1, 0), a11 = block_aff(1, 1);
+    const __m512i m00ff = _mm512_set1_epi16(0x00FF);
+    for (; i + 32 <= len; i += 32) {  // 32 u16 symbols = 64 bytes
+      __m512i x = _mm512_loadu_si512(reinterpret_cast<const void*>(in + i));
+      __m512i hi_even = _mm512_srli_epi16(x, 8);  // hi byte -> even lane
+      __m512i lo_odd = _mm512_slli_epi16(x, 8);   // lo byte -> odd lane
+      __m512i lo_out = _mm512_xor_si512(
+          _mm512_gf2p8affine_epi64_epi8(x, a00, 0),
+          _mm512_gf2p8affine_epi64_epi8(hi_even, a01, 0));
+      __m512i hi_out = _mm512_xor_si512(
+          _mm512_gf2p8affine_epi64_epi8(lo_odd, a10, 0),
+          _mm512_gf2p8affine_epi64_epi8(x, a11, 0));
+      __m512i term = _mm512_or_si512(_mm512_and_si512(lo_out, m00ff),
+                                     _mm512_andnot_si512(m00ff, hi_out));
+      __m512i y = _mm512_loadu_si512(reinterpret_cast<void*>(out + i));
+      _mm512_storeu_si512(reinterpret_cast<void*>(out + i),
+                          _mm512_xor_si512(y, term));
+    }
+  }
+#endif
 #if defined(__AVX2__)
   {
     __m256i tl[4], th[4];

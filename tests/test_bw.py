@@ -1053,3 +1053,65 @@ def test_fused_gf65536_whole_share(rng):
     assert spec is not None and gen is not None
     np.testing.assert_array_equal(np.stack(spec[0]), np.stack(gen[0]))
     np.testing.assert_array_equal(np.stack(spec[0]), data)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_decode_chaos_soak_speculative_vs_generic(seed):
+    """Chaos soak over the round-5 decode architecture: random geometry,
+    stripe widths straddling the speculation threshold, random mixes of
+    whole-share and scattered corruption within the radius, random
+    arrival order — the speculative decode, the generic decode, and the
+    ground truth must agree exactly; beyond-radius patterns must fail on
+    both paths identically."""
+    import noise_ec_tpu.matrix.bw as bw
+
+    rng = np.random.default_rng(0xC0DE + seed)
+    gf = GF256()
+    for trial in range(4):
+        k = int(rng.integers(2, 12))
+        r = int(rng.integers(2, 7))
+        n = k + r
+        m = n  # all shares arrive
+        e = r // 2
+        S = int(rng.choice([8192, bw._SPECULATE_MIN_S + 1024]))
+        gold = GoldenCodec(k, n)
+        data = rng.integers(0, 256, size=(k, S), dtype=np.int64).astype(np.uint8)
+        cw = gold.encode_all(data).astype(np.uint8)
+        nums = rng.permutation(n).tolist()
+        rows = [np.ascontiguousarray(cw[i]) for i in nums]
+        n_whole = int(rng.integers(0, e + 1))
+        whole_rows = rng.permutation(m)[:n_whole]
+        for w in whole_rows:
+            rows[w] = rows[w] ^ np.uint8(int(rng.integers(1, 256)))
+        # scattered errors on OTHER rows, never exceeding the radius at
+        # any column: per scattered row, distinct columns, and total
+        # corrupt rows per column <= e (whole rows hit every column).
+        budget = e - n_whole
+        if budget > 0:
+            others = [i for i in range(m) if i not in set(whole_rows)]
+            sc_rows = rng.permutation(others)[:budget]
+            for srow in sc_rows:
+                cols = rng.integers(0, S, 17)
+                rr = rows[srow].copy()
+                rr[cols] ^= int(rng.integers(1, 256))
+                rows[srow] = rr
+        spec = bw.syndrome_decode_rows(gf, "cauchy", k, n, nums, rows)
+        gen = bw.syndrome_decode_rows(
+            gf, "cauchy", k, n, nums, rows, _speculate=False
+        )
+        assert spec is not None and gen is not None, (seed, trial, k, r)
+        np.testing.assert_array_equal(np.stack(spec[0]), data)
+        np.testing.assert_array_equal(np.stack(gen[0]), data)
+        # Beyond-radius: corrupt e+1 whole shares -> both paths refuse.
+        if e + 1 <= m:
+            rows_bad = [np.ascontiguousarray(cw[i]) for i in nums]
+            for w in rng.permutation(m)[: e + 1]:
+                rows_bad[w] = rows_bad[w] ^ np.frombuffer(
+                    rng.integers(1, 256, size=S, dtype=np.int64)
+                    .astype(np.uint8).tobytes(), np.uint8,
+                )
+            s1 = bw.syndrome_decode_rows(gf, "cauchy", k, n, nums, rows_bad)
+            s2 = bw.syndrome_decode_rows(
+                gf, "cauchy", k, n, nums, rows_bad, _speculate=False
+            )
+            assert s1 is None and s2 is None, (seed, trial, "radius")
