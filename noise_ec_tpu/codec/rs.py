@@ -106,9 +106,10 @@ class ReedSolomon:
             try:
                 return self._dev.matmul_stripes(M, D)
             except NotImplementedError:
-                # Wide-field near-limit geometries have no device kernel
-                # (dispatch._guard_wide_field); the native host tier is
-                # the designed fallback, not an error, for codec callers.
+                # Defensive: the stripes entry routes every geometry
+                # today (baked or MXU); if a future backend reintroduces
+                # an unsupported region, the native host tier is the
+                # designed fallback for codec callers, not an error.
                 pass
         return host_matvec(self.gf, M, D)
 
@@ -279,11 +280,28 @@ class ReedSolomon:
                 )
             changed.append((j, arr))
         if changed and self.r:
-            cols = [j for j, _ in changed]
-            deltas = np.stack([arrs[j] ^ arr for j, arr in changed])
             parity = np.stack(arrs[self.k:])
-            # Fancy indexing already yields a fresh contiguous submatrix.
-            parity ^= self._mul(self.G[self.k:, cols], deltas)
+            if self._dev is not None:
+                # Device backend: scatter the deltas into a full-width
+                # zero block and reuse the ALREADY-COMPILED full parity
+                # program (linearity: G[k:, cols] @ deltas ==
+                # G[k:] @ scatter(deltas)). A per-subset submatrix would
+                # bake a fresh XOR-network kernel for every distinct
+                # changed-column set — seconds of Mosaic compile each,
+                # against microseconds of extra zero-row multiply at the
+                # kernel's 400+ GB/s.
+                delta_full = np.zeros(
+                    (self.k, size), dtype=self.gf.dtype
+                )
+                for j, arr in changed:
+                    delta_full[j] = arrs[j] ^ arr
+                parity ^= self._mul(self.G[self.k:], delta_full)
+            else:
+                # numpy backend: the true O(c*r*S) incremental multiply
+                # (the shim runs arbitrary submatrices, no compile step).
+                cols = [j for j, _ in changed]
+                deltas = np.stack([arrs[j] ^ arr for j, arr in changed])
+                parity ^= self._mul(self.G[self.k:, cols], deltas)
             for row, i in enumerate(range(self.k, self.n)):
                 arrs[i] = parity[row]
         for j, arr in changed:

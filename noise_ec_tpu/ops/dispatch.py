@@ -274,6 +274,7 @@ class DeviceCodec:
         self._mask_dev_cache: dict[bytes, jnp.ndarray] = {}
         self._rows_cache: dict[bytes, tuple] = {}
         self._cost_cache: dict[bytes, int] = {}
+        self._m2w_cache: dict = {}
         self._mxu = None
 
     def _key(self, M: np.ndarray) -> bytes:
@@ -294,13 +295,12 @@ class DeviceCodec:
         matmul_stripes/matmul_words route such matrices to the MXU before
         ever calling this; direct callers get the clear error.
         """
-        if self.gf.degree == 8 and self.route_for(M) == "mxu":
+        if self.route_for(M) == "mxu":
             raise NotImplementedError(
                 "matrix exceeds the baked-kernel budget; use "
-                "matmul_stripes/matmul_words (MXU route)"
+                "matmul_stripes/matmul_words (gf256) or the byte-sliced "
+                "entries (gf65536) — the MXU route"
             )
-        if self.gf.degree == 16:
-            self._guard_wide_field(M)
         M = np.ascontiguousarray(np.asarray(M, dtype=self.gf.dtype))
         key = self._key(M)
         hit = self._rows_cache.get(key)
@@ -328,15 +328,45 @@ class DeviceCodec:
     def route_for(self, M: np.ndarray) -> str:
         """Which kernel family runs this matrix: "baked" (planned
         XOR-network VPU kernels) or "mxu" (dense int8 bit-plane matmul).
-        Exposed so tests can pin the near-field-limit fallback."""
-        if self.gf.degree != 8:
-            return "baked"  # no MXU formulation for the wide field yet
+        Exposed so tests can pin the near-field-limit fallback.
+
+        For the wide field the row bound counts BYTE rows (the byte-
+        sliced pipeline runs 2k of them) and the tighter 112-row ceiling
+        applies (see _guarded note in matmul_words_batch): past either
+        bound the byte-sliced entries run the same MXU kernel — the bit
+        matrix is field-blind — via _bytesliced_words.
+        """
         r, k = np.asarray(M).shape
+        if self.gf.degree == 16:
+            if 2 * max(r, k) > 112 or self._xor_cost_for(M) > _BAKED_XOR_BUDGET:
+                return "mxu"
+            return "baked"
         if max(r, k) > _BAKED_MAX_ROWS:
             return "mxu"
         if self._xor_cost_for(M) > _BAKED_XOR_BUDGET:
             return "mxu"
         return "baked"
+
+    def _m2_for_wide(self, M: np.ndarray):
+        """Cached (16r, 16k) int8 bit expansion of a gf65536 matrix for
+        the byte-sliced MXU route — bounded, and promoted to a
+        device-resident array outside any active trace so repeated
+        encodes do not re-stage a multi-MB operand (mirrors
+        MxuCodec._m2_for, including the tracer-leak guard)."""
+        from noise_ec_tpu.ops.mxu_gf2 import _trace_state_clean
+
+        M = np.ascontiguousarray(np.asarray(M, dtype=self.gf.dtype))
+        key = self._key(M)
+        hit = self._m2w_cache.get(key)
+        if hit is None:
+            hit = expand_generator_bits(self.gf, M).astype(np.int8)
+            if len(self._m2w_cache) > 64:
+                self._m2w_cache.clear()
+            self._m2w_cache[key] = hit
+        if isinstance(hit, np.ndarray) and _trace_state_clean():
+            hit = jnp.asarray(hit)
+            self._m2w_cache[key] = hit
+        return hit
 
     def _mxu_for(self):
         if self._mxu is None:
@@ -347,66 +377,22 @@ class DeviceCodec:
             )
         return self._mxu
 
-    def _guard_wide_field(self, M: np.ndarray) -> None:
-        """Refuse near-field-limit GF(2^16) matrices with a clear error.
-
-        The wide field has no MXU formulation yet, and its byte-sliced
-        networks hit BOTH baked-kernel walls: Paar factoring on a ~1M-XOR
-        network (minutes) and the pack stage's per-row VMEM (2k byte rows
-        for k symbol rows). A NotImplementedError beats a silent
-        multi-minute hang or a Mosaic OOM.
-        """
-        r, k = np.asarray(M).shape
-        # Two bounds, matching the gf256 budgets (Paar planning time is
-        # field-blind — it sees terms — and the pack stage sees byte
-        # rows): raw XORs <= _BAKED_XOR_BUDGET, byte rows <= 112. The
-        # measured scoped-VMEM model (200 input rows OOMed at 24.8M vs
-        # the 16M limit, ~linear in rows) puts failure near ~129 rows;
-        # 112 keeps ~13% margin, because an admitted-at-the-limit matrix
-        # fails at RUNTIME with a Mosaic OOM that the NotImplementedError
-        # fallbacks in codec/bw cannot catch — the refusal must fire
-        # strictly before the model limit, not at it.
-        if 2 * max(r, k) > 112:
-            raise NotImplementedError(
-                f"GF(2^16) geometry ({r}, {k}) exceeds the baked kernels' "
-                "row budget (112 byte rows); the native host tier "
-                "(hostmath/shim) is the supported wide-field path there"
-            )
-        if self._xor_cost_for(M) > _BAKED_XOR_BUDGET:
-            raise NotImplementedError(
-                "geometry too large for the baked GF(2^16) kernels "
-                f"({self._xor_cost_for(M)} raw XORs); the native host "
-                "tier (hostmath/shim) is the supported wide-field path"
-            )
-
     def supports_matrix(self, M: np.ndarray) -> bool:
         """Cheap predicate: does a device kernel exist for ``M``?
 
-        False means the caller should take the host tier without building
-        any row data (no stacking copies — the decode dispatch consults
-        this BEFORE materializing multi-MiB stacks it would then throw
-        away on the refusal path).
+        Always True since the wide-field MXU route landed — every matrix
+        has a device route on the stripes/byte-sliced entries (baked
+        network or dense MXU). Kept as an API so decode dispatch code
+        written against the predicate keeps working, and as the hook if a
+        future backend ever reintroduces an unsupported region.
         """
-        if self.gf.degree != 16:
-            return True  # gf256 always has a route (baked or MXU)
-        try:
-            self._guard_wide_field(M)
-            return True
-        except NotImplementedError:
-            return False
+        del M
+        return True
 
     def supports_syndrome(self, A: np.ndarray) -> bool:
-        """supports_matrix for the syndrome route, owning the [A | I]
-        augmentation that syndrome_stripes will build — so the refusal
-        condition is encoded ONCE and callers never duplicate the aug
-        shape. Short-circuits before any allocation for gf256."""
-        if self.gf.degree != 16:
-            return True
-        A = np.asarray(A, dtype=self.gf.dtype)
-        aug = np.concatenate(
-            [A, np.eye(A.shape[0], dtype=self.gf.dtype)], axis=1
-        )
-        return self.supports_matrix(aug)
+        """supports_matrix for the syndrome route (see supports_matrix)."""
+        del A
+        return True
 
     def matmul_stripes(self, M: np.ndarray, D) -> np.ndarray:
         """(r, k) GF matrix x (k, S) stripes -> (r, S), computed on device."""
@@ -425,7 +411,6 @@ class DeviceCodec:
             # not a read-only view of the device buffer.
             return np.array(out)
         if m == 16:
-            self._guard_wide_field(M)  # no MXU fallback for gf65536 yet
             # BYTE-SLICED GF(2^16): each u16 symbol splits into (lo, hi)
             # byte rows (2k rows of S bytes), and the device runs the
             # GF(2^8)-shaped m=8 pipeline — the expanded bit matrix needs
@@ -530,6 +515,20 @@ class DeviceCodec:
             buf[:, :S] = Db
         else:
             buf = np.ascontiguousarray(Db)
+        if self.route_for(M) == "mxu":
+            # Near-field-limit wide-field matrices run the dense MXU
+            # kernel directly on the byte rows: the kernel is pure GF(2)
+            # and the UNPERMUTED (16r, 16k) expansion over 2k byte rows
+            # IS an (8R, 8K) bit matrix with R = 2r, K = 2k. Same route
+            # gate as gf256 (route_for), closing the round-5 refusal gap.
+            from noise_ec_tpu.ops.mxu_gf2 import mxu_encode_words_bits
+
+            out_w = np.array(mxu_encode_words_bits(
+                self._m2_for_wide(M), buf.view("<u4"),
+                r=r2, k=k2,
+                interpret=self.kernel == "pallas_interpret",
+            ))
+            return out_w.view(np.uint8)[:, :S]
         fn = _fused_words_fn(
             r2, self.bits_rows_for(M), self.kernel == "pallas_interpret"
         )
@@ -550,11 +549,24 @@ class DeviceCodec:
         if self.gf.degree != 16:
             raise ValueError("matmul_words_bytesliced is gf65536-only")
         r2 = 2 * M.shape[0]
-        fn = _fused_words_fn(
-            r2, self.bits_rows_for(M), self.kernel == "pallas_interpret"
-        )
         TW = words.shape[1]
         TWp = pad_words(TW)
+        if self.route_for(M) == "mxu":
+            # Near-field-limit wide-field matrices: the dense MXU kernel
+            # over the same byte rows (see _bytesliced_words).
+            from noise_ec_tpu.ops.mxu_gf2 import mxu_encode_words_bits
+
+            fn = functools.partial(
+                mxu_encode_words_bits,
+                self._m2_for_wide(M),
+                r=r2,
+                k=2 * M.shape[1],
+                interpret=self.kernel == "pallas_interpret",
+            )
+        else:
+            fn = _fused_words_fn(
+                r2, self.bits_rows_for(M), self.kernel == "pallas_interpret"
+            )
         if TWp != TW:
             return fn(jnp.pad(words, ((0, 0), (0, TWp - TW))))[:, :TW]
         return fn(words)
@@ -587,7 +599,7 @@ class DeviceCodec:
         record_kernel("matmul_words", 4 * int(np.prod(words.shape)))
         TW = words.shape[2]
         TWp = pad_words(TW) if self.gf.degree == 8 else pad_words16(TW)
-        if self.route_for(M) == "mxu":
+        if self.gf.degree == 8 and self.route_for(M) == "mxu":
             # Near-field-limit geometries (see _BAKED_XOR_BUDGET): the
             # dense MXU product, same words contract. WORD_QUANTUM is a
             # multiple of the MXU lane tile, so the padding below fits
@@ -595,8 +607,14 @@ class DeviceCodec:
             mx = self._mxu_for()
             fn = functools.partial(mx.encode_words, M)
         else:
-            if self.gf.degree != 8:
-                self._guard_wide_field(M)
+            if self.gf.degree == 16 and self.route_for(M) == "mxu":
+                # The MXU route consumes BYTE rows; this entry's
+                # interleaved-u16 layout has no kernel at this size.
+                raise NotImplementedError(
+                    "near-field-limit GF(2^16) matrices run the MXU route "
+                    "on the byte-sliced entries (matmul_words_bytesliced "
+                    "/ matmul_stripes), not the interleaved words entry"
+                )
             mk = _fused_words_fn if self.gf.degree == 8 else _fused_words16_fn
             fn = mk(
                 M.shape[0], self.bits_rows_for(M),

@@ -104,6 +104,45 @@ def _mxu_encode_words_jit(m2, words, *, r, k, tile_words, interpret):
     )(m2, words)
 
 
+def mxu_encode_words_bits(
+    m2: np.ndarray,
+    words,
+    *,
+    r: int,
+    k: int,
+    interpret: bool = False,
+):
+    """Low-level MXU entry on a PRE-EXPANDED GF(2) bit matrix.
+
+    ``m2``: (8r, 8k) 0/1 int8 bit matrix over k byte rows; ``words``:
+    (k, TW) uint32 with TW a multiple of the chosen lane tile. The field
+    is irrelevant here — the kernel is pure GF(2) — which is what lets
+    the BYTE-SLICED GF(2^16) path run on the MXU: its expanded (16r, 16k)
+    bit matrix over 2k byte rows IS an (8R, 8K) matrix with R = 2r,
+    K = 2k (design.md: the flat plane index needs no permutation).
+    The lane tile narrows for many-byte-row geometries so the in-kernel
+    bit tensor (k * 32 * tile bytes) stays VMEM-resident.
+    """
+    tile = MXU_TILE_WORDS if k <= 256 else MXU_TILE_WORDS // 2
+    words = jnp.asarray(words)
+    if words.shape[1] % tile:
+        raise ValueError(
+            f"TW {words.shape[1]} not a multiple of tile {tile}"
+        )
+    if isinstance(m2, np.ndarray):
+        # Callers that cache a device-resident operand pass it through
+        # untouched; only host ndarrays get staged here.
+        m2 = jnp.asarray(np.ascontiguousarray(m2, dtype=np.int8))
+    return _mxu_encode_words_jit(
+        m2,
+        words,
+        r=r,
+        k=k,
+        tile_words=tile,
+        interpret=interpret,
+    )
+
+
 class MxuCodec:
     """Experimental MXU-route encoder over u32 word stripes.
 

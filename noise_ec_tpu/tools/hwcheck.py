@@ -165,6 +165,27 @@ def _checks():
         np.array_equal(dev_s, host_s) and np.array_equal(dev_counts, host_counts),
     )
 
+    # --- device decode1 fold (round 5): the single-corrupt-row decode as
+    # ONE generator-shaped matmul — corrected row equals the true data
+    # row and every consistency row reads zero on pure whole-share
+    # corruption; a mixed-corruption column is flagged nonzero.
+    w14 = jnp.asarray(np.ascontiguousarray(cw).view("<u4"))
+    got_c, got_bad = dev.decode1_words(A, 1, w14)
+    c_bytes = np.asarray(got_c)[None].view(np.uint8)[0]
+    yield (
+        "device decode1 fused fold gf256 RS(10,4)",
+        np.array_equal(c_bytes, D[1]) and not np.asarray(got_bad).any(),
+    )
+    cw_mix = cw.copy()  # share 1 is ALREADY wholly corrupt (line above)
+    cw_mix[2, 100] ^= 0x3C  # second error at one column -> mixed
+    w_mix = jnp.asarray(np.ascontiguousarray(cw_mix).view("<u4"))
+    _, bad_mix = dev.decode1_words(A, 1, w_mix)
+    bad_bytes = np.asarray(bad_mix)[None].view(np.uint8)[0]
+    yield (
+        "device decode1 flags mixed-corruption columns gf256 RS(10,4)",
+        bool(bad_bytes[100]) and not bad_bytes[:100].any(),
+    )
+
     # --- full corrupted-share decode with the device route end to end.
     from noise_ec_tpu.codec.fec import FEC, Share
 
@@ -230,6 +251,29 @@ def _checks():
     yield (
         "near-limit device syndrome gf256 RS(200,56)",
         np.array_equal(dev_sL, host_sL) and np.array_equal(dev_cL, host_cL),
+    )
+    wL = jnp.asarray(np.ascontiguousarray(cwL).view("<u4"))
+    cL, badL = dev.decode1_words(AL, 7, wL)
+    yield (
+        "near-limit device decode1 (MXU route) gf256 RS(200,56)",
+        np.array_equal(
+            np.asarray(cL)[None].view(np.uint8)[0], DL[7]
+        )
+        and not np.asarray(badL).any(),
+    )
+    # Wide-field near-limit (round 5): the byte-sliced MXU route — the
+    # bit matrix is field-blind, so gf65536 RS(200,56) (400 byte rows)
+    # runs the same dense kernel instead of refusing.
+    dev16L = DeviceCodec(field="gf65536", kernel="pallas")
+    G16L = generator_matrix(dev16L.gf, kL, kL + rL, "cauchy")
+    D16L = data_for("gf65536", kL, 2048)
+    yield (
+        "near-limit encode gf65536 RS(200,56) (byte-sliced MXU)",
+        dev16L.route_for(G16L[kL:]) == "mxu"
+        and np.array_equal(
+            dev16L.matmul_stripes(G16L[kL:], D16L),
+            np.asarray(golden("gf65536", kL, kL + rL).encode(D16L)),
+        ),
     )
     fecL = FEC(kL, kL + rL, backend="numpy")
     sharesL = fecL.encode_shares(DL.tobytes())

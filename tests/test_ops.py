@@ -314,41 +314,70 @@ def test_near_limit_fec_corrupted_decode_host():
     assert fec.stats["bw_decodes"] == 1
 
 
-def test_wide_field_near_limit_refuses_clearly():
-    """GF(2^16) near-field-limit matrices must raise NotImplementedError
-    (no MXU formulation for the wide field yet) instead of hanging in
-    Paar factoring or OOMing the pack stage — on BOTH stripe and words
-    entries, for both failure classes (big network, many rows)."""
+def test_wide_field_near_limit_routes_to_mxu():
+    """GF(2^16) near-field-limit matrices run the dense MXU kernel on the
+    byte-sliced entries (the bit matrix is field-blind), bit-exact vs
+    golden; the baked-network choke point and the interleaved words entry
+    still refuse with a clear error instead of hanging in Paar factoring
+    or OOMing the pack stage."""
     import numpy as np
     import pytest
 
+    from noise_ec_tpu.golden.codec import GoldenCodec
+    from noise_ec_tpu.matrix.generators import generator_matrix
     from noise_ec_tpu.ops.dispatch import DeviceCodec
 
     dev = DeviceCodec(field="gf65536", kernel="pallas_interpret")
     rng = np.random.default_rng(13)
-    big = rng.integers(0, 1 << 16, size=(56, 200)).astype(np.uint16)
-    D = rng.integers(0, 1 << 16, size=(200, 64)).astype(np.uint16)
+    G = generator_matrix(dev.gf, 60, 76, "cauchy")  # 120 byte rows > 112
+    assert dev.route_for(G[60:]) == "mxu"
+    D = rng.integers(0, 1 << 16, size=(60, 512)).astype(np.uint16)
+    got = dev.matmul_stripes(G[60:], D)
+    want = np.asarray(GoldenCodec(60, 76, field="gf65536").encode(D))
+    np.testing.assert_array_equal(got, want)
+    # The baked choke point refuses rather than factoring a huge network.
     with pytest.raises(NotImplementedError):
-        dev.matmul_stripes(big, D)
-    many_rows = np.zeros((3, 200), dtype=np.uint16)
-    many_rows[:, :3] = np.eye(3, dtype=np.uint16)
-    with pytest.raises(NotImplementedError):
-        dev.matmul_stripes(many_rows, D)
-    # The guard sits in bits_rows_for, the shared choke point, so the
-    # planes / byte-sliced / direct entries are covered too.
-    with pytest.raises(NotImplementedError):
-        dev.bits_rows_for(big)
+        dev.bits_rows_for(G[60:])
     dev8 = DeviceCodec(field="gf256", kernel="pallas_interpret")
     big8 = np.arange(56 * 200, dtype=np.int64).astype(np.uint8).reshape(56, 200)
     with pytest.raises(NotImplementedError):
         dev8.bits_rows_for(big8)
-    # Codec callers are not broken by the refusal: ReedSolomon's device
-    # backend falls back to the native host tier and still matches golden.
+    # Codec callers get the same bytes through the public surface.
     from noise_ec_tpu.codec.rs import ReedSolomon
-    from noise_ec_tpu.golden.codec import GoldenCodec
 
-    rs = ReedSolomon(40, 16, field="gf65536", backend="device")
-    Dm = rng.integers(0, 1 << 16, size=(40, 512)).astype(np.uint16)
-    got = np.stack(rs.encode(list(Dm))[40:]).view("<u2")
-    want = np.asarray(GoldenCodec(40, 56, field="gf65536").encode(Dm))
+    rs = ReedSolomon(60, 16, field="gf65536", backend="device")
+    got2 = np.stack(rs.encode(list(D))[60:]).view("<u2")
+    np.testing.assert_array_equal(got2, want)
+
+
+def test_wide_field_bytesliced_words_entry_routes_to_mxu():
+    """The device-resident byte-sliced words entry (the bench's fast
+    path) must route near-limit gf65536 matrices to the MXU instead of
+    dead-ending in the baked choke point (r5 review finding)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from noise_ec_tpu.golden.codec import GoldenCodec
+    from noise_ec_tpu.matrix.generators import generator_matrix
+    from noise_ec_tpu.ops.dispatch import DeviceCodec
+
+    dev = DeviceCodec(field="gf65536", kernel="pallas_interpret")
+    rng = np.random.default_rng(17)
+    k, r = 60, 16
+    G = generator_matrix(dev.gf, k, k + r, "cauchy")
+    assert dev.route_for(G[k:]) == "mxu"
+    S = 512  # symbols
+    D = rng.integers(0, 1 << 16, size=(k, S)).astype(np.uint16)
+    # byte-sliced device words: (2k, S) byte rows viewed as u32 words
+    Db = (
+        np.ascontiguousarray(D).view(np.uint8).reshape(k, S, 2)
+        .transpose(0, 2, 1).reshape(2 * k, S)
+    )
+    words = jnp.asarray(np.ascontiguousarray(Db).view("<u4"))
+    out_w = np.asarray(dev.matmul_words_bytesliced(G[k:], words))
+    got = (
+        out_w.view(np.uint8)[:, : S].reshape(r, 2, S)
+        .transpose(0, 2, 1).reshape(r, 2 * S).view("<u2")
+    )
+    want = np.asarray(GoldenCodec(k, k + r, field="gf65536").encode(D))
     np.testing.assert_array_equal(got, want)
