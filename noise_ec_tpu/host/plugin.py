@@ -186,8 +186,14 @@ class ShardPlugin:
         # Novel-geometry rate limiter state (see _fec_receive) + the
         # host-only fallback codec cache for rate-limited senders.
         self._novel_geometry: OrderedDict[bytes, list] = OrderedDict()
-        # geometry -> admission time, while its first decode (the kernel
-        # compile) is still pending; see NOVEL_COMPILES_INFLIGHT_MAX.
+        # Admission lifecycle: _fec_receive puts a granted novel geometry
+        # into _novel_pending; the decode sites move it to _novel_inflight
+        # for exactly the duration of the first decode (where the kernel
+        # compile happens) and clear it in their finally. Only INFLIGHT
+        # entries count against NOVEL_COMPILES_INFLIGHT_MAX, so stray
+        # shards that never assemble to k cannot pin the admission budget
+        # (r5 holistic review).
+        self._novel_pending: dict[tuple, float] = {}
         self._novel_inflight: dict[tuple, float] = {}
         # Admission timestamps for the global window backstop.
         self._novel_global: list = []
@@ -291,6 +297,8 @@ class ShardPlugin:
             stale = now - self.NOVEL_COMPILE_GRACE_SECONDS
             for g in [g for g, t0 in self._novel_inflight.items() if t0 < stale]:
                 del self._novel_inflight[g]
+            for g in [g for g, t0 in self._novel_pending.items() if t0 < cutoff]:
+                del self._novel_pending[g]
             while self._novel_global and self._novel_global[0] < cutoff:
                 self._novel_global.pop(0)
             limited = (
@@ -302,7 +310,7 @@ class ShardPlugin:
             )
             if not limited:
                 dq.append(now)
-                self._novel_inflight[(k, n)] = now
+                self._novel_pending[(k, n)] = now
                 self._novel_global.append(now)
         if not limited:
             return self._fec(k, n)
@@ -316,12 +324,20 @@ class ShardPlugin:
             self._fec_host_cache, (k, n), FEC(k, n, backend="numpy")
         )
 
+    def _geometry_decode_begin(self, k: int, n: int) -> None:
+        """Admitted geometry's first decode is starting: occupy an
+        in-flight compile slot for its duration (see _novel_pending)."""
+        with self._novel_lock:
+            if self._novel_pending.pop((k, n), None) is not None:
+                self._novel_inflight[(k, n)] = time.monotonic()
+
     def _geometry_ready(self, k: int, n: int) -> None:
-        """Release the in-flight compile slot for (k, n): its first
-        full-backend decode completed, so the kernels are compiled and
-        the geometry no longer occupies the global admission budget."""
+        """Release the compile slot for (k, n): its first full-backend
+        decode finished (either way — the compile is over), so the
+        geometry no longer occupies the global admission budget."""
         with self._novel_lock:
             self._novel_inflight.pop((k, n), None)
+            self._novel_pending.pop((k, n), None)
 
     def prewarm(self, geometries=None, stripe_len: int = 64) -> None:
         """Build (and jit-warm) codecs for ``geometries`` before traffic.
@@ -913,6 +929,7 @@ class ShardPlugin:
                     return delivered
                 return self._repair_stream(ctx, msg, key, k, n, count)
         fec = self._fec_receive(k, n, ctx)
+        self._geometry_decode_begin(k, n)
         try:
             with Timer(self.counters, "decode_s",
                        nbytes=sum(len(s.data) for s in snapshot)):
@@ -1044,6 +1061,7 @@ class ShardPlugin:
                         return None
                     if len(shares) <= st["done"].get(i, 0):
                         continue
+                self._geometry_decode_begin(k, n)
                 try:
                     chunk = fec.decode(shares)
                 except Exception:  # noqa: BLE001 — keep repairing others
@@ -1163,6 +1181,7 @@ class ShardPlugin:
 
         # CASE C: enough distinct shares — decode + verify (main.go:72-99).
         fec = self._fec_receive(k, n, ctx)
+        self._geometry_decode_begin(k, n)
         try:
             with Timer(self.counters, "decode_s",
                        nbytes=sum(len(s.data) for s in snapshot)):

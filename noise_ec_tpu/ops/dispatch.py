@@ -289,15 +289,20 @@ class DeviceCodec:
         kernel (cached).
 
         The shared choke point for EVERY baked-kernel entry (words,
-        planes, byte-sliced), so the near-field-limit guard lives here:
-        a matrix past the baked budget must never reach Paar factoring
-        (>9 min measured) or the pack stage (VMEM OOM) through any path.
-        matmul_stripes/matmul_words route such matrices to the MXU before
-        ever calling this; direct callers get the clear error.
+        planes, byte-sliced), so the PLANNING-TIME guard lives here: a
+        network past the XOR budget must never reach Paar factoring
+        (>9 min measured) or bake an unboundedly large program, through
+        any path. Only the XOR-cost bound applies at this level — the
+        row bound models the words entries' pack-stage VMEM, which the
+        planes entry never runs, so it is enforced by route_for at the
+        words/stripes routing decision instead (a (3, 200)
+        reconstruction matrix stays legal here for matmul_planes).
+        matmul_stripes/matmul_words route over-budget matrices to the
+        MXU before ever calling this; direct callers get the clear error.
         """
-        if self.route_for(M) == "mxu":
+        if self._xor_cost_for(M) > _BAKED_XOR_BUDGET:
             raise NotImplementedError(
-                "matrix exceeds the baked-kernel budget; use "
+                "matrix exceeds the baked-kernel XOR budget; use "
                 "matmul_stripes/matmul_words (gf256) or the byte-sliced "
                 "entries (gf65536) — the MXU route"
             )
@@ -330,18 +335,15 @@ class DeviceCodec:
         XOR-network VPU kernels) or "mxu" (dense int8 bit-plane matmul).
         Exposed so tests can pin the near-field-limit fallback.
 
-        For the wide field the row bound counts BYTE rows (the byte-
-        sliced pipeline runs 2k of them) and the tighter 112-row ceiling
-        applies (see _guarded note in matmul_words_batch): past either
-        bound the byte-sliced entries run the same MXU kernel — the bit
-        matrix is field-blind — via _bytesliced_words.
+        The row bound counts the rows the BAKED PIPELINE runs: symbol
+        rows for gf256, 2x byte rows for the byte-sliced wide field —
+        one bound (_BAKED_MAX_ROWS) for the one pack stage both share.
+        Past either bound, both fields run the same MXU kernel (the bit
+        matrix is field-blind) via their routed entries.
         """
         r, k = np.asarray(M).shape
-        if self.gf.degree == 16:
-            if 2 * max(r, k) > 112 or self._xor_cost_for(M) > _BAKED_XOR_BUDGET:
-                return "mxu"
-            return "baked"
-        if max(r, k) > _BAKED_MAX_ROWS:
+        rows = 2 * max(r, k) if self.gf.degree == 16 else max(r, k)
+        if rows > _BAKED_MAX_ROWS:
             return "mxu"
         if self._xor_cost_for(M) > _BAKED_XOR_BUDGET:
             return "mxu"
@@ -349,24 +351,12 @@ class DeviceCodec:
 
     def _m2_for_wide(self, M: np.ndarray):
         """Cached (16r, 16k) int8 bit expansion of a gf65536 matrix for
-        the byte-sliced MXU route — bounded, and promoted to a
-        device-resident array outside any active trace so repeated
-        encodes do not re-stage a multi-MB operand (mirrors
-        MxuCodec._m2_for, including the tracer-leak guard)."""
-        from noise_ec_tpu.ops.mxu_gf2 import _trace_state_clean
+        the byte-sliced MXU route (shared implementation — see
+        mxu_gf2.cached_bit_expansion for the key scheme, bound, and
+        tracer-leak guard)."""
+        from noise_ec_tpu.ops.mxu_gf2 import cached_bit_expansion
 
-        M = np.ascontiguousarray(np.asarray(M, dtype=self.gf.dtype))
-        key = self._key(M)
-        hit = self._m2w_cache.get(key)
-        if hit is None:
-            hit = expand_generator_bits(self.gf, M).astype(np.int8)
-            if len(self._m2w_cache) > 64:
-                self._m2w_cache.clear()
-            self._m2w_cache[key] = hit
-        if isinstance(hit, np.ndarray) and _trace_state_clean():
-            hit = jnp.asarray(hit)
-            self._m2w_cache[key] = hit
-        return hit
+        return cached_bit_expansion(self._m2w_cache, self.gf, M, bound=64)
 
     def _mxu_for(self):
         if self._mxu is None:

@@ -104,6 +104,31 @@ def _mxu_encode_words_jit(m2, words, *, r, k, tile_words, interpret):
     )(m2, words)
 
 
+def cached_bit_expansion(cache: dict, gf: GF, M: np.ndarray,
+                         *, bound: int = 256):
+    """Cached int8 GF(2) bit expansion of ``M`` with device promotion.
+
+    One implementation for every MXU caller (MxuCodec and the dispatch
+    wide-field route) so the cache-key scheme (full shape + bytes — the
+    r4 collision fix), the size bound, and the tracer-leak guard cannot
+    diverge: the promotion to a device-resident jnp array happens ONLY
+    outside an active trace (jnp.asarray under tracing returns a tracer,
+    and caching that leaks it into later calls).
+    """
+    M = np.ascontiguousarray(np.asarray(M, dtype=gf.dtype))
+    key = (M.shape, M.tobytes())
+    hit = cache.get(key)
+    if hit is None:
+        hit = expand_generator_bits(gf, M).astype(np.int8)
+        if len(cache) > bound:
+            cache.clear()
+        cache[key] = hit
+    if isinstance(hit, np.ndarray) and _trace_state_clean():
+        hit = jnp.asarray(hit)
+        cache[key] = hit
+    return hit
+
+
 def mxu_encode_words_bits(
     m2: np.ndarray,
     words,
@@ -161,25 +186,7 @@ class MxuCodec:
         self._m2_cache: dict = {}
 
     def _m2_for(self, M: np.ndarray):
-        M = np.ascontiguousarray(np.asarray(M, dtype=self.gf.dtype))
-        # Full shape in the key: same bytes under congruent-mod-256 column
-        # counts must not collide (r4 advisor finding).
-        key = (M.shape, M.tobytes())
-        hit = self._m2_cache.get(key)
-        if hit is None:
-            hit = expand_generator_bits(self.gf, M).astype(np.int8)
-            if len(self._m2_cache) > 256:
-                self._m2_cache.clear()
-            self._m2_cache[key] = hit
-        # Promote to a device-resident array so repeated encodes do not
-        # re-stage the (8r, 8k) operand — but ONLY outside any active
-        # trace: jnp.asarray executed while an outer jit is tracing
-        # returns a tracer, and caching that leaks it into later calls
-        # (observed with the bench's chained fori_loop harness).
-        if isinstance(hit, np.ndarray) and _trace_state_clean():
-            hit = jnp.asarray(hit)
-            self._m2_cache[key] = hit
-        return hit
+        return cached_bit_expansion(self._m2_cache, self.gf, M)
 
     def encode_words(self, M: np.ndarray, words) -> jnp.ndarray:
         """(r, k) GF matrix x (k, TW) u32 words -> (r, TW) parity words.

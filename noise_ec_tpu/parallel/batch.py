@@ -344,12 +344,42 @@ class BatchCodec:
                 P(None, None) if row_axis is None else P(row_axis, None)
             )
         else:
-            row_groups = [
-                bits_to_rows(
-                    expand_generator_bits(self.gf, M[d * Rl : (d + 1) * Rl])
+            # Round-5 route gate, mirroring DeviceCodec.route_for: a
+            # near-field-limit matrix must not reach Paar factoring
+            # (>9 min measured) or the pack stage's VMEM through the
+            # mesh path either — it runs the dense MXU kernel per row
+            # slice instead (the MXU program is jit-composable inside
+            # shard_map, so DP/TP sharding is unchanged).
+            from noise_ec_tpu.ops.dispatch import (
+                _BAKED_MAX_ROWS,
+                _BAKED_XOR_BUDGET,
+            )
+
+            bits_full = expand_generator_bits(self.gf, M)
+            cost = int(np.count_nonzero(bits_full)) - bits_full.shape[0]
+            rows_eff = max(M.shape) * (2 if m == 16 else 1)
+            mxu_route = (
+                cost > _BAKED_XOR_BUDGET or rows_eff > _BAKED_MAX_ROWS
+            )
+            if mxu_route and m != 8:
+                raise NotImplementedError(
+                    "near-field-limit GF(2^16) has no mesh words kernel; "
+                    "use the stripes path (make_sharded_matmul) or GF(2^8)"
                 )
-                for d in range(rsz)
-            ]
+            if mxu_route:
+                slice_groups: list = [
+                    expand_generator_bits(
+                        self.gf, M[d * Rl : (d + 1) * Rl]
+                    ).astype(np.int8)
+                    for d in range(rsz)
+                ]
+            else:
+                slice_groups = [
+                    bits_to_rows(
+                        expand_generator_bits(self.gf, M[d * Rl : (d + 1) * Rl])
+                    )
+                    for d in range(rsz)
+                ]
 
         def local_pallas(words_local):
             from noise_ec_tpu.ops.pallas_fused import (
@@ -363,6 +393,31 @@ class BatchCodec:
                 words_local = jnp.pad(words_local, ((0, 0), (0, 0), (0, TWp - TW)))
             W8 = TWp // (8 * m)
 
+            if mxu_route:
+                from noise_ec_tpu.ops.mxu_gf2 import mxu_encode_words_bits
+
+                def encode_slice(w, m2):
+                    return mxu_encode_words_bits(
+                        m2, w, r=Rl, k=k, interpret=interpret
+                    )
+
+                def one(w):
+                    branches = [
+                        (lambda w, g=g: encode_slice(w, g))
+                        for g in slice_groups
+                    ]
+                    if rsz == 1:
+                        return branches[0](w)
+                    return jax.lax.switch(
+                        jax.lax.axis_index(row_axis), branches, w
+                    )
+
+                out = jax.vmap(one)(words_local)[:, :, :TW]
+                if row_axis is not None:
+                    out = jax.lax.all_gather(out, row_axis, axis=1, tiled=True)
+                return out
+
+            row_groups = slice_groups
             # Tier 1: the single fused kernel per row slice (pack -> matmul
             # -> unpack in VMEM scratch; see ops/pallas_fused.py). Tier 2:
             # the three-kernel lane pipeline when the fused tile cannot fit

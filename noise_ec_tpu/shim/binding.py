@@ -317,6 +317,12 @@ def gf_decode1_fused(
         return None
     Ab = np.ascontiguousarray(A, dtype=np.uint8)
     r2, k = Ab.shape
+    if r2 > 255:
+        # The C kernel's per-column counter is uint8: more check rows
+        # would wrap the count and silently mis-classify columns (same
+        # guard as gf_syndrome_rows). Reachable via custom generator
+        # matrices through syndrome_decode_rows_any; NumPy fallback.
+        return None
     out = np.empty(length, dtype=np.uint8)
     state = np.empty(length, dtype=np.uint8)
     b_ptrs, b_keep = _row_ptrs(basis)

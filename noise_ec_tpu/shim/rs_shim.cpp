@@ -554,8 +554,9 @@ int rs16_matmul_rows(const uint16_t* M, int r, int k,
                      const uint16_t* const* in, uint16_t* const* out,
                      size_t len) {
   if (!M || !in || !out || r < 1 || k < 1) return -1;
+  if (len == 0) return 0;  // zero-length rows: nothing to write
   constexpr size_t kTile = 16 << 10;  // symbols: 32 KiB per row tile
-  for (size_t off = 0; off < len || off == 0; off += kTile) {
+  for (size_t off = 0; off < len; off += kTile) {
     size_t t = len - off < kTile ? len - off : kTile;
     for (int i = 0; i < r; ++i) {
       std::memset(out[i] + off, 0, 2 * t);
@@ -563,7 +564,6 @@ int rs16_matmul_rows(const uint16_t* M, int r, int k,
         mul_add_row16(out[i] + off, in[j] + off,
                       M[static_cast<size_t>(i) * k + j], t);
     }
-    if (len == 0) break;
   }
   return 0;
 }

@@ -1115,3 +1115,41 @@ def test_decode_chaos_soak_speculative_vs_generic(seed):
                 gf, "cauchy", k, n, nums, rows_bad, _speculate=False
             )
             assert s1 is None and s2 is None, (seed, trial, "radius")
+
+
+def test_fused_refuses_geometries_beyond_uint8_counts(rng):
+    """A custom generator with more than 255 check rows (reachable via
+    syndrome_decode_rows_any) must NOT run the GF(2^8) fused kernel — its
+    uint8 per-column counter would wrap and silently mis-classify
+    columns. Confirmed r5: the speculative path returned corrupted bytes
+    where the generic path decoded correctly; the binding now refuses
+    r2 > 255 and speculation falls back to the generic machinery."""
+    from noise_ec_tpu.matrix.bw import syndrome_decode_rows_any
+    from noise_ec_tpu.matrix.linalg import gf_inv
+
+    gf = GF256()
+    k, n, S = 4, 300, 262_144 + 512  # r2 = 296 > 255
+    rng2 = np.random.default_rng(0xBADC)
+    while True:  # random parity block with an invertible first-k basis
+        G = np.concatenate(
+            [np.eye(k, dtype=np.uint8),
+             rng2.integers(0, 256, size=(n - k, k)).astype(np.uint8)],
+        )
+        try:
+            gf_inv(gf, G[:k])
+            break
+        except np.linalg.LinAlgError:
+            continue
+    data = rng2.integers(0, 256, size=(k, S), dtype=np.int64).astype(np.uint8)
+    cw = gf.matvec_stripes(
+        G.astype(np.int64), data.astype(np.int64)
+    ).astype(np.uint8)
+    rows = [np.ascontiguousarray(cw[i]) for i in range(n)]
+    rows[0] = rows[0] ^ np.uint8(0x5D)  # whole-share corrupt basis row 0
+    spec = syndrome_decode_rows_any(gf, G, k, list(range(n)), rows)
+    gen = syndrome_decode_rows_any(
+        gf, G, k, list(range(n)), rows, _speculate=False
+    )
+    assert spec is not None and gen is not None
+    np.testing.assert_array_equal(np.stack(spec[0]), data)
+    np.testing.assert_array_equal(np.stack(gen[0]), data)

@@ -655,6 +655,9 @@ def test_inflight_compile_cap_limits_and_releases(monkeypatch):
     for i in range(cap):
         fec = plugin._fec_receive(2, 3 + i, ctx_for(i))
         assert fec._rs.backend == "device", i
+        # Admission alone must NOT occupy a slot (stray shards that never
+        # assemble to k cannot pin the budget); the decode start does.
+        plugin._geometry_decode_begin(2, 3 + i)
     assert len(plugin._novel_inflight) == cap
     # Slots saturated: a fresh identity's novel geometry is demoted.
     fec = plugin._fec_receive(2, 3 + cap, ctx_for(cap))
@@ -664,6 +667,7 @@ def test_inflight_compile_cap_limits_and_releases(monkeypatch):
     plugin._geometry_ready(2, 3)
     fec = plugin._fec_receive(2, 30, ctx_for(cap + 1))
     assert fec._rs.backend == "device"
+    plugin._geometry_decode_begin(2, 30)  # its decode starts, then hangs
     # Grace expiry reclaims stuck slots.
     real = _time.monotonic()
     monkeypatch.setattr(
@@ -674,7 +678,7 @@ def test_inflight_compile_cap_limits_and_releases(monkeypatch):
     )
     fec = plugin._fec_receive(2, 31, ctx_for(cap + 2))
     assert fec._rs.backend == "device"
-    assert (2, 31) in plugin._novel_inflight
+    assert (2, 31) in plugin._novel_pending
     assert (2, 30) not in plugin._novel_inflight  # reclaimed
 
 
@@ -801,3 +805,60 @@ def test_global_window_backstop_bounds_fast_compile_floods(monkeypatch):
             admitted += 1
     assert admitted == cap
     assert plugin.counters.get("geometry_rate_limited") == 10
+
+
+def test_stray_shards_do_not_pin_compile_slots():
+    """Two novel geometries that receive only ONE shard each (never
+    enough to decode) must not occupy in-flight compile slots: a third
+    sender's novel geometry still gets the full backend (r5 holistic
+    review: admission-at-first-shard pinned both slots for the whole
+    grace window at 2 stray shards/min)."""
+    from noise_ec_tpu.codec.fec import FEC
+    from noise_ec_tpu.host.crypto import KeyPair, PeerID, serialize_message
+    from noise_ec_tpu.host.wire import Shard as WireShard
+
+    plugin = ShardPlugin(backend="device")
+    for i in range(2):  # two stray single-shard objects, fresh identities
+        keys = KeyPair.from_seed(bytes([120 + i]) * 32)
+        peer = PeerID.create(f"tcp://localhost:{7700 + i}", keys.public_key)
+
+        class Ctx:
+            def __init__(self, msg, peer=peer):
+                self._msg, self._sender = msg, peer
+
+            def message(self):
+                return self._msg
+
+            def sender(self):
+                return self._sender
+
+            def client_public_key(self):
+                return self._sender.public_key
+
+        k, n = 4, 8 + i
+        payload = bytes(range(32))
+        sig = keys.sign(plugin.signature_policy, plugin.hash_policy,
+                        serialize_message(peer, payload))
+        s = FEC(k, n, backend="numpy").encode_shares(payload)[0]
+        plugin.receive(Ctx(WireShard(
+            file_signature=sig, shard_data=s.data, shard_number=s.number,
+            total_shards=n, minimum_needed_shards=k,
+        )))
+    assert not plugin._novel_inflight  # no decode ran -> no slot held
+    # A bystander's novel geometry is admitted on the full backend.
+    keys = KeyPair.from_seed(bytes([99]) * 32)
+    peer = PeerID.create("tcp://localhost:7800", keys.public_key)
+
+    class Ctx2:
+        def message(self):
+            return None
+
+        def sender(self):
+            return peer
+
+        def client_public_key(self):
+            return peer.public_key
+
+    fec = plugin._fec_receive(5, 9, Ctx2())
+    assert fec._rs.backend == "device"
+    assert plugin.counters.get("geometry_rate_limited") in (0.0, 0)

@@ -288,3 +288,42 @@ def test_sharded_decode1_corrects_over_mesh():
     ok = ~(out[:, 1:] != 0).any(axis=1)
     assert ok.all(), "single-support hypothesis must verify everywhere here"
     np.testing.assert_array_equal(out[:, 0], data[:, 5])
+
+
+def test_sharded_words_near_limit_routes_to_mxu():
+    """make_sharded_matmul_words must not bake a ~361k-XOR network for
+    near-field-limit geometries (the >9-min Paar hang / pack-stage OOM
+    the round-5 route gate exists to prevent): RS(200,56) runs the dense
+    MXU kernel per row slice under shard_map, bit-exact vs golden, and
+    planning completes in seconds."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from noise_ec_tpu.golden.codec import GoldenCodec
+    from noise_ec_tpu.parallel.batch import BatchCodec
+    from noise_ec_tpu.parallel.mesh import make_mesh
+
+    devs = jax.devices()[:8]
+    mesh = make_mesh(("batch", "row"), (4, 2), devs)
+    k, r = 200, 56
+    bc = BatchCodec(k, r)
+    B, TW = 8, 512  # words per shard
+    rng = np.random.default_rng(0x200)
+    words = rng.integers(0, 1 << 32, size=(B, k, TW), dtype=np.uint64).astype(np.uint32)
+    t0 = time.monotonic()
+    enc = bc.make_sharded_matmul_words(
+        mesh, bc.parity_matrix, row_axis="row", kernel="pallas_interpret"
+    )
+    parity = np.asarray(jax.block_until_ready(enc(jnp.asarray(words))))
+    elapsed = time.monotonic() - t0
+    assert elapsed < 300, f"near-limit mesh words path took {elapsed:.0f}s"
+    gold = GoldenCodec(k, k + r)
+    for b in range(2):  # spot-check two objects bit-exactly
+        want = np.asarray(
+            gold.encode(np.ascontiguousarray(words[b]).view(np.uint8))
+        )
+        got = np.ascontiguousarray(parity[b]).view(np.uint8)
+        np.testing.assert_array_equal(got, want)
