@@ -1153,3 +1153,28 @@ def test_fused_refuses_geometries_beyond_uint8_counts(rng):
     assert spec is not None and gen is not None
     np.testing.assert_array_equal(np.stack(spec[0]), data)
     np.testing.assert_array_equal(np.stack(gen[0]), data)
+
+
+def test_device_decode1_gf65536(rng):
+    """The decode1 fold is field-generic: a gf65536 whole-share
+    corruption corrects through DeviceCodec.decode1_words on the wide
+    field's 16-plane kernels (interpret mode), consistency rows zero."""
+    from noise_ec_tpu.matrix.linalg import gf_inv
+    from noise_ec_tpu.ops.dispatch import DeviceCodec
+
+    gf = GF65536()
+    k, n, S = 6, 10, 2048  # symbols
+    gold = GoldenCodec(k, n, field="gf65536")
+    data = rng.integers(0, 1 << 16, size=(k, S)).astype(np.uint16)
+    cw = gold.encode_all(data).astype(np.uint16)
+    cw[3] ^= 0x5A5A
+    A = gf.matmul(
+        gold.G[k:].astype(np.int64), gf_inv(gf, gold.G[:k]).astype(np.int64)
+    ).astype(np.uint16)
+    dev = DeviceCodec(field="gf65536", kernel="pallas_interpret")
+    import jax.numpy as jnp
+    words = jnp.asarray(np.ascontiguousarray(cw).view("<u4"))
+    c_w, bad_w = dev.decode1_words(A, 3, words)
+    got = np.asarray(c_w)[None].view("<u2")[0][:S]
+    np.testing.assert_array_equal(got, data[3])
+    assert not np.asarray(bad_w).any()
