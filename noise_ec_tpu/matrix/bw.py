@@ -310,6 +310,43 @@ def _solve_support_gathered(
     return ok, z
 
 
+def _single_supports_batch(
+    gf: GF, A: np.ndarray, k: int, sc64: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-column single BASIS-row supports for a batch of syndrome
+    columns, in one algebra pass over the (r2, nb) batch.
+
+    Returns ``(jstar, Z)``: ``jstar[c]`` is the basis row whose single
+    error explains column c in FULL (the candidate magnitude
+    Z[c, jstar[c]] = sigma[p0]/A[p0, j] predicts sigma on EVERY check
+    row), or -1 when no single basis row does. The full-row match IS the
+    verification — a column with jstar >= 0 needs no further solve.
+    Extra-row singles are the caller's concern (they cannot appear among
+    radius-flagged columns: a single extra-row error gives count 1 <= e).
+    The ONE implementation behind both the scalar discovery helper and
+    the gathered classification pass, so the support algebra cannot
+    diverge between them.
+    """
+    r2, nb = sc64.shape
+    p0 = np.argmax(sc64 != 0, axis=0)
+    s_p0 = sc64[p0, np.arange(nb)]
+    A64 = np.asarray(A, dtype=np.int64)
+    Ap0 = A64[p0]  # (nb, k): row p0_c of A per column
+    valid = Ap0 != 0
+    Z = np.zeros((nb, k), dtype=np.int64)
+    if valid.any():
+        Z[valid] = np.asarray(gf.div(
+            np.broadcast_to(s_p0[:, None], (nb, k))[valid], Ap0[valid],
+        ), dtype=np.int64)
+    pred = np.asarray(
+        gf.mul(A64[:, None, :], Z[None, :, :]), dtype=np.int64
+    )  # (r2, nb, k)
+    match = valid & (pred == sc64[:, :, None]).all(axis=0)
+    has = match.any(axis=1)
+    jstar = np.where(has, np.argmax(match, axis=1), -1)
+    return jstar, Z
+
+
 def _single_support_from_sigma(
     gf: GF, A: np.ndarray, k: int, sigma: np.ndarray
 ) -> Optional[frozenset]:
@@ -333,21 +370,9 @@ def _single_support_from_sigma(
         return frozenset()
     if nz.size == 1:
         return frozenset([k + int(nz[0])])
-    p0 = int(nz[0])
-    Ap0 = np.asarray(A[p0], dtype=np.int64)
-    valid = np.flatnonzero(Ap0)
-    if valid.size == 0:
-        return None
-    zj = np.asarray(
-        gf.div(int(sig[p0]), Ap0[valid]), dtype=np.int64
-    )
-    pred = np.asarray(
-        gf.mul(np.asarray(A, dtype=np.int64)[:, valid], zj[None, :]),
-        dtype=np.int64,
-    )
-    match = np.flatnonzero((pred == sig[:, None]).all(axis=0))
-    if match.size:
-        return frozenset([int(valid[match[0]])])
+    jstar, _ = _single_supports_batch(gf, A, k, sig[:, None])
+    if jstar[0] >= 0:
+        return frozenset([int(jstar[0])])
     return None
 
 
@@ -614,6 +639,40 @@ def syndrome_decode_rows(
         if nrem:
             if e == 0:
                 return None  # any inconsistency is beyond the radius
+            if nrem <= _GATHER_CAP:
+                # Vectorized single-support classification of EVERY
+                # gathered bad column at once: one algebra pass finds
+                # each column's single-row explanation (if any), and one
+                # gathered solve per distinct support group applies it —
+                # so scattered corruption across several shares resolves
+                # in a single round instead of one discovery + solve
+                # round per support. Columns no single row explains fall
+                # through to the shared-support rounds below unchanged.
+                remaining = np.flatnonzero(rem_mask)
+                nb = remaining.size
+                # Chunked: the classification's (r2, chunk, k) temporaries
+                # must stay bounded for large geometries at the gather cap
+                # (a full-width (64, 65536, 96) int64 batch would be GBs).
+                chunk = max(512, (1 << 24) // max(1, r2 * k))
+                for lo in range(0, nb, chunk):
+                    idx = remaining[lo : lo + chunk]
+                    sc64 = np.ascontiguousarray(
+                        s[:, idx]
+                    ).astype(np.int64)
+                    jstar, Z = _single_supports_batch(gf, A, k, sc64)
+                    for j_s in np.unique(jstar[jstar >= 0]):
+                        cols_j = np.flatnonzero(jstar == j_s)
+                        okcols = idx[cols_j]
+                        # The full-row match in _single_supports_batch IS
+                        # the verification (Z predicts sigma on every
+                        # check row), so the correction applies directly
+                        # — no second solve/verify pass.
+                        corrections.setdefault(int(j_s), []).append(
+                            ("sparse", okcols,
+                             Z[cols_j, j_s].astype(gf.dtype))
+                        )
+                        rem_mask[okcols] = False
+                        nrem -= int(okcols.size)
             T: list[int] = []
             for _round in range(e + 1):
                 if not nrem:
