@@ -613,8 +613,19 @@ class ShardPlugin:
         count: int, length: int, chunks,
     ) -> int:
         shards_out = bytes_out = 0
+        # Transport backpressure PER SHARE: without it a bulk stream
+        # outruns TCP drain and the transport's anti-DoS write cap
+        # disconnects the peers mid-object. Per-share (not per-chunk)
+        # with the share's own size as headroom, so the guarantee holds
+        # for any geometry/chunk combination — a whole chunk's burst can
+        # exceed the cap's headroom on its own (e.g. k=1 fan-out).
+        # Transports without the hook — the loopback fake — are
+        # unbuffered. The non-busy check is one short lock + int reads.
+        waiter = getattr(network, "wait_writable", None)
         for index, shares in self._encode_chunk_stream(chunks, k, n, B):
             for s in shares:
+                if waiter is not None:
+                    waiter(headroom=len(s.data) + 4096)
                 shard = Shard(
                     file_signature=file_signature,
                     shard_data=s.data,

@@ -26,6 +26,7 @@ import logging
 import os
 import struct
 import threading
+import time
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
@@ -483,6 +484,10 @@ class TCPNetwork:
         # Write coalescing state — touched only on the event-loop thread.
         self._pending: dict[asyncio.StreamWriter, list[bytes]] = {}
         self._pending_bytes: dict[asyncio.StreamWriter, int] = {}
+        # Bytes posted cross-thread (broadcast -> call_soon queue) but not
+        # yet seen by _enqueue_frame; guarded by self._lock. Part of the
+        # wait_writable backpressure measurement.
+        self._posted_bytes: dict[asyncio.StreamWriter, int] = {}
         self._flush_handles: dict[asyncio.StreamWriter, asyncio.TimerHandle] = {}
         self._draining: set[asyncio.StreamWriter] = set()
         # Discovery state: addresses we are responsible for dialing (dedup +
@@ -638,8 +643,71 @@ class TCPNetwork:
         frame = self._frame(_OP_SHARD, msg.marshal())
         with self._lock:
             writers = [p.writer for p in self.peers.values()]
+            # Count the bytes as posted BEFORE handing them to the loop
+            # thread: a frame sitting in call_soon_threadsafe's queue is
+            # visible to neither the kernel buffer nor the coalesce
+            # batch, so without this the backpressure waiter reads
+            # "empty" while a starved loop thread holds an unbounded
+            # backlog (observed: cap disconnects despite per-share
+            # waiting on a loaded single-core host).
+            for w in writers:
+                self._posted_bytes[w] = (
+                    self._posted_bytes.get(w, 0) + len(frame)
+                )
         for w in writers:
             self._loop.call_soon_threadsafe(self._enqueue_frame, w, frame)
+
+    def wait_writable(
+        self,
+        soft_cap: Optional[int] = None,
+        timeout: float = 30.0,
+        headroom: int = 0,
+    ) -> None:
+        """Producer-side backpressure for bulk streams: block the calling
+        (non-loop) thread until every peer's outgoing buffer (kernel +
+        asyncio + the coalesce batch + cross-thread posted frames) is
+        below ``soft_cap`` (default: the hard cap minus the caller's
+        ``headroom``, floored at 1/8 of the cap).
+
+        Without this, a sender producing faster than its peers drain —
+        e.g. streaming a multi-hundred-MiB object to a receiver that is
+        busy decoding — walks the write buffer into the
+        MAX_PEER_WRITE_BUFFER hard cap and DISCONNECTS its own peer
+        mid-stream (found by a 256 MiB real-TCP soak): the hard cap is an
+        anti-DoS bound against unresponsive READERS, not a send-rate
+        governor. The stream emitter calls this between chunks. Reads
+        are cross-thread snapshots (plain int reads under the GIL);
+        staleness costs at most one extra 5 ms poll. On timeout the
+        caller proceeds — a genuinely stalled peer is then the hard
+        cap's and write_timeout's job to drop.
+        """
+        if soft_cap is None:
+            # Derive from the hard cap MINUS what the caller is about to
+            # enqueue (``headroom``): waiting to "half full" is not
+            # enough when the next burst alone exceeds the other half.
+            # The floor keeps progress even for outsized bursts — a
+            # single frame larger than the hard cap cannot be saved by
+            # any waiting policy.
+            soft_cap = max(
+                self.MAX_PEER_WRITE_BUFFER - headroom,
+                self.MAX_PEER_WRITE_BUFFER // 8,
+            )
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                with self._lock:
+                    writers = [p.writer for p in self.peers.values()]
+                    posted = [self._posted_bytes.get(w, 0) for w in writers]
+                busy = any(
+                    w.transport.get_write_buffer_size()
+                    + self._pending_bytes.get(w, 0) + posted_w > soft_cap
+                    for w, posted_w in zip(writers, posted)
+                )
+            except Exception:  # noqa: BLE001 — peer set mutating mid-scan
+                busy = True
+            if not busy:
+                return
+            time.sleep(0.005)
 
     # -- write path (event-loop thread only) --
 
@@ -648,7 +716,7 @@ class TCPNetwork:
         batch reaches ``write_buffer_size`` bytes or ``send_window`` frames,
         otherwise after ``write_flush_latency``."""
         if writer.transport.get_write_buffer_size() > self.MAX_PEER_WRITE_BUFFER:
-            self._drop_writer(writer)
+            self._drop_writer(writer)  # also clears _posted_bytes
             self._record_error(
                 RuntimeError("peer write buffer exceeded cap; disconnected")
             )
@@ -657,6 +725,16 @@ class TCPNetwork:
         pend.append(frame)
         total = self._pending_bytes.get(writer, 0) + len(frame)
         self._pending_bytes[writer] = total
+        with self._lock:
+            # Decrement the cross-thread posted counter only AFTER the
+            # bytes are visible in the coalesce batch: the backpressure
+            # waiter must always see in-flight bytes counted SOMEWHERE
+            # (posted -> pending -> transport buffer, in that order).
+            left = self._posted_bytes.get(writer, 0) - len(frame)
+            if left > 0:
+                self._posted_bytes[writer] = left
+            else:
+                self._posted_bytes.pop(writer, None)
         if total >= self.write_buffer_size or len(pend) >= self.send_window:
             self._flush_writer(writer)
         elif writer not in self._flush_handles:
@@ -669,14 +747,19 @@ class TCPNetwork:
         if handle is not None:
             handle.cancel()
         pend = self._pending.pop(writer, None)
-        self._pending_bytes.pop(writer, None)
         if not pend:
+            self._pending_bytes.pop(writer, None)
             return
         try:
+            # _pending_bytes is cleared only after write() lands the batch
+            # in the transport buffer, so the backpressure waiter never
+            # sees the bytes vanish from both counters at once.
             writer.write(b"".join(pend))
         except Exception as exc:  # noqa: BLE001
             self._record_error(exc)
             return
+        finally:
+            self._pending_bytes.pop(writer, None)
         # Enforce write_timeout: a peer that cannot drain for that long is
         # disconnected. One drain task per writer at a time (asyncio allows
         # a single drain waiter).
@@ -726,6 +809,8 @@ class TCPNetwork:
             handle.cancel()
         self._pending.pop(writer, None)
         self._pending_bytes.pop(writer, None)
+        with self._lock:
+            self._posted_bytes.pop(writer, None)
         try:
             writer.close()
         except Exception:  # noqa: BLE001

@@ -1178,3 +1178,25 @@ def test_device_decode1_gf65536(rng):
     got = np.asarray(c_w)[None].view("<u2")[0][:S]
     np.testing.assert_array_equal(got, data[3])
     assert not np.asarray(bad_w).any()
+
+
+def test_gathered_two_row_supports_fall_through_to_rounds(rng):
+    """Columns where TWO shares are corrupt at the SAME positions have no
+    single-row support: the vectorized classification must leave them for
+    the shared-support rounds, which solve the {a, b} support exactly."""
+    from noise_ec_tpu.matrix.bw import syndrome_decode_rows
+
+    gf = GF256()
+    k, n, S = 10, 14, 4096
+    gold = GoldenCodec(k, n)
+    data = rng.integers(0, 256, size=(k, S), dtype=np.int64).astype(np.uint8)
+    cw = gold.encode_all(data).astype(np.uint8)
+    rows = [np.ascontiguousarray(cw[i]) for i in range(n)]
+    cols = rng.permutation(S)[:23]
+    for j, mask in ((2, 0x41), (6, 0x87)):  # same columns, two shares
+        rr = rows[j].copy()
+        rr[cols] ^= mask
+        rows[j] = rr
+    res = syndrome_decode_rows(gf, "cauchy", k, n, list(range(n)), rows)
+    assert res is not None
+    np.testing.assert_array_equal(np.stack(res[0]), data)

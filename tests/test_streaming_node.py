@@ -462,3 +462,52 @@ def test_stream_whole_share_corruption_fused_path_end_to_end():
         plugin.receive(_Ctx(s, sender))
     assert [m for m, _ in inboxes[1]] == [data]
     assert plugin.counters.get("verified") == 1
+
+
+def test_stream_backpressure_survives_tiny_write_cap():
+    """Producer-side backpressure: with the peer-write hard cap shrunk to
+    2 MiB, a 24 MiB stream over real TCP must throttle between chunks
+    instead of walking its peer into the cap and disconnecting it
+    mid-object (found by a 256 MiB soak; the hard cap is an anti-DoS
+    bound for unresponsive readers, not a send-rate governor)."""
+    import time
+
+    from noise_ec_tpu.host.transport import TCPNetwork
+
+    rng = np.random.default_rng(31)
+    nets, inbox = [], []
+    try:
+        for i in range(2):
+            net = TCPNetwork(host="127.0.0.1", port=0)
+            # Instance-level shrink. The emitter waits per SHARE with
+            # the share's size as headroom, so the invariant is just
+            # "one frame fits under the hard cap" — 256 KiB chunks give
+            # ~26 KiB shares against the 4 MiB cap.
+            net.MAX_PEER_WRITE_BUFFER = 4 << 20
+            net.add_plugin(ShardPlugin(
+                backend="numpy", minimum_needed_shards=10, total_shards=14,
+                on_object=lambda m, s: inbox.append(len(m)),
+            ))
+            net.listen()
+            nets.append(net)
+        nets[1].bootstrap([nets[0].id.address])
+        deadline = time.time() + 10
+        while time.time() < deadline and (
+            not nets[0].peers or not nets[1].peers
+        ):
+            time.sleep(0.02)
+        assert nets[0].peers and nets[1].peers
+        data = bytes(rng.integers(0, 256, size=16 << 20, dtype=np.uint8))
+        nets[0].plugins[0].stream_and_broadcast(
+            nets[0], data, chunk_bytes=1 << 18
+        )
+        deadline = time.time() + 120
+        while time.time() < deadline and not inbox:
+            time.sleep(0.05)
+        assert inbox == [len(data)], (
+            inbox, list(nets[0].errors), list(nets[1].errors),
+        )
+        assert not nets[0].errors and not nets[1].errors
+    finally:
+        for net in nets:
+            net.close()
