@@ -172,8 +172,15 @@ def main() -> None:
         # stretch the 9 rounds across ~2 s so the p50 spans epochs
         # instead of living entirely inside one.
         samples: dict[str, list] = {name: [] for name in cases}
+        order = list(cases.items())
         for round_i in range(9):
-            for name, (fec_c, bad) in cases.items():
+            # Rotate the case order per round: whichever case runs first
+            # after the sleep takes the cold-cache hit, and a FIXED order
+            # hands that penalty to the same case every round (measured:
+            # it flattens a ~0.3 ms structural gap into a coin flip).
+            for name, (fec_c, bad) in (
+                order[round_i % len(order):] + order[: round_i % len(order)]
+            ):
                 t0 = time.perf_counter()
                 fec_c.decode(bad)
                 samples[name].append(time.perf_counter() - t0)

@@ -332,7 +332,10 @@ def gf_decode1_fused(
         _as_u8_ptr(out), _as_u8_ptr(state), length,
     )
     del b_keep, e_keep
-    if rc == -2:
+    if rc in (-2, -3):
+        # -2: check column j identically zero; -3: nnz(A[:, j]) <= e so
+        # the count-free shortcut is unsound. Neither occurs for MDS
+        # checks; the caller falls back to the generic path.
         return None
     if rc != 0:
         raise RuntimeError(f"rs_decode1_fused failed: {rc}")
@@ -427,7 +430,10 @@ def gf16_decode1_fused(
         _as_u16_ptr(out), _as_u8_ptr(state), length,
     )
     del b_keep, e_keep
-    if rc == -2:
+    if rc in (-2, -3):
+        # -2: check column j identically zero; -3: nnz(A[:, j]) <= e so
+        # the count-free shortcut is unsound. Neither occurs for MDS
+        # checks; the caller falls back to the generic path.
         return None
     if rc != 0:
         raise RuntimeError(f"rs16_decode1_fused failed: {rc}")

@@ -483,34 +483,48 @@ int rs_syndrome_rows(const uint8_t* A, int r2, int k,
 //
 //   s_i  = (sum_c A[i][c] * basis[c]) ^ extra[i]       i in [0, r2)
 //   z    = s_p0 * inv(A[p0][j])     (p0 = first row with A[p0][j] != 0)
-//   ok   = all_i (s_i == A[i][j] * z)   — rank-1 consistency with col j
-//   out_row = basis[j] ^ ((count > e && ok) ? z : 0)
-//   state   = 0 clean (count <= e), 1 corrected, 2 unexplained
+//   bad  = OR_i (s_i ^ A[i][j] * z)     — zero iff rank-1 consistent
+//   out_row = basis[j] ^ ((bad == 0) ? z : 0)
+//   state   = 0 clean (s == 0 everywhere), 1 corrected, 2 inconsistent
 //
-// The per-column guarantee is the generic syndrome decoder's: count <= e
-// columns already hold the unique codeword; count > e columns that verify
-// rank-1 against check column j become a codeword differing from the
-// received word in one row <= e. state == 2 columns need the general
-// path (the Python caller gathers and re-decodes just those columns).
-// Requires 0 <= j < k and e >= 1. Returns 0 on success, -2 when check
-// column j is all zero (never true for an MDS parity check).
+// No per-column COUNT is needed: when bad == 0 the syndrome is exactly
+// A[:, j] * z, so its nonzero-row count is nnz(A[:, j]) whenever z != 0
+// — a compile-time scalar the kernel checks ONCE (> e required, true for
+// every MDS check: any column of A has >= r2 - k + 1 ... in practice all
+// entries nonzero for Cauchy). bad == 0 && z != 0 therefore implies
+// count = nnz > e (bad column, corrected: the fixed word agrees with
+// m - 1 >= m - e rows — the unique radius decode); bad == 0 && z == 0 is
+// a clean column; bad != 0 goes to the general path (state 2), which
+// recomputes exact counts — including columns whose <= e extra-row-only
+// errors the old count test classified clean; sending those to the
+// gathered re-decode costs a few columns of exact work and keeps this
+// hot loop at r2 syndrome passes + 1 consistency pass with no byte-wise
+// counting. state == 2 columns are gathered and re-decoded exactly by
+// the Python caller. Requires 0 <= j < k, e >= 1. Returns 0 on success,
+// -2 when check column j is identically zero, -3 when nnz(A[:, j]) <= e
+// (the z-implies-bad-column shortcut would be unsound; never true for
+// MDS checks with e = floor(r2/2) < r2 <= nnz).
 int rs_decode1_fused(const uint8_t* A, int r2, int k,
                      const uint8_t* const* basis, const uint8_t* const* extra,
                      int j, int e, uint8_t* out_row, uint8_t* state,
                      size_t len) {
   if (!A || !basis || !extra || !out_row || !state) return -1;
   if (r2 < 1 || k < 1 || j < 0 || j >= k || e < 1) return -1;
-  int p0 = -1;
+  int p0 = -1, nnz = 0;
   for (int i = 0; i < r2; ++i)
-    if (A[static_cast<size_t>(i) * k + j]) { p0 = i; break; }
+    if (A[static_cast<size_t>(i) * k + j]) {
+      if (p0 < 0) p0 = i;
+      ++nnz;
+    }
   if (p0 < 0) return -2;
+  if (nnz <= e) return -3;
   const uint8_t inv_p0 = gf_inv(A[static_cast<size_t>(p0) * k + j]);
-  // Small tiles: tmp + z + cnt + bad must stay L1-resident while the
-  // basis/extra streams pass through (they re-stream from L2 per check
-  // row, same as rs_syndrome_rows).
-  constexpr size_t kTile = 8 << 10;
-  std::vector<uint8_t> tmp(kTile), z(kTile), cnt(kTile), bad(kTile);
-  const uint8_t ecap = static_cast<uint8_t>(e < 255 ? e : 255);
+  // 16K tiles: tmp + z + bad stay cache-resident while the basis/extra
+  // streams pass through (they re-stream from L2 per check row, same as
+  // rs_syndrome_rows); dropping the count array let the tile double vs
+  // the first version and removed four byte-wise passes per tile.
+  constexpr size_t kTile = 16 << 10;
+  std::vector<uint8_t> tmp(kTile), z(kTile), bad(kTile);
   for (size_t off = 0; off < len; off += kTile) {
     const size_t t = len - off < kTile ? len - off : kTile;
     // Check row p0 first: its syndrome defines the candidate magnitude z
@@ -519,7 +533,6 @@ int rs_decode1_fused(const uint8_t* A, int r2, int k,
     for (int c = 0; c < k; ++c)
       mul_add_row(tmp.data(), basis[c] + off,
                   A[static_cast<size_t>(p0) * k + c], t);
-    for (size_t q = 0; q < t; ++q) cnt[q] = tmp[q] != 0;
     std::memset(z.data(), 0, t);
     mul_add_row(z.data(), tmp.data(), inv_p0, t);
     std::memset(bad.data(), 0, t);
@@ -529,7 +542,6 @@ int rs_decode1_fused(const uint8_t* A, int r2, int k,
       for (int c = 0; c < k; ++c)
         mul_add_row(tmp.data(), basis[c] + off,
                     A[static_cast<size_t>(i) * k + c], t);
-      for (size_t q = 0; q < t; ++q) cnt[q] += tmp[q] != 0;
       // tmp ^= A[i][j] * z: zero exactly where row i is consistent with
       // the single-support hypothesis, so OR-folding flags violations.
       mul_add_row(tmp.data(), z.data(), A[static_cast<size_t>(i) * k + j], t);
@@ -539,10 +551,11 @@ int rs_decode1_fused(const uint8_t* A, int r2, int k,
     uint8_t* oj = out_row + off;
     uint8_t* st = state + off;
     for (size_t q = 0; q < t; ++q) {
-      const bool isbad = cnt[q] > ecap;
-      const bool fix = isbad && bad[q] == 0;
-      oj[q] = static_cast<uint8_t>(bj[q] ^ (fix ? z[q] : 0));
-      st[q] = static_cast<uint8_t>(isbad ? (fix ? 1 : 2) : 0);
+      const uint8_t zq = z[q];
+      const bool consistent = bad[q] == 0;
+      oj[q] = static_cast<uint8_t>(bj[q] ^ (consistent ? zq : 0));
+      st[q] = static_cast<uint8_t>(
+          consistent ? (zq ? 1 : 0) : 2);
     }
   }
   return 0;
@@ -596,8 +609,9 @@ int rs16_syndrome_rows(const uint16_t* A, int r2, int k,
   return 0;
 }
 
-// GF(2^16) tier of rs_decode1_fused (same per-column state machine;
-// lengths in SYMBOLS, state stays one byte per column).
+// GF(2^16) tier of rs_decode1_fused (same count-free per-column state
+// machine — see the gf256 kernel's comment; lengths in SYMBOLS, state
+// stays one byte per column).
 int rs16_decode1_fused(const uint16_t* A, int r2, int k,
                        const uint16_t* const* basis,
                        const uint16_t* const* extra,
@@ -605,23 +619,23 @@ int rs16_decode1_fused(const uint16_t* A, int r2, int k,
                        size_t len) {
   if (!A || !basis || !extra || !out_row || !state) return -1;
   if (r2 < 1 || k < 1 || j < 0 || j >= k || e < 1) return -1;
-  int p0 = -1;
+  int p0 = -1, nnz = 0;
   for (int i = 0; i < r2; ++i)
-    if (A[static_cast<size_t>(i) * k + j]) { p0 = i; break; }
+    if (A[static_cast<size_t>(i) * k + j]) {
+      if (p0 < 0) p0 = i;
+      ++nnz;
+    }
   if (p0 < 0) return -2;
+  if (nnz <= e) return -3;
   const uint16_t inv_p0 = gf16_inv_sym(A[static_cast<size_t>(p0) * k + j]);
-  constexpr size_t kTile = 4 << 10;  // symbols: 8 KiB tiles like gf256
+  constexpr size_t kTile = 8 << 10;  // symbols: 16 KiB tiles like gf256
   std::vector<uint16_t> tmp(kTile), z(kTile), bad(kTile);
-  std::vector<uint16_t> cnt(kTile);
-  const uint16_t ecap =
-      static_cast<uint16_t>(e < 0xFFFF ? e : 0xFFFF);
   for (size_t off = 0; off < len; off += kTile) {
     const size_t t = len - off < kTile ? len - off : kTile;
     std::memcpy(tmp.data(), extra[p0] + off, 2 * t);
     for (int c = 0; c < k; ++c)
       mul_add_row16(tmp.data(), basis[c] + off,
                     A[static_cast<size_t>(p0) * k + c], t);
-    for (size_t q = 0; q < t; ++q) cnt[q] = tmp[q] != 0;
     std::memset(z.data(), 0, 2 * t);
     mul_add_row16(z.data(), tmp.data(), inv_p0, t);
     std::memset(bad.data(), 0, 2 * t);
@@ -631,7 +645,6 @@ int rs16_decode1_fused(const uint16_t* A, int r2, int k,
       for (int c = 0; c < k; ++c)
         mul_add_row16(tmp.data(), basis[c] + off,
                       A[static_cast<size_t>(i) * k + c], t);
-      for (size_t q = 0; q < t; ++q) cnt[q] += tmp[q] != 0;
       mul_add_row16(tmp.data(), z.data(),
                     A[static_cast<size_t>(i) * k + j], t);
       for (size_t q = 0; q < t; ++q) bad[q] |= tmp[q];
@@ -640,10 +653,10 @@ int rs16_decode1_fused(const uint16_t* A, int r2, int k,
     uint16_t* oj = out_row + off;
     uint8_t* st = state + off;
     for (size_t q = 0; q < t; ++q) {
-      const bool isbad = cnt[q] > ecap;
-      const bool fix = isbad && bad[q] == 0;
-      oj[q] = static_cast<uint16_t>(bj[q] ^ (fix ? z[q] : 0));
-      st[q] = static_cast<uint8_t>(isbad ? (fix ? 1 : 2) : 0);
+      const uint16_t zq = z[q];
+      const bool consistent = bad[q] == 0;
+      oj[q] = static_cast<uint16_t>(bj[q] ^ (consistent ? zq : 0));
+      st[q] = static_cast<uint8_t>(consistent ? (zq ? 1 : 0) : 2);
     }
   }
   return 0;
