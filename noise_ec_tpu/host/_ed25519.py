@@ -1,0 +1,155 @@
+"""Pure-Python Ed25519 (RFC 8032) — the no-dependency fallback backend.
+
+host/crypto.py prefers the ``cryptography`` package (OpenSSL: fast and
+constant-time); this module keeps the host layer *functional* when that
+wheel is absent (hermetic CI images, minimal containers) so the loopback
+harness, transports and the observability round-trip tests still run.
+
+Bit-identical output to the RFC 8032 test vectors (pinned in
+tests/test_host_crypto.py). NOT constant-time — Python big-int arithmetic
+leaks timing — so crypto.py logs a warning once when this backend is
+active; production deployments install ``cryptography``
+(requirements-test.txt).
+
+Performance: ~2.5 ms per scalar multiplication on a current x86 core
+(sign ≈ 3 ms, verify ≈ 6 ms) — ample for tests and REPL traffic, ~100x
+off OpenSSL for bulk streams.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+__all__ = ["public_from_seed", "sign", "verify"]
+
+_p = 2**255 - 19
+_L = 2**252 + 27742317777372353535851937790883648493
+
+
+def _inv(x: int) -> int:
+    return pow(x, _p - 2, _p)
+
+
+_d = -121665 * _inv(121666) % _p
+_I = pow(2, (_p - 1) // 4, _p)
+_By = 4 * _inv(5) % _p
+
+
+def _recover_x(y: int, sign_bit: int) -> int | None:
+    if y >= _p:
+        return None
+    x2 = (y * y - 1) * _inv(_d * y * y + 1) % _p
+    x = pow(x2, (_p + 3) // 8, _p)
+    if (x * x - x2) % _p:
+        x = x * _I % _p
+    if (x * x - x2) % _p:
+        return None
+    if x == 0 and sign_bit:
+        return None
+    if x & 1 != sign_bit:
+        x = _p - x
+    return x
+
+
+# Extended homogeneous coordinates (X, Y, Z, T) with x=X/Z, y=Y/Z, T=XY/Z.
+_Bx = _recover_x(_By, 0)
+_B = (_Bx, _By, 1, _Bx * _By % _p)
+_ZERO = (0, 1, 1, 0)
+
+
+def _add(P, Q):
+    X1, Y1, Z1, T1 = P
+    X2, Y2, Z2, T2 = Q
+    A = (Y1 - X1) * (Y2 - X2) % _p
+    B = (Y1 + X1) * (Y2 + X2) % _p
+    C = 2 * T1 * _d * T2 % _p
+    D = 2 * Z1 * Z2 % _p
+    E, F, G, H = B - A, D - C, D + C, B + A
+    return (E * F % _p, G * H % _p, F * G % _p, E * H % _p)
+
+
+def _mult(P, s: int):
+    Q = _ZERO
+    while s:
+        if s & 1:
+            Q = _add(Q, P)
+        P = _add(P, P)
+        s >>= 1
+    return Q
+
+
+def _compress(P) -> bytes:
+    X, Y, Z, _ = P
+    zi = _inv(Z)
+    x, y = X * zi % _p, Y * zi % _p
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def _decompress(data: bytes):
+    if len(data) != 32:
+        return None
+    val = int.from_bytes(data, "little")
+    y = val & ((1 << 255) - 1)
+    x = _recover_x(y, val >> 255)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % _p)
+
+
+def _clamp(h: bytes) -> int:
+    a = int.from_bytes(h[:32], "little")
+    return (a & ((1 << 254) - 8)) | (1 << 254)
+
+
+def _hash_to_scalar(*parts: bytes) -> int:
+    h = hashlib.sha512()
+    for part in parts:
+        h.update(part)
+    return int.from_bytes(h.digest(), "little") % _L
+
+
+class SigningKey:
+    """Expanded signing key: the per-seed work (SHA-512 expansion plus
+    the public-key scalar mult) done once, so a cached key signs with a
+    single scalar mult. Same ``.sign(message)`` surface as
+    ``cryptography``'s ``Ed25519PrivateKey``, which lets crypto.py's LRU
+    key cache hold either backend's object."""
+
+    __slots__ = ("_a", "_prefix", "public_key")
+
+    def __init__(self, seed: bytes):
+        if len(seed) != 32:
+            raise ValueError("Ed25519 seed must be 32 bytes")
+        h = hashlib.sha512(seed).digest()
+        self._a = _clamp(h)
+        self._prefix = h[32:]
+        self.public_key = _compress(_mult(_B, self._a))
+
+    def sign(self, message: bytes) -> bytes:
+        r = _hash_to_scalar(self._prefix, message)
+        R = _compress(_mult(_B, r))
+        S = (r + _hash_to_scalar(R, self.public_key, message) * self._a) % _L
+        return R + S.to_bytes(32, "little")
+
+
+def public_from_seed(seed: bytes) -> bytes:
+    return SigningKey(seed).public_key
+
+
+def sign(seed: bytes, message: bytes) -> bytes:
+    return SigningKey(seed).sign(message)
+
+
+def verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
+    if len(public_key) != 32 or len(signature) != 64:
+        return False
+    A = _decompress(public_key)
+    R = _decompress(signature[:32])
+    if A is None or R is None:
+        return False
+    S = int.from_bytes(signature[32:], "little")
+    if S >= _L:
+        return False  # malleability check, RFC 8032 §5.1.7
+    k = _hash_to_scalar(signature[:32], public_key, message)
+    # S*B == R + k*A, compared in compressed form (projective equality).
+    return _compress(_mult(_B, S)) == _compress(_add(R, _mult(A, k)))

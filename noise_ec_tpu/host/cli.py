@@ -24,8 +24,9 @@ import sys
 from noise_ec_tpu.host.crypto import KeyPair, PeerID
 from noise_ec_tpu.host.plugin import ShardPlugin
 from noise_ec_tpu.host.transport import TCPNetwork
+from noise_ec_tpu.obs.profiling import device_trace, kernel_counters
+from noise_ec_tpu.obs.server import PeriodicReporter, StatsServer
 from noise_ec_tpu.utils.logging import setup_logging
-from noise_ec_tpu.utils.profiling import device_trace, kernel_counters
 
 log = logging.getLogger("noise_ec_tpu.host.cli")
 
@@ -68,6 +69,23 @@ def build_parser() -> argparse.ArgumentParser:
         type=int,
         default=4 << 20,
         help="chunk payload size for /send file streaming (bytes)",
+    )
+    p.add_argument(
+        "-metrics-port",
+        type=int,
+        default=-1,
+        metavar="PORT",
+        help="serve Prometheus exposition on 127.0.0.1:PORT (/metrics; "
+        "also /spans for the trace ring buffer). 0 binds an ephemeral "
+        "port (logged); negative disables (default)",
+    )
+    p.add_argument(
+        "-stats-interval",
+        type=float,
+        default=0.0,
+        metavar="SECONDS",
+        help="log a stats snapshot every SECONDS while running "
+        "(0 disables; stats always log once at shutdown)",
     )
     return p
 
@@ -122,6 +140,25 @@ def main(argv: list[str] | None = None) -> int:
 
     net.listen()  # background accept loop (go net.Listen(), main.go:169)
     log.info("listening for peers on %s", net.id.address)
+
+    def stats_snapshot() -> dict:
+        stats = plugin.counters.snapshot()
+        stats.update(kernel_counters.snapshot())
+        return stats
+
+    stats_server = reporter = None
+    if args.metrics_port >= 0:
+        stats_server = StatsServer(
+            port=args.metrics_port,
+            extra_counters={
+                "noise_ec_plugin": plugin.counters,
+                "noise_ec_kernel": kernel_counters,
+            },
+        )
+        log.info("metrics endpoint on %s/metrics", stats_server.url)
+    if args.stats_interval > 0:
+        reporter = PeriodicReporter(args.stats_interval, stats_snapshot, log)
+
     peers = [a for a in args.peers.split(",") if a]
     if peers:
         net.bootstrap(peers)
@@ -157,9 +194,12 @@ def main(argv: list[str] | None = None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if reporter is not None:
+            reporter.close()
+        if stats_server is not None:
+            stats_server.close()
         net.close()
-        stats = plugin.counters.snapshot()
-        stats.update(kernel_counters.snapshot())
+        stats = stats_snapshot()
         if stats:
             log.info("session stats: %s", stats)
     return 0

@@ -21,16 +21,30 @@ from __future__ import annotations
 
 import functools
 import hashlib
+import logging
+import os
 import struct
 import threading
 from dataclasses import dataclass
 from typing import Optional
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
+try:  # OpenSSL-backed Ed25519: fast and constant-time. Preferred.
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+
+    _HAVE_CRYPTOGRAPHY = True
+except ImportError:  # hermetic images: the pure-Python RFC 8032 backend
+    _HAVE_CRYPTOGRAPHY = False
+    from noise_ec_tpu.host import _ed25519 as _pyed
+
+    logging.getLogger("noise_ec_tpu.host").warning(
+        "the 'cryptography' package is unavailable; Ed25519 falls back to "
+        "the pure-Python backend (correct but slow and not constant-time "
+        "— install cryptography for production use)"
+    )
 
 __all__ = [
     "Blake2bPolicy",
@@ -102,7 +116,7 @@ class Blake2bPolicy:
 
 
 @functools.lru_cache(maxsize=1024)
-def _parsed_public_key(public_key: bytes) -> Ed25519PublicKey:
+def _parsed_public_key(public_key: bytes) -> "Ed25519PublicKey":
     """Parsed peer key, LRU-cached: reconstructing the object per verify
     cost ~35 us/message and a node talks to a small stable peer set."""
     return Ed25519PublicKey.from_public_bytes(public_key)
@@ -138,13 +152,19 @@ class Ed25519Policy:
                     # order + re-append-on-hit), so churning transient
                     # seeds cannot push out the node's hot identity.
                     self._parsed_priv.pop(next(iter(self._parsed_priv)))
-                pk = Ed25519PrivateKey.from_private_bytes(seed)
+                pk = (
+                    Ed25519PrivateKey.from_private_bytes(seed)
+                    if _HAVE_CRYPTOGRAPHY
+                    else _pyed.SigningKey(seed)
+                )
             self._parsed_priv[seed] = pk
         return pk.sign(message)
 
     def verify(self, public_key: bytes, message: bytes, signature: bytes) -> bool:
         if len(public_key) != self.public_key_size:
             return False
+        if not _HAVE_CRYPTOGRAPHY:
+            return _pyed.verify(bytes(public_key), message, signature)
         try:
             _parsed_public_key(bytes(public_key)).verify(signature, message)
             return True
@@ -163,23 +183,12 @@ class KeyPair:
     def random(cls) -> "KeyPair":
         """Fresh identity, regenerated per run like the reference
         (ed25519.RandomKeyPair(), main.go:132)."""
-        sk = Ed25519PrivateKey.generate()
-        from cryptography.hazmat.primitives.serialization import (
-            Encoding,
-            NoEncryption,
-            PrivateFormat,
-            PublicFormat,
-        )
-
-        return cls(
-            private_key=sk.private_bytes(
-                Encoding.Raw, PrivateFormat.Raw, NoEncryption()
-            ),
-            public_key=sk.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw),
-        )
+        return cls.from_seed(os.urandom(32))
 
     @classmethod
     def from_seed(cls, seed: bytes) -> "KeyPair":
+        if not _HAVE_CRYPTOGRAPHY:
+            return cls(private_key=seed, public_key=_pyed.public_from_seed(seed))
         sk = Ed25519PrivateKey.from_private_bytes(seed)
         from cryptography.hazmat.primitives.serialization import (
             Encoding,

@@ -38,7 +38,34 @@ import time
 from collections import deque
 from typing import Callable, Optional
 
+from noise_ec_tpu.obs.registry import default_registry
+
 __all__ = ["open_kcp_connection", "start_kcp_server", "KcpServer"]
+
+
+class _KcpMetrics:
+    """Cached ARQ metric children (resolved once per process): retransmit
+    counts by trigger, dead-link closes, sessions opened. Retransmit rate
+    per peer is THE health signal for the UDP path — a rising rto share
+    means loss, a rising fast share means reordering."""
+
+    def __init__(self):
+        reg = default_registry()
+        fam = reg.counter("noise_ec_kcp_retransmits_total")
+        self.rto = fam.labels(kind="rto")
+        self.fast = fam.labels(kind="fast")
+        self.dead = reg.counter("noise_ec_kcp_dead_links_total").labels()
+        self.opened = reg.counter("noise_ec_kcp_sessions_opened_total").labels()
+
+
+_metrics: Optional[_KcpMetrics] = None
+
+
+def _kcp_metrics() -> _KcpMetrics:
+    global _metrics
+    if _metrics is None:
+        _metrics = _KcpMetrics()
+    return _metrics
 
 _HDR = struct.Struct("<IBIIH")  # conv, cmd, sn, una, len
 _CMD_PUSH = 1
@@ -104,6 +131,8 @@ class KcpSession:
         self._close_deadline: Optional[float] = None
         self._close_hard = 0.0  # set with _close_deadline in start_close
         self._drain_waiters: list[asyncio.Future] = []
+        self._metrics = _kcp_metrics()
+        self._metrics.opened.add(1)
         self._update_handle = loop.call_later(UPDATE_INTERVAL, self._update)
 
     # ------------------------------------------------------------- sending
@@ -297,9 +326,9 @@ class KcpSession:
                 older.skips += 1
 
     def _after_acks(self) -> None:
-        now = time.monotonic()
         for sn, seg in list(self._snd_buf.items()):
             if seg.skips >= FAST_RESEND:
+                self._metrics.fast.add(1)
                 self._transmit(sn, seg)
         self._fill_window()
         self._wake_drains()
@@ -314,8 +343,10 @@ class KcpSession:
         for sn, seg in list(self._snd_buf.items()):
             if now - seg.sent_at >= seg.rto:
                 if seg.xmit >= DEAD_XMIT:
+                    self._metrics.dead.add(1)
                     self.close(ConnectionError("kcp dead link"))
                     return
+                self._metrics.rto.add(1)
                 self._transmit(sn, seg)
         # An idle tick flushes a lingering sub-MSS tail (write coalescing
         # above already batches; this bounds tail latency).

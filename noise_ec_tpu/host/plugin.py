@@ -36,7 +36,9 @@ from noise_ec_tpu.host.crypto import (
 )
 from noise_ec_tpu.host.mempool import PoolLimitError, PoolTooLargeError, ShardPool
 from noise_ec_tpu.host.wire import Shard
-from noise_ec_tpu.utils.metrics import Counters, Timer
+from noise_ec_tpu.obs.metrics import Counters, Timer
+from noise_ec_tpu.obs.registry import default_registry
+from noise_ec_tpu.obs.trace import span, trace_key
 
 __all__ = [
     "ShardPlugin",
@@ -139,6 +141,12 @@ class ShardPlugin:
             max_total_bytes=pool_max_total_bytes,
         )
         self.counters = Counters()
+        # Decode-path histograms (p50/p99 surfaces — the flat decode_s
+        # sum stays for back-compat but cannot answer tail questions).
+        # Children resolved once; observe is a lock + bisect + adds.
+        reg = default_registry()
+        self._decode_hist = reg.histogram("noise_ec_decode_seconds").labels()
+        self._decode_bytes_hist = reg.histogram("noise_ec_decode_bytes").labels()
         # Geometry is runtime-dynamic (SURVEY.md §7.4); cache one codec per
         # (k, n) so repeated geometries reuse their jitted kernels. LRU-
         # bounded: geometry is attacker-influenced on the receive path, and
@@ -385,8 +393,13 @@ class ShardPlugin:
         peers (main.go:201-210). Returns the shards for callers that want
         them (the reference discards them)."""
         shards = self.prepare_shards(network.id, network.keys, input_bytes)
-        for shard in shards:
-            network.broadcast(shard)
+        with span(
+            "broadcast",
+            key=trace_key(shards[0].file_signature),
+            shards=len(shards),
+        ):
+            for shard in shards:
+                network.broadcast(shard)
         self.counters.add("shards_out", len(shards))
         self.counters.add("bytes_out", sum(len(s.shard_data) for s in shards))
         return shards
@@ -403,13 +416,21 @@ class ShardPlugin:
         """
         if not input_bytes:
             raise ValueError("cannot prepare shards for empty input")  # main.go:215-217
-        k, n = self._adjusted_geometry(len(input_bytes))
-        file_signature = keys.sign(
-            self.signature_policy,
-            self.hash_policy,
-            serialize_message(node_id, input_bytes),
-        )
-        shares = self._fec(k, n).encode_shares(input_bytes)
+        with span("prepare", nbytes=len(input_bytes)) as psp:
+            k, n = self._adjusted_geometry(len(input_bytes))
+            # The trace key IS the signature prefix, so the sign span
+            # attaches it from inside (known only after signing) and the
+            # enclosing prepare span adopts it before its own exit.
+            with span("sign") as ssp:
+                file_signature = keys.sign(
+                    self.signature_policy,
+                    self.hash_policy,
+                    serialize_message(node_id, input_bytes),
+                )
+                ssp.set_key(trace_key(file_signature))
+            psp.set_key(trace_key(file_signature))
+            with span("encode", k=k, n=n):
+                shares = self._fec(k, n).encode_shares(input_bytes)
         return [
             Shard(
                 file_signature=file_signature,
@@ -505,11 +526,13 @@ class ShardPlugin:
         k, n, B, count = self._stream_plan(len(data), chunk_bytes, geometry)
         # Same preimage as a plain broadcast (serialize_message), hashed
         # in parts to skip a whole-object join copy.
-        file_signature = network.keys.sign_parts(
-            self.signature_policy,
-            self.hash_policy,
-            serialize_message_parts(network.id, data),
-        )
+        with span("sign", nbytes=len(data)) as ssp:
+            file_signature = network.keys.sign_parts(
+                self.signature_policy,
+                self.hash_policy,
+                serialize_message_parts(network.id, data),
+            )
+            ssp.set_key(trace_key(file_signature))
         view = memoryview(data)
         chunks = (view[i * B : (i + 1) * B] for i in range(count))
         return self._emit_stream(
@@ -550,9 +573,11 @@ class ShardPlugin:
                         return
                     yield blk
 
-        file_signature = network.keys.sign_parts(
-            self.signature_policy, self.hash_policy, sig_parts()
-        )
+        with span("sign", nbytes=size) as ssp:
+            file_signature = network.keys.sign_parts(
+                self.signature_policy, self.hash_policy, sig_parts()
+            )
+            ssp.set_key(trace_key(file_signature))
 
         def chunks():
             with open(path, "rb") as f:
@@ -622,23 +647,24 @@ class ShardPlugin:
         # Transports without the hook — the loopback fake — are
         # unbuffered. The non-busy check is one short lock + int reads.
         waiter = getattr(network, "wait_writable", None)
-        for index, shares in self._encode_chunk_stream(chunks, k, n, B):
-            for s in shares:
-                if waiter is not None:
-                    waiter(headroom=len(s.data) + 4096)
-                shard = Shard(
-                    file_signature=file_signature,
-                    shard_data=s.data,
-                    shard_number=s.number,
-                    total_shards=n,
-                    minimum_needed_shards=k,
-                    stream_chunk_index=index,
-                    stream_chunk_count=count,
-                    stream_object_bytes=length,
-                )
-                network.broadcast(shard)
-                shards_out += 1
-                bytes_out += len(s.data)
+        with span("broadcast", key=trace_key(file_signature), chunks=count):
+            for index, shares in self._encode_chunk_stream(chunks, k, n, B):
+                for s in shares:
+                    if waiter is not None:
+                        waiter(headroom=len(s.data) + 4096)
+                    shard = Shard(
+                        file_signature=file_signature,
+                        shard_data=s.data,
+                        shard_number=s.number,
+                        total_shards=n,
+                        minimum_needed_shards=k,
+                        stream_chunk_index=index,
+                        stream_chunk_count=count,
+                        stream_object_bytes=length,
+                    )
+                    network.broadcast(shard)
+                    shards_out += 1
+                    bytes_out += len(s.data)
         self.counters.add("stream_chunks_out", count)
         self.counters.add("shards_out", shards_out)
         self.counters.add("bytes_out", bytes_out)
@@ -869,7 +895,11 @@ class ShardPlugin:
         share = Share(msg.shard_number, bytes(msg.shard_data))
         pool_key = f"{key}:{index}"
         try:
-            snapshot, distinct, was_new = self.pool.add(pool_key, share, k, n)
+            with span("reassemble", key=trace_key(msg.file_signature),
+                      chunk=index):
+                snapshot, distinct, was_new = self.pool.add(
+                    pool_key, share, k, n
+                )
         except PoolLimitError:
             self.counters.add("pool_limit_rejections", 1)
             raise
@@ -941,10 +971,14 @@ class ShardPlugin:
                 return self._repair_stream(ctx, msg, key, k, n, count)
         fec = self._fec_receive(k, n, ctx)
         self._geometry_decode_begin(k, n)
+        decode_nbytes = sum(len(s.data) for s in snapshot)
         try:
-            with Timer(self.counters, "decode_s",
-                       nbytes=sum(len(s.data) for s in snapshot)):
+            with span("decode", key=trace_key(msg.file_signature),
+                      chunk=index), \
+                    Timer(self.counters, "decode_s", nbytes=decode_nbytes,
+                          histogram=self._decode_hist):
                 chunk = fec.decode(snapshot)
+            self._decode_bytes_hist.observe(decode_nbytes)
         except Exception as exc:
             self.counters.add("decode_errors", 1)
             log.error("stream chunk %d decode failed for %s…: %s",
@@ -1010,13 +1044,15 @@ class ShardPlugin:
         as bytes only on delivery); None on failure (caller decides
         repair/unrecoverability)."""
         sender = ctx.sender()
-        ok = verify_parts(
-            self.signature_policy,
-            self.hash_policy,
-            ctx.client_public_key(),
-            serialize_message_parts(sender, complete),
-            msg.file_signature,
-        )
+        with span("verify", key=trace_key(msg.file_signature),
+                  nbytes=len(complete)):
+            ok = verify_parts(
+                self.signature_policy,
+                self.hash_policy,
+                ctx.client_public_key(),
+                serialize_message_parts(sender, complete),
+                msg.file_signature,
+            )
         if not ok:
             self.counters.add("verify_failures", 1)
             log.warning("stream object signature verify failed for %s…",
@@ -1169,7 +1205,8 @@ class ShardPlugin:
                 f"shard number {msg.shard_number} out of range for n={n}"
             )
         try:
-            snapshot, distinct, was_new = self.pool.add(key, share, k, n)
+            with span("reassemble", key=trace_key(msg.file_signature)):
+                snapshot, distinct, was_new = self.pool.add(key, share, k, n)
         except PoolTooLargeError:
             self.counters.add("pool_overflows", 1)
             raise
@@ -1193,10 +1230,13 @@ class ShardPlugin:
         # CASE C: enough distinct shares — decode + verify (main.go:72-99).
         fec = self._fec_receive(k, n, ctx)
         self._geometry_decode_begin(k, n)
+        decode_nbytes = sum(len(s.data) for s in snapshot)
         try:
-            with Timer(self.counters, "decode_s",
-                       nbytes=sum(len(s.data) for s in snapshot)):
+            with span("decode", key=trace_key(msg.file_signature), k=k, n=n), \
+                    Timer(self.counters, "decode_s", nbytes=decode_nbytes,
+                          histogram=self._decode_hist):
                 complete = fec.decode(snapshot)
+            self._decode_bytes_hist.observe(decode_nbytes)
         except Exception as exc:
             # The reference logs decode errors and falls through to a
             # doomed Verify on nil (main.go:75-80, quirk 5); we log and
@@ -1217,13 +1257,14 @@ class ShardPlugin:
         self.counters.add("decodes", 1)
 
         sender = ctx.sender()
-        ok = verify(
-            self.signature_policy,
-            self.hash_policy,
-            ctx.client_public_key(),  # transport sender == original encoder
-            serialize_message(sender, complete),  # (main.go:85, quirk 6)
-            msg.file_signature,
-        )
+        with span("verify", key=trace_key(msg.file_signature)):
+            ok = verify(
+                self.signature_policy,
+                self.hash_policy,
+                ctx.client_public_key(),  # transport sender == original encoder
+                serialize_message(sender, complete),  # (main.go:85, quirk 6)
+                msg.file_signature,
+            )
         if ok:
             self.pool.evict(key)  # main.go:90-93
             if not self._mark_completed(key):

@@ -27,6 +27,7 @@ import os
 import struct
 import threading
 import time
+import weakref
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
@@ -41,6 +42,9 @@ from noise_ec_tpu.host.crypto import (
     PeerID,
 )
 from noise_ec_tpu.host.wire import Shard, WireError
+from noise_ec_tpu.obs.metrics import Timer
+from noise_ec_tpu.obs.registry import default_registry
+from noise_ec_tpu.obs.trace import span, trace_key
 
 __all__ = [
     "Ctx",
@@ -57,6 +61,70 @@ log = logging.getLogger("noise_ec_tpu.host.transport")
 def format_address(protocol: str, host: str, port: int) -> str:
     """network.FormatAddress(protocol, host, port) — main.go:148."""
     return f"{protocol}://{host}:{port}"
+
+
+class _TransportMetrics:
+    """Cached children of the per-peer transport metric families.
+
+    ``Family.labels()`` is a lock + dict get; the frame hot path pays one
+    plain dict get here instead. Peer label cardinality is bounded: the
+    address inside a frame is self-claimed, so past ``MAX_PEERS`` distinct
+    labels new peers collapse into ``peer="other"`` rather than letting a
+    hostile churner grow the registry without bound.
+    """
+
+    MAX_PEERS = 256
+
+    def __init__(self):
+        reg = default_registry()
+        self._shards_in = reg.counter("noise_ec_transport_shards_in_total")
+        self._shards_out = reg.counter("noise_ec_transport_shards_out_total")
+        self._bytes_in = reg.counter("noise_ec_transport_bytes_in_total")
+        self._bytes_out = reg.counter("noise_ec_transport_bytes_out_total")
+        self._errors = reg.counter("noise_ec_transport_frame_errors_total")
+        self._in: dict[str, tuple] = {}
+        self._out: dict[str, tuple] = {}
+        self._err: dict[str, object] = {}
+
+    def _pair(self, cache: dict, shards, bytes_, peer: str) -> tuple:
+        pair = cache.get(peer)
+        if pair is None:
+            if len(cache) >= self.MAX_PEERS:
+                peer = "other"
+                pair = cache.get(peer)
+                if pair is not None:
+                    return pair
+            pair = cache[peer] = (
+                shards.labels(peer=peer), bytes_.labels(peer=peer)
+            )
+        return pair
+
+    def record_in(self, peer: str, nbytes: int) -> None:
+        c, b = self._pair(self._in, self._shards_in, self._bytes_in, peer)
+        c.add(1)
+        b.add(nbytes)
+
+    def record_out(self, peer: str, nbytes: int) -> None:
+        c, b = self._pair(self._out, self._shards_out, self._bytes_out, peer)
+        c.add(1)
+        b.add(nbytes)
+
+    def error(self, kind: str) -> None:
+        c = self._err.get(kind)
+        if c is None:
+            c = self._err[kind] = self._errors.labels(kind=kind)
+        c.add(1)
+
+
+_transport_metrics: Optional[_TransportMetrics] = None
+
+
+def transport_metrics() -> _TransportMetrics:
+    """Process-wide transport metrics (lazy: first transport constructs)."""
+    global _transport_metrics
+    if _transport_metrics is None:
+        _transport_metrics = _TransportMetrics()
+    return _transport_metrics
 
 
 class Ctx:
@@ -163,9 +231,11 @@ class LoopbackHub:
     def fan_out(self, sender: "LoopbackNetwork", wire_bytes: bytes) -> None:
         """Deliver one message to every peer except the sender
         (net.Broadcast semantics, main.go:206-208)."""
+        metrics = transport_metrics()
         for addr, node in self.nodes.items():
             if addr == sender.id.address:
                 continue
+            metrics.record_out(addr, len(wire_bytes))
             bufs = [wire_bytes]
             if self.faults is not None:
                 bufs = self.faults.apply(bufs, link=f"{sender.id.address}->{addr}")
@@ -196,23 +266,30 @@ class LoopbackNetwork:
         self.error_count += 1
 
     def broadcast(self, msg: Shard) -> None:
-        self.hub.fan_out(self, msg.marshal())
+        with span("wire_encode", key=trace_key(msg.file_signature)):
+            wire = msg.marshal()
+        self.hub.fan_out(self, wire)
 
     def deliver(self, wire_bytes: bytes, sender: PeerID) -> None:
         """Hub-side delivery: decode and dispatch to every plugin in
         registration order. Decode/dispatch errors are recorded, not
         raised — one bad message must not kill the receive loop."""
+        metrics = transport_metrics()
         try:
             msg = Shard.unmarshal(wire_bytes)
         except WireError as exc:
+            metrics.error("wire")
             self._record_error(exc)
             return
+        metrics.record_in(sender.address, len(wire_bytes))
         ctx = Ctx(msg, sender)
-        for plugin in self.plugins:
-            try:
-                plugin.receive(ctx)
-            except Exception as exc:  # noqa: BLE001 — isolate the loop
-                self._record_error(exc)
+        with span("deliver", key=trace_key(msg.file_signature)):
+            for plugin in self.plugins:
+                try:
+                    plugin.receive(ctx)
+                except Exception as exc:  # noqa: BLE001 — isolate the loop
+                    metrics.error("handler")
+                    self._record_error(exc)
 
 
 # -------------------------------------------------------------------- TCP
@@ -284,6 +361,11 @@ class _SerialDispatcher:
     key runs on the pool at a time.
     """
 
+    # Live dispatchers for the aggregate queue-depth gauge (weak: a
+    # closed network's dispatcher must not pin itself via the callback).
+    _instances: "weakref.WeakSet[_SerialDispatcher]" = weakref.WeakSet()
+    _gauge_registered = False
+
     def __init__(self, max_workers: int = 4, max_queue: int = 4096,
                  on_error=None):
         self._pool = ThreadPoolExecutor(
@@ -294,6 +376,18 @@ class _SerialDispatcher:
         self._active: set[bytes] = set()
         self.max_queue = max_queue
         self.overflows = 0
+        reg = default_registry()
+        self._overflow_counter = reg.counter(
+            "noise_ec_dispatch_overflows_total"
+        ).labels()
+        self._latency_hist = reg.histogram("noise_ec_dispatch_seconds").labels()
+        cls = type(self)
+        cls._instances.add(self)
+        if not cls._gauge_registered:
+            cls._gauge_registered = True
+            reg.gauge("noise_ec_dispatch_queue_depth").set_callback(
+                lambda: sum(d.queue_depth() for d in list(cls._instances))
+            )
         # Error contract: a handler that raises is reported to ``on_error``
         # (an ``(exc) -> None`` recorder) and counted — never silently
         # swallowed. The TCP dispatch wrapper records into Network.errors;
@@ -309,6 +403,7 @@ class _SerialDispatcher:
             q = self._queues.setdefault(key, deque())
             if len(q) >= self.max_queue:
                 self.overflows += 1
+                self._overflow_counter.add(1)
                 return False
             q.append((fn, args))
             if key not in self._active:
@@ -331,7 +426,8 @@ class _SerialDispatcher:
                     return
                 fn, args = q.popleft()
             try:
-                fn(*args)
+                with Timer(histogram=self._latency_hist):
+                    fn(*args)
             except Exception as exc:  # noqa: BLE001 — isolate the stream
                 self.dropped_errors += 1
                 if self._on_error is not None:
@@ -343,6 +439,11 @@ class _SerialDispatcher:
                     log.warning("dispatch handler error on %r: %r", key, exc)
         # Batch exhausted with work remaining: requeue behind other senders.
         self._pool.submit(self._drain, key)
+
+    def queue_depth(self) -> int:
+        """Entries enqueued across all senders (the export gauge)."""
+        with self._lock:
+            return sum(len(q) for q in self._queues.values())
 
     def shutdown(self, wait: bool = True) -> None:
         self._pool.shutdown(wait=wait)
@@ -640,9 +741,13 @@ class TCPNetwork:
         Frames ride the per-peer coalescing buffer: consecutive broadcasts
         within ``write_flush_latency`` batch into one socket write (noise's
         WriteFlushLatency semantics)."""
-        frame = self._frame(_OP_SHARD, msg.marshal())
+        with span("wire_encode", key=trace_key(msg.file_signature)):
+            frame = self._frame(_OP_SHARD, msg.marshal())
+        metrics = transport_metrics()
         with self._lock:
             writers = [p.writer for p in self.peers.values()]
+            for p in self.peers.values():
+                metrics.record_out(p.pid.address, len(frame))
             # Count the bytes as posted BEFORE handing them to the loop
             # thread: a frame sitting in call_soon_threadsafe's queue is
             # visible to neither the kernel buffer nor the coalesce
@@ -956,9 +1061,11 @@ class TCPNetwork:
     def _on_frame(
         self, body: bytes, writer: asyncio.StreamWriter, conn: _Conn
     ) -> None:
+        metrics = transport_metrics()
         try:
             opcode, pid, payload, sig = self._parse_frame(body)
         except (WireError, IndexError, struct.error, UnicodeDecodeError) as exc:
+            metrics.error("wire")
             self._record_error(WireError(f"bad frame: {exc}"))
             return
         if not self._sig.verify(
@@ -968,6 +1075,7 @@ class TCPNetwork:
             ),
             sig,
         ):
+            metrics.error("signature")
             self._record_error(WireError(f"bad frame signature from {pid.address}"))
             return
 
@@ -1043,6 +1151,7 @@ class TCPNetwork:
             # Only registered connections may deliver shards, and the frame
             # identity must match the handshake identity.
             if conn.peer is None or pid.public_key != conn.peer.public_key:
+                metrics.error("unregistered")
                 self._record_error(
                     WireError(f"shard from unregistered connection ({pid.address})")
                 )
@@ -1050,12 +1159,15 @@ class TCPNetwork:
             try:
                 msg = Shard.unmarshal(payload)
             except WireError as exc:
+                metrics.error("wire")
                 self._record_error(exc)
                 return
+            metrics.record_in(pid.address, len(body) + 4)
             ctx = Ctx(msg, pid)
             if not self._dispatch.submit(
                 pid.public_key, self._dispatch_plugins, ctx
             ):
+                metrics.error("overflow")
                 self._record_error(
                     RuntimeError(
                         f"recv window ({self.recv_window}) overflow from "
@@ -1064,8 +1176,13 @@ class TCPNetwork:
                 )
 
     def _dispatch_plugins(self, ctx: Ctx) -> None:
-        for plugin in self.plugins:
-            try:
-                plugin.receive(ctx)
-            except Exception as exc:  # noqa: BLE001
-                self._record_error(exc)
+        metrics = transport_metrics()
+        msg = ctx.message()
+        key = trace_key(msg.file_signature) if isinstance(msg, Shard) else None
+        with span("deliver", key=key):
+            for plugin in self.plugins:
+                try:
+                    plugin.receive(ctx)
+                except Exception as exc:  # noqa: BLE001
+                    metrics.error("handler")
+                    self._record_error(exc)

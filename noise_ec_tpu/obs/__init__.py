@@ -1,0 +1,46 @@
+"""Observability: spans, histograms, labeled metrics, Prometheus export.
+
+The reference's only observability is glog lines (SURVEY.md §5); this
+package is the layer the ROADMAP's production north star needs — the
+answer to "where does a shard spend its time and which peer is degrading"
+has to come from structured telemetry, not log archaeology:
+
+- :mod:`obs.metrics` — counters, fixed-bucket histograms with
+  p50/p90/p99 extraction, timers (absorbs ``utils.metrics``);
+- :mod:`obs.registry` — the labeled metric-family registry plus the
+  declarative metric-name registry (``METRICS``) every exported series
+  must appear in (``tools/check_metrics.py`` enforces it);
+- :mod:`obs.trace` — the in-process span tracer: ``span("decode",
+  key=...)`` records per-stage timings keyed by message/stream identity
+  into a ring buffer, with a dump API;
+- :mod:`obs.profiling` — per-kernel throughput counters and the XLA
+  trace hook (absorbs ``utils.profiling``);
+- :mod:`obs.export` — Prometheus text-format exposition;
+- :mod:`obs.server` — the optional stdlib-``http.server`` stats
+  endpoint and the periodic reporter thread the CLI flags drive.
+
+``utils.metrics`` / ``utils.profiling`` remain as compatible re-export
+shims, so existing imports keep working.
+"""
+
+from noise_ec_tpu.obs.metrics import Counters, Histogram, Timer
+from noise_ec_tpu.obs.registry import (
+    METRICS,
+    PIPELINE_STAGES,
+    Registry,
+    default_registry,
+)
+from noise_ec_tpu.obs.trace import Tracer, default_tracer, span
+
+__all__ = [
+    "Counters",
+    "Histogram",
+    "METRICS",
+    "PIPELINE_STAGES",
+    "Registry",
+    "Timer",
+    "Tracer",
+    "default_registry",
+    "default_tracer",
+    "span",
+]

@@ -1,0 +1,121 @@
+"""Prometheus text-format exposition (version 0.0.4).
+
+Renders the labeled registry (counters, gauges, histograms with
+cumulative ``le`` buckets) plus any number of plain :class:`Counters`
+bags (the plugin's per-node counters, the global kernel counters) as
+prefixed counter series — so one scrape of ``/metrics`` carries the
+whole node: transport per-peer series, stage latency histograms, plugin
+state machine counts, and per-kernel byte totals.
+
+No prometheus_client dependency: the format is a stable line protocol and
+the stdlib renders it in ~100 lines, which keeps the container-image
+constraint (nothing new to install) and the export path auditable.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from noise_ec_tpu.obs.metrics import Counters
+from noise_ec_tpu.obs.registry import Registry, default_registry
+
+__all__ = ["escape_label_value", "render_counters", "render_prometheus"]
+
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_NAME_FIX = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def escape_label_value(value: str) -> str:
+    """Backslash, double-quote and newline escaping per the exposition
+    format spec — peer addresses carry ``://`` and arbitrary hosts."""
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _fmt(v: float) -> str:
+    # Integral values print as integers (Prometheus convention); floats
+    # get shortest-roundtrip formatting.
+    if float(v).is_integer() and abs(v) < 2**63:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _labels_str(names: tuple[str, ...], values: tuple[str, ...],
+                extra: str = "") -> str:
+    parts = [
+        f'{n}="{escape_label_value(v)}"' for n, v in zip(names, values)
+    ]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _render_family(fam, out: list[str]) -> None:
+    out.append(f"# HELP {fam.name} {fam.help}")
+    out.append(f"# TYPE {fam.name} {fam.type}")
+    for values, child in sorted(fam.children()):
+        lbl = _labels_str(fam.label_names, values)
+        if fam.type == "counter":
+            out.append(f"{fam.name}{lbl} {_fmt(child.value)}")
+        elif fam.type == "gauge":
+            out.append(f"{fam.name}{lbl} {_fmt(child.read())}")
+        else:  # histogram: cumulative le buckets + sum + count
+            snap = child.snapshot()
+            cum = 0
+            for bound, count in zip(snap["bounds"], snap["counts"]):
+                cum += count
+                le = _labels_str(
+                    fam.label_names, values, f'le="{_fmt_le(bound)}"'
+                )
+                out.append(f"{fam.name}_bucket{le} {cum}")
+            cum += snap["counts"][-1]
+            le = _labels_str(fam.label_names, values, 'le="+Inf"')
+            out.append(f"{fam.name}_bucket{le} {cum}")
+            out.append(f"{fam.name}_sum{lbl} {repr(snap['sum'])}")
+            out.append(f"{fam.name}_count{lbl} {snap['count']}")
+
+
+def _fmt_le(bound: float) -> str:
+    return _fmt(bound) if bound == int(bound) else format(bound, ".9g")
+
+
+def sanitize_name(name: str) -> str:
+    """Counter-bag keys (``decode_s``, ``matmul_words_bytes``) to legal
+    metric name fragments."""
+    name = _NAME_FIX.sub("_", name)
+    if not _NAME_OK.match(name):
+        name = "_" + name
+    return name
+
+
+def render_counters(prefix: str, counters: Counters) -> list[str]:
+    """One :class:`Counters` bag as ``<prefix>_<key>`` counter lines.
+
+    Flat counter bags are untyped at the source, but every key is
+    monotonically increasing by the Counters contract, so counter is the
+    honest exposition type.
+    """
+    out: list[str] = []
+    for key, value in sorted(counters.snapshot().items()):
+        name = f"{prefix}_{sanitize_name(key)}"
+        out.append(f"# TYPE {name} counter")
+        out.append(f"{name} {_fmt(value)}")
+    return out
+
+
+def render_prometheus(
+    registry: Optional[Registry] = None,
+    extra_counters: Optional[dict[str, Counters]] = None,
+) -> str:
+    """The full exposition document. ``extra_counters`` maps a metric
+    prefix to a plain Counters bag (e.g. ``{"noise_ec_plugin":
+    plugin.counters, "noise_ec_kernel": kernel_counters}``)."""
+    reg = registry if registry is not None else default_registry()
+    out: list[str] = []
+    for fam in reg.collect():
+        _render_family(fam, out)
+    for prefix, counters in (extra_counters or {}).items():
+        out.extend(render_counters(prefix, counters))
+    return "\n".join(out) + "\n"

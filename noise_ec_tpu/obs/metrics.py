@@ -1,0 +1,188 @@
+"""Core metric primitives: counters, fixed-bucket histograms, timers.
+
+Absorbs ``utils/metrics.py`` (which now re-exports from here). The new
+piece is :class:`Histogram`: the flat ``decode_s`` sum the old ``Timer``
+kept is lossy — a p99 regression hides completely inside a sum — so the
+decode and dispatch hot paths now feed fixed-bucket histograms whose
+p50/p90/p99 are extractable at report time and exportable in Prometheus
+exposition (obs/export.py).
+
+Hot-path budget: ``Counters.add`` is one lock + one dict add;
+``Histogram.observe`` is one lock + a bisect + three adds. Both match the
+"two lock-free-ish counter adds" cost class ``record_kernel`` promises.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from bisect import bisect_left
+from typing import Optional, Sequence
+
+__all__ = [
+    "Counters",
+    "Histogram",
+    "Timer",
+    "LATENCY_BUCKETS",
+    "SIZE_BUCKETS",
+]
+
+# Default latency buckets: 1 us .. ~16.8 s, geometric (x2). Wide enough to
+# hold both a sub-ms numpy decode and a multi-second first-geometry jit.
+LATENCY_BUCKETS: tuple[float, ...] = tuple(1e-6 * 2**i for i in range(25))
+
+# Default size buckets: 64 B .. 1 GiB, geometric (x4) — shard payloads at
+# the low end, whole stream objects at the top.
+SIZE_BUCKETS: tuple[float, ...] = tuple(64.0 * 4**i for i in range(13))
+
+
+class Counters:
+    """A named bag of monotonically increasing counters."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values: dict[str, float] = {}
+
+    def add(self, name: str, delta: float = 1.0) -> None:
+        with self._lock:
+            self._values[name] = self._values.get(name, 0.0) + delta
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._values.get(name, 0.0)
+
+    def snapshot(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._values)
+
+    def __repr__(self) -> str:
+        return f"Counters({self.snapshot()!r})"
+
+
+class Histogram:
+    """Fixed-bucket histogram with percentile extraction.
+
+    ``buckets`` are upper bounds (ascending); an implicit +Inf bucket
+    catches the overflow. Observations are counted into the first bucket
+    whose bound is >= the value — Prometheus ``le`` semantics, so the
+    exporter can emit cumulative bucket lines without re-binning.
+    """
+
+    def __init__(self, buckets: Sequence[float] = LATENCY_BUCKETS):
+        bounds = tuple(float(b) for b in buckets)
+        if not bounds or any(
+            b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])
+        ):
+            raise ValueError("buckets must be non-empty and ascending")
+        self.bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # last = +Inf
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        i = bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[i] += 1
+            self.sum += value
+            self.count += 1
+
+    def snapshot(self) -> dict:
+        """(bounds, per-bucket counts, sum, count) — a consistent copy."""
+        with self._lock:
+            return {
+                "bounds": self.bounds,
+                "counts": tuple(self._counts),
+                "sum": self.sum,
+                "count": self.count,
+            }
+
+    def percentile(self, q: float) -> float:
+        """Estimate the ``q``-quantile (q in [0, 1]) by linear
+        interpolation inside the containing bucket.
+
+        Values in the +Inf bucket clamp to the top finite bound — the
+        honest answer a fixed-bucket sketch can give. Returns 0.0 for an
+        empty histogram.
+        """
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        snap = self.snapshot()
+        total = snap["count"]
+        if total == 0:
+            return 0.0
+        target = q * total
+        cum = 0.0
+        for i, c in enumerate(snap["counts"]):
+            if c == 0:
+                continue
+            if cum + c >= target:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = (
+                    self.bounds[i]
+                    if i < len(self.bounds)
+                    else self.bounds[-1]  # +Inf bucket: clamp
+                )
+                frac = (target - cum) / c
+                return lo + frac * (hi - lo)
+            cum += c
+        return self.bounds[-1]
+
+    @property
+    def p50(self) -> float:
+        return self.percentile(0.50)
+
+    @property
+    def p90(self) -> float:
+        return self.percentile(0.90)
+
+    @property
+    def p99(self) -> float:
+        return self.percentile(0.99)
+
+    def __repr__(self) -> str:
+        return (
+            f"Histogram(count={self.count}, p50={self.p50:.3g}, "
+            f"p99={self.p99:.3g})"
+        )
+
+
+class Timer:
+    """Context-manager stopwatch; feeds a throughput counter and/or a
+    latency :class:`Histogram`."""
+
+    def __init__(
+        self,
+        counters: Optional[Counters] = None,
+        name: str = "elapsed_s",
+        nbytes: Optional[int] = None,
+        histogram: Optional[Histogram] = None,
+    ):
+        self.counters = counters
+        self.name = name
+        self.nbytes = nbytes
+        self.histogram = histogram
+        self.elapsed: float = 0.0
+
+    def __enter__(self) -> "Timer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.elapsed = time.perf_counter() - self._t0
+        if self.counters is not None:
+            self.counters.add(self.name, self.elapsed)
+            # Bytes are recorded unconditionally: gating on elapsed > 0
+            # silently dropped byte accounting for timings below the
+            # clock resolution (the old metrics.py:62 defect), skewing
+            # every derived GB/s figure upward on fast paths.
+            if self.nbytes is not None:
+                self.counters.add(f"{self.name}_bytes", self.nbytes)
+        if self.histogram is not None:
+            self.histogram.observe(self.elapsed)
+
+    @property
+    def gbps(self) -> float:
+        if self.nbytes is None or self.elapsed == 0:
+            return 0.0
+        return self.nbytes / self.elapsed / 1e9

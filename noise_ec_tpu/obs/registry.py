@@ -1,0 +1,286 @@
+"""Labeled metric families and the declarative metric-name registry.
+
+Two registries live here, deliberately together:
+
+- :class:`Registry` — the runtime object: named counter/gauge/histogram
+  FAMILIES whose children are keyed by label values (``peer="tcp://..."``,
+  ``stage="decode"``). The transports, dispatcher, KCP layer and tracer
+  record into the process-wide :func:`default_registry`; obs/export.py
+  walks it for exposition.
+- :data:`METRICS` — the declarative name registry: every metric name this
+  codebase may export, with its type, help string and label names.
+  ``Registry`` refuses names that are not declared (or declared with a
+  different type), so a typo'd metric name is an error at first record,
+  not a silently forked time series — and ``tools/check_metrics.py``
+  statically walks the source tree against this same table.
+
+Hot-path budget: a child lookup is one dict get under a lock; a counter
+add is one more lock + add (the ``record_kernel`` cost class). Callers on
+per-shard paths should hold the child (``self._shards_in =
+family.labels(peer=...)``) rather than re-resolving labels per event.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, Optional, Sequence
+
+from noise_ec_tpu.obs.metrics import (
+    LATENCY_BUCKETS,
+    SIZE_BUCKETS,
+    Histogram,
+)
+
+__all__ = [
+    "METRICS",
+    "PIPELINE_STAGES",
+    "Registry",
+    "default_registry",
+]
+
+# The span/stage model (docs/observability.md): every pipeline stage a
+# shard can spend time in, send path then receive path. Span names outside
+# this tuple still record (the tracer is generic) but the stage histogram
+# label set stays bounded by convention.
+PIPELINE_STAGES: tuple[str, ...] = (
+    "prepare",
+    "encode",
+    "sign",
+    "wire_encode",
+    "broadcast",
+    "deliver",
+    "decode",
+    "verify",
+    "reassemble",
+)
+
+# name -> (type, help, label names). The single source of truth for every
+# exported series; obs/export.py renders HELP/TYPE from it and
+# tools/check_metrics.py cross-checks source literals against it.
+METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
+    "noise_ec_stage_seconds": (
+        "histogram",
+        "Pipeline stage latency (span durations), labeled by stage",
+        ("stage",),
+    ),
+    "noise_ec_decode_seconds": (
+        "histogram",
+        "FEC decode latency on the receive hot path",
+        (),
+    ),
+    "noise_ec_decode_bytes": (
+        "histogram",
+        "FEC decode payload size per decode call",
+        (),
+    ),
+    "noise_ec_dispatch_seconds": (
+        "histogram",
+        "Per-delivery plugin handler latency on the dispatcher pool",
+        (),
+    ),
+    "noise_ec_stream_chunk_seconds": (
+        "histogram",
+        "Streaming encoder per-chunk encode+fetch latency",
+        (),
+    ),
+    "noise_ec_transport_shards_in_total": (
+        "counter",
+        "Shard messages received, labeled by sending peer address",
+        ("peer",),
+    ),
+    "noise_ec_transport_shards_out_total": (
+        "counter",
+        "Shard messages sent, labeled by destination peer address",
+        ("peer",),
+    ),
+    "noise_ec_transport_bytes_in_total": (
+        "counter",
+        "Shard payload bytes received, labeled by sending peer address",
+        ("peer",),
+    ),
+    "noise_ec_transport_bytes_out_total": (
+        "counter",
+        "Shard payload bytes sent, labeled by destination peer address",
+        ("peer",),
+    ),
+    "noise_ec_transport_frame_errors_total": (
+        "counter",
+        "Transport frames rejected before dispatch, labeled by kind "
+        "(wire, signature, unregistered, overflow, handler)",
+        ("kind",),
+    ),
+    "noise_ec_dispatch_queue_depth": (
+        "gauge",
+        "Entries queued in the serial dispatcher (all senders)",
+        (),
+    ),
+    "noise_ec_dispatch_overflows_total": (
+        "counter",
+        "Deliveries dropped because a sender's dispatch window was full",
+        (),
+    ),
+    "noise_ec_kcp_retransmits_total": (
+        "counter",
+        "KCP segments retransmitted, labeled by trigger (rto, fast)",
+        ("kind",),
+    ),
+    "noise_ec_kcp_dead_links_total": (
+        "counter",
+        "KCP sessions closed after DEAD_XMIT transmissions of a segment",
+        (),
+    ),
+    "noise_ec_kcp_sessions_opened_total": (
+        "counter",
+        "KCP sessions opened (dialed or accepted)",
+        (),
+    ),
+    "noise_ec_spans_total": (
+        "counter",
+        "Spans recorded by the in-process tracer, labeled by stage",
+        ("stage",),
+    ),
+}
+
+# Bucket layout per histogram metric (export needs them fixed per family).
+_HISTOGRAM_BUCKETS: dict[str, tuple[float, ...]] = {
+    "noise_ec_decode_bytes": SIZE_BUCKETS,
+}
+
+
+class _Counter:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def add(self, delta: float = 1.0) -> None:
+        with self._lock:
+            self.value += delta
+
+
+class _Gauge:
+    __slots__ = ("value", "_lock", "fn")
+
+    def __init__(self, fn: Optional[Callable[[], float]] = None):
+        self.value = 0.0
+        self._lock = threading.Lock()
+        self.fn = fn  # callback gauges are read at collect time
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = value
+
+    def add(self, delta: float) -> None:
+        with self._lock:
+            self.value += delta
+
+    def read(self) -> float:
+        if self.fn is not None:
+            try:
+                return float(self.fn())
+            except Exception:  # noqa: BLE001 — a dead callback reads 0
+                return 0.0
+        return self.value
+
+
+class Family:
+    """One named metric family; children keyed by label-value tuples."""
+
+    def __init__(self, name: str, mtype: str, help_text: str,
+                 label_names: tuple[str, ...],
+                 buckets: Optional[Sequence[float]] = None):
+        self.name = name
+        self.type = mtype
+        self.help = help_text
+        self.label_names = label_names
+        self.buckets = tuple(buckets) if buckets is not None else None
+        self._children: dict[tuple[str, ...], object] = {}
+        self._lock = threading.Lock()
+
+    def _make_child(self):
+        if self.type == "counter":
+            return _Counter()
+        if self.type == "gauge":
+            return _Gauge()
+        return Histogram(self.buckets or LATENCY_BUCKETS)
+
+    def labels(self, **labels: str):
+        """Child for the given label values (created on first use)."""
+        if tuple(sorted(labels)) != tuple(sorted(self.label_names)):
+            raise ValueError(
+                f"{self.name} takes labels {self.label_names}, "
+                f"got {tuple(labels)}"
+            )
+        key = tuple(str(labels[k]) for k in self.label_names)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._make_child()
+            return child
+
+    def set_callback(self, fn: Callable[[], float], **labels: str) -> None:
+        """Install a collect-time callback gauge child (queue depths and
+        other live values that would be racy to mirror on every event)."""
+        if self.type != "gauge":
+            raise ValueError(f"{self.name} is a {self.type}, not a gauge")
+        key = tuple(str(labels.get(k, "")) for k in self.label_names)
+        with self._lock:
+            self._children[key] = _Gauge(fn)
+
+    def children(self) -> Iterable[tuple[tuple[str, ...], object]]:
+        with self._lock:
+            return list(self._children.items())
+
+
+class Registry:
+    """Named metric families, validated against :data:`METRICS`."""
+
+    def __init__(self, declarations: Optional[dict] = None):
+        self._declarations = declarations if declarations is not None else METRICS
+        self._families: dict[str, Family] = {}
+        self._lock = threading.Lock()
+
+    def _family(self, name: str, mtype: str) -> Family:
+        decl = self._declarations.get(name)
+        if decl is None:
+            raise KeyError(
+                f"metric {name!r} is not declared in obs.registry.METRICS; "
+                "add it there (tools/check_metrics.py enforces the same)"
+            )
+        if decl[0] != mtype:
+            raise TypeError(
+                f"metric {name!r} is declared as {decl[0]}, requested as "
+                f"{mtype}"
+            )
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = self._families[name] = Family(
+                    name, mtype, decl[1], decl[2],
+                    buckets=_HISTOGRAM_BUCKETS.get(name),
+                )
+            return fam
+
+    def counter(self, name: str) -> Family:
+        return self._family(name, "counter")
+
+    def gauge(self, name: str) -> Family:
+        return self._family(name, "gauge")
+
+    def histogram(self, name: str) -> Family:
+        return self._family(name, "histogram")
+
+    def collect(self) -> list[Family]:
+        """Families in declaration order (stable exposition output)."""
+        with self._lock:
+            fams = dict(self._families)
+        return [fams[n] for n in self._declarations if n in fams]
+
+
+_default = Registry()
+
+
+def default_registry() -> Registry:
+    """The process-wide registry the instrumented layers record into."""
+    return _default

@@ -1,0 +1,148 @@
+"""The stats endpoint and periodic reporter — the node's scrape surface.
+
+``StatsServer`` is a stdlib ``http.server`` on a daemon thread serving:
+
+- ``GET /metrics`` — Prometheus text exposition (obs/export.py): the
+  registry's labeled families plus any attached plain counter bags;
+- ``GET /spans`` — JSON dump of the tracer ring buffer (optionally
+  ``?trace=<id>`` / ``?limit=<n>``);
+- ``GET /healthz`` — liveness.
+
+``PeriodicReporter`` logs a structured stats snapshot every N seconds so
+a node without a scraper still surfaces its counters during the run, not
+only at shutdown. Both are wired to CLI flags (``-metrics-port`` /
+``-stats-interval``) in host/cli.py.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+from noise_ec_tpu.obs.export import render_prometheus
+from noise_ec_tpu.obs.metrics import Counters
+from noise_ec_tpu.obs.registry import Registry
+from noise_ec_tpu.obs.trace import Tracer, default_tracer
+
+__all__ = ["PeriodicReporter", "StatsServer"]
+
+log = logging.getLogger("noise_ec_tpu.obs")
+
+_PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class StatsServer:
+    """Serve /metrics, /spans and /healthz on a daemon thread.
+
+    ``port=0`` binds an ephemeral port (tests); the bound port is
+    ``self.port`` after construction. ``extra_counters`` maps exposition
+    prefixes to plain :class:`Counters` bags (see obs/export.py).
+    """
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        registry: Optional[Registry] = None,
+        tracer: Optional[Tracer] = None,
+        extra_counters: Optional[dict[str, Counters]] = None,
+    ):
+        self.registry = registry
+        self.tracer = tracer if tracer is not None else default_tracer()
+        self.extra_counters = dict(extra_counters or {})
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                url = urlparse(self.path)
+                if url.path == "/metrics":
+                    body = render_prometheus(
+                        outer.registry, outer.extra_counters
+                    ).encode()
+                    self._reply(200, _PROM_CONTENT_TYPE, body)
+                elif url.path == "/spans":
+                    q = parse_qs(url.query)
+                    limit = None
+                    if "limit" in q:
+                        try:
+                            limit = int(q["limit"][0])
+                        except ValueError:
+                            self._reply(400, "text/plain", b"bad limit\n")
+                            return
+                    trace = q.get("trace", [None])[0]
+                    body = json.dumps(
+                        outer.tracer.dump(trace_id=trace, limit=limit),
+                        indent=1,
+                    ).encode()
+                    self._reply(200, "application/json", body)
+                elif url.path == "/healthz":
+                    self._reply(200, "text/plain", b"ok\n")
+                else:
+                    self._reply(404, "text/plain", b"not found\n")
+
+            def _reply(self, code: int, ctype: str, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # scrapes are not log news
+                log.debug("stats endpoint: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="noise-ec-stats",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+class PeriodicReporter:
+    """Log a stats snapshot every ``interval`` seconds on a daemon thread.
+
+    ``snapshot_fn`` returns the dict to log (e.g. merged plugin + kernel
+    counters); errors in it are logged, never raised — a reporting bug
+    must not take the node down.
+    """
+
+    def __init__(self, interval: float, snapshot_fn: Callable[[], dict],
+                 logger: Optional[logging.Logger] = None):
+        if interval <= 0:
+            raise ValueError(f"interval must be positive, got {interval}")
+        self.interval = interval
+        self.snapshot_fn = snapshot_fn
+        self.log = logger if logger is not None else log
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="noise-ec-reporter", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.log.info("stats: %s", self.snapshot_fn())
+            except Exception as exc:  # noqa: BLE001 — keep reporting
+                self.log.warning("stats snapshot failed: %s", exc)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)

@@ -37,7 +37,7 @@ from noise_ec_tpu.ops.pallas_gf2mm import (
     planes_to_tiled,
     tiled_to_planes,
 )
-from noise_ec_tpu.utils.profiling import record_kernel
+from noise_ec_tpu.obs.profiling import record_kernel
 
 _FIELDS = {"gf256": GF256, "gf65536": GF65536}
 

@@ -14,6 +14,7 @@ chunk's shards — the same per-message isolation the reference's mempool gives
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Iterable, Iterator, Optional
 
@@ -21,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from noise_ec_tpu.obs.registry import default_registry
 from noise_ec_tpu.parallel.batch import BatchCodec
 
 
@@ -69,6 +71,13 @@ class StreamingEncoder:
         self._padded_bytes = (
             -(-self.chunk_bytes // wq) * wq if self._use_words else self.chunk_bytes
         )
+        # Per-chunk dispatch-to-fetch latency (includes pipeline queueing:
+        # a growing p99 here means the consumer or the fetch link, not the
+        # kernels, is the bottleneck). One observe per chunk — nothing on
+        # the per-kernel path.
+        self._chunk_hist = default_registry().histogram(
+            "noise_ec_stream_chunk_seconds"
+        ).labels()
 
     def _to_stripes(self, chunk: bytes) -> np.ndarray:
         buf = np.frombuffer(chunk, dtype=np.uint8)
@@ -96,7 +105,7 @@ class StreamingEncoder:
         device still holds dispatched work while the consumer handles the
         yielded group.
         """
-        inflight: list[tuple[int, int, jnp.ndarray]] = []
+        inflight: list[tuple[int, int, jnp.ndarray, float]] = []
         idx = 0
         for chunk in chunks:
             if len(chunk) > self.chunk_bytes:
@@ -104,6 +113,7 @@ class StreamingEncoder:
                     f"chunk {idx} is {len(chunk)} bytes > chunk_bytes "
                     f"{self.chunk_bytes}"
                 )
+            t0 = time.perf_counter()
             stripes = self._to_stripes(chunk)
             # B=1 batch; async dispatch returns immediately. On TPU the
             # chunk rides as uint32 words through the fused lane pipeline
@@ -114,7 +124,7 @@ class StreamingEncoder:
                     jnp.asarray(words)[None], kernel=self._kernel)[0]
             else:
                 full = self.codec.encode_batch(jnp.asarray(stripes)[None])[0]
-            inflight.append((idx, len(chunk), full))
+            inflight.append((idx, len(chunk), full, t0))
             idx += 1
             if len(inflight) >= depth:
                 yield from self._drain_group(inflight, keep=depth // 2)
@@ -136,8 +146,10 @@ class StreamingEncoder:
         cut = max(len(inflight) - keep, 1)
         group = inflight[:cut]
         del inflight[:cut]
-        arrs = jax.device_get([full for (_, _, full) in group])
-        for (i, dlen, _), arr in zip(group, arrs):
+        arrs = jax.device_get([full for (_, _, full, _) in group])
+        done = time.perf_counter()
+        for (i, dlen, _, t0), arr in zip(group, arrs):
+            self._chunk_hist.observe(done - t0)
             if arr.dtype != np.uint8:
                 arr = arr.view(np.uint8)
             yield StreamChunk(index=i, shards=arr, data_len=dlen)

@@ -1,13 +1,17 @@
-"""Shared host utilities: metrics counters, profiling, logging setup."""
+"""Shared host utilities: logging setup plus compatibility re-exports.
 
-from noise_ec_tpu.utils.logging import setup_logging
-from noise_ec_tpu.utils.metrics import Counters, Timer
-from noise_ec_tpu.utils.profiling import (
+The metrics/profiling primitives moved to :mod:`noise_ec_tpu.obs`; the
+names below stay importable from here for existing callers.
+"""
+
+from noise_ec_tpu.obs.metrics import Counters, Timer
+from noise_ec_tpu.obs.profiling import (
     device_trace,
     kernel_counters,
     kernel_gbps,
     timed_window,
 )
+from noise_ec_tpu.utils.logging import setup_logging
 
 __all__ = [
     "Counters",

@@ -1,69 +1,10 @@
-"""Lightweight metrics: thread-safe counters and wall-clock timers.
+"""Compatibility shim: the metrics primitives moved to ``obs.metrics``.
 
-The reference has no metrics at all (glog lines only — SURVEY.md §5
-observability row); these counters back the structured stats the new
-framework reports (shards in/out, decodes, verify failures, throughput).
+Existing imports (``from noise_ec_tpu.utils.metrics import Counters,
+Timer``) keep working; new code should import from :mod:`noise_ec_tpu.obs`
+directly, where histograms and the labeled registry also live.
 """
 
-from __future__ import annotations
+from noise_ec_tpu.obs.metrics import Counters, Histogram, Timer
 
-import threading
-import time
-from typing import Optional
-
-__all__ = ["Counters", "Timer"]
-
-
-class Counters:
-    """A named bag of monotonically increasing counters."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._values: dict[str, float] = {}
-
-    def add(self, name: str, delta: float = 1.0) -> None:
-        with self._lock:
-            self._values[name] = self._values.get(name, 0.0) + delta
-
-    def get(self, name: str) -> float:
-        with self._lock:
-            return self._values.get(name, 0.0)
-
-    def snapshot(self) -> dict[str, float]:
-        with self._lock:
-            return dict(self._values)
-
-    def __repr__(self) -> str:
-        return f"Counters({self.snapshot()!r})"
-
-
-class Timer:
-    """Context-manager stopwatch; optionally feeds a throughput counter."""
-
-    def __init__(
-        self,
-        counters: Optional[Counters] = None,
-        name: str = "elapsed_s",
-        nbytes: Optional[int] = None,
-    ):
-        self.counters = counters
-        self.name = name
-        self.nbytes = nbytes
-        self.elapsed: float = 0.0
-
-    def __enter__(self) -> "Timer":
-        self._t0 = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc) -> None:
-        self.elapsed = time.perf_counter() - self._t0
-        if self.counters is not None:
-            self.counters.add(self.name, self.elapsed)
-            if self.nbytes is not None and self.elapsed > 0:
-                self.counters.add(f"{self.name}_bytes", self.nbytes)
-
-    @property
-    def gbps(self) -> float:
-        if self.nbytes is None or self.elapsed == 0:
-            return 0.0
-        return self.nbytes / self.elapsed / 1e9
+__all__ = ["Counters", "Histogram", "Timer"]

@@ -1,83 +1,22 @@
-"""Tracing and per-kernel throughput counters (SURVEY.md §5 observability).
+"""Compatibility shim: profiling moved to ``obs.profiling``.
 
-The reference has no profiling at all; the TPU build needs two things the
-judge's checklist names explicitly:
-
-- **XLA traces**: :func:`device_trace` wraps ``jax.profiler.trace`` so any
-  region (a bench config, a plugin decode burst) can be captured for
-  tensorboard / xprof without the callers importing profiler plumbing.
-- **Per-kernel GB/s counters**: :data:`kernel_counters` accumulates call
-  counts and payload bytes per device-kernel entry point; ``DeviceCodec``
-  feeds it on every matmul. :func:`kernel_gbps` folds a wall-clock window
-  into data rates for the BASELINE metric.
-
-Counters are process-global on purpose: the hot path records two counter
-adds per device call (no sync, no device round-trip), and one snapshot at
-report time tells you which kernel moved how many bytes.
+``kernel_counters`` here IS the same object as
+``noise_ec_tpu.obs.profiling.kernel_counters`` — callers snapshotting
+through either path see the same stats.
 """
 
-from __future__ import annotations
+from noise_ec_tpu.obs.profiling import (
+    device_trace,
+    kernel_counters,
+    kernel_gbps,
+    record_kernel,
+    timed_window,
+)
 
-import contextlib
-import time
-from typing import Iterator, Optional
-
-from noise_ec_tpu.utils.metrics import Counters
-
-__all__ = ["device_trace", "kernel_counters", "kernel_gbps", "timed_window"]
-
-# Global per-kernel stats: "<entry>_calls" and "<entry>_bytes" pairs, e.g.
-# matmul_words_calls / matmul_words_bytes.
-kernel_counters = Counters()
-
-
-def record_kernel(entry: str, nbytes: int) -> None:
-    """One device-kernel invocation moving ``nbytes`` of payload."""
-    kernel_counters.add(f"{entry}_calls", 1)
-    kernel_counters.add(f"{entry}_bytes", nbytes)
-
-
-@contextlib.contextmanager
-def device_trace(logdir: Optional[str]) -> Iterator[None]:
-    """Capture a JAX/XLA profiler trace of the region into ``logdir``.
-
-    No-op when ``logdir`` is falsy, so call sites can thread a CLI flag
-    straight through. View with tensorboard's profile plugin or xprof.
-    """
-    if not logdir:
-        yield
-        return
-    import jax
-
-    with jax.profiler.trace(logdir):
-        yield
-
-
-@contextlib.contextmanager
-def timed_window() -> Iterator[dict]:
-    """Snapshot kernel counters around a region; yields a dict filled on
-    exit with per-entry deltas plus the wall-clock window."""
-    before = kernel_counters.snapshot()
-    out: dict = {}
-    t0 = time.perf_counter()
-    try:
-        yield out
-    finally:
-        out["window_s"] = time.perf_counter() - t0
-        after = kernel_counters.snapshot()
-        for k, v in after.items():
-            d = v - before.get(k, 0.0)
-            if d:
-                out[k] = d
-
-
-def kernel_gbps(window: dict) -> dict[str, float]:
-    """Fold a :func:`timed_window` result into GB/s per kernel entry."""
-    secs = window.get("window_s", 0.0)
-    if secs <= 0:
-        return {}
-    return {
-        k[: -len("_bytes")]: round(v / secs / 1e9, 3)
-        for k, v in window.items()
-        if k.endswith("_bytes")
-    }
+__all__ = [
+    "device_trace",
+    "kernel_counters",
+    "kernel_gbps",
+    "record_kernel",
+    "timed_window",
+]

@@ -253,7 +253,12 @@ def test_bw_exact_k_no_redundancy(rng):
 # -- property tests ---------------------------------------------------------
 
 
-from hypothesis import given, settings, strategies as st  # noqa: E402
+try:
+    from hypothesis import given, settings, strategies as st  # noqa: E402
+except ImportError:  # optional dep — property tests skip, the rest run
+    from conftest import hypothesis_stubs
+
+    given, settings, st = hypothesis_stubs()
 
 
 @settings(max_examples=30, deadline=None)

@@ -3,7 +3,13 @@
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+try:
+    from hypothesis import given, settings, strategies as st
+except ImportError:  # optional dep — property tests skip, the rest run
+    from conftest import hypothesis_stubs
+
+    given, settings, st = hypothesis_stubs()
 
 from noise_ec_tpu.codec import FEC, ReedSolomon, Share
 from noise_ec_tpu.golden.codec import GoldenCodec, TooManyErrorsError

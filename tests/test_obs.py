@@ -1,0 +1,410 @@
+"""Observability layer tests: histogram math, span lifecycle, the
+Prometheus exposition format, the metric-name lint, and the loopback
+round-trip trace coverage the ISSUE's acceptance bar names."""
+
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from noise_ec_tpu.obs.export import (
+    escape_label_value,
+    render_prometheus,
+)
+from noise_ec_tpu.obs.metrics import Counters, Histogram, Timer
+from noise_ec_tpu.obs.registry import METRICS, Registry
+from noise_ec_tpu.obs.server import PeriodicReporter, StatsServer
+from noise_ec_tpu.obs.trace import Tracer, trace_key
+
+# -- histogram math ---------------------------------------------------------
+
+
+def test_histogram_bucket_assignment_le_semantics():
+    h = Histogram(buckets=[1.0, 2.0, 4.0])
+    for v in (0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 100.0):
+        h.observe(v)
+    snap = h.snapshot()
+    # le semantics: value lands in the first bucket whose bound >= value.
+    assert snap["counts"] == (2, 2, 2, 1)  # [.5,1], [1.5,2], [3,4], [100]
+    assert snap["count"] == 7
+    assert snap["sum"] == pytest.approx(112.0)
+
+
+def test_histogram_percentiles_against_known_samples():
+    h = Histogram(buckets=[float(b) for b in range(1, 101)])
+    for v in range(1, 101):  # 1..100, one per bucket
+        h.observe(float(v))
+    # Interpolated percentiles are exact when each bucket holds one
+    # sample: q*N th sample sits at the top of its bucket.
+    assert h.p50 == pytest.approx(50.0)
+    assert h.p90 == pytest.approx(90.0)
+    assert h.p99 == pytest.approx(99.0)
+    assert h.percentile(1.0) == pytest.approx(100.0)
+
+
+def test_histogram_percentile_interpolates_within_bucket():
+    h = Histogram(buckets=[10.0, 20.0])
+    for _ in range(4):
+        h.observe(15.0)  # all mass in (10, 20]
+    # p50 = halfway through the bucket's span by linear interpolation.
+    assert h.percentile(0.5) == pytest.approx(15.0)
+    assert h.percentile(0.25) == pytest.approx(12.5)
+
+
+def test_histogram_overflow_clamps_and_empty_is_zero():
+    h = Histogram(buckets=[1.0, 2.0])
+    assert h.p99 == 0.0  # empty
+    h.observe(50.0)  # +Inf bucket
+    assert h.percentile(0.99) == pytest.approx(2.0)  # clamp to top bound
+
+
+def test_histogram_rejects_bad_buckets():
+    with pytest.raises(ValueError):
+        Histogram(buckets=[])
+    with pytest.raises(ValueError):
+        Histogram(buckets=[2.0, 1.0])
+    with pytest.raises(ValueError):
+        Histogram(buckets=[1.0]).percentile(1.5)
+
+
+# -- Timer bugfix -----------------------------------------------------------
+
+
+def test_timer_records_bytes_even_for_subresolution_timings(monkeypatch):
+    """The old Timer only recorded ``{name}_bytes`` when elapsed > 0,
+    silently dropping byte accounting for timings below the clock
+    resolution — bytes must be unconditional."""
+    c = Counters()
+    t = Timer(c, "op_s", nbytes=4096)
+    t._t0 = 0.0
+    monkeypatch.setattr(time, "perf_counter", lambda: 0.0)
+    with t:
+        pass  # elapsed exactly 0.0 under the frozen clock
+    assert t.elapsed == 0.0
+    assert c.get("op_s_bytes") == 4096
+
+
+def test_timer_feeds_histogram():
+    h = Histogram()
+    with Timer(histogram=h):
+        pass
+    assert h.count == 1
+
+
+# -- span lifecycle ---------------------------------------------------------
+
+
+def test_span_records_timing_and_key():
+    tr = Tracer(registry=Registry())
+    with tr.span("decode", key="k1", k=4, n=6):
+        pass
+    (d,) = tr.dump()
+    assert d["trace_id"] == "k1"
+    assert d["name"] == "decode"
+    assert d["seconds"] >= 0.0
+    assert d["attrs"] == {"k": 4, "n": 6}
+
+
+def test_span_nesting_inherits_trace_id_and_parent():
+    tr = Tracer(registry=Registry())
+    with tr.span("prepare", key="root"):
+        with tr.span("encode"):
+            with tr.span("inner"):
+                pass
+    by_name = {d["name"]: d for d in tr.dump()}
+    assert by_name["encode"]["trace_id"] == "root"
+    assert by_name["inner"]["trace_id"] == "root"
+    assert by_name["encode"]["parent"] == "prepare"
+    assert by_name["inner"]["parent"] == "encode"
+
+
+def test_span_set_key_mid_span_propagates_to_children_finished_after():
+    """The send path learns its key only after signing: a key attached
+    mid-span must cover the span and later-finishing children."""
+    tr = Tracer(registry=Registry())
+    with tr.span("prepare") as psp:
+        with tr.span("sign") as ssp:
+            ssp.set_key("late-key")
+        psp.set_key("late-key")
+        with tr.span("encode"):
+            pass
+    assert {d["trace_id"] for d in tr.dump()} == {"late-key"}
+
+
+def test_span_error_recorded_and_reraised():
+    tr = Tracer(registry=Registry())
+    with pytest.raises(ValueError, match="boom"):
+        with tr.span("decode", key="e"):
+            raise ValueError("boom")
+    (d,) = tr.dump()
+    assert "boom" in d["error"]
+
+
+def test_span_ring_buffer_evicts_oldest():
+    tr = Tracer(capacity=4, registry=Registry())
+    for i in range(10):
+        with tr.span(f"s{i}", key=f"t{i}"):
+            pass
+    names = [d["name"] for d in tr.dump()]
+    assert names == ["s6", "s7", "s8", "s9"]
+
+
+def test_span_anonymous_gets_fresh_trace_ids_and_disable_is_noop():
+    tr = Tracer(registry=Registry())
+    with tr.span("a"):
+        pass
+    with tr.span("b"):
+        pass
+    ids = {d["trace_id"] for d in tr.dump()}
+    assert len(ids) == 2 and all(i.startswith("anon-") for i in ids)
+    tr.enabled = False
+    with tr.span("c", key="k") as sp:
+        sp.set_key("still-noop")  # the no-op span accepts the API
+    assert len(tr.dump()) == 2
+
+
+def test_tracer_feeds_stage_histogram_and_counter():
+    reg = Registry()
+    tr = Tracer(registry=reg)
+    for _ in range(3):
+        with tr.span("decode", key="k"):
+            pass
+    hist = reg.histogram("noise_ec_stage_seconds").labels(stage="decode")
+    assert hist.count == 3
+    ctr = reg.counter("noise_ec_spans_total").labels(stage="decode")
+    assert ctr.value == 3
+
+
+def test_trace_key_is_signature_prefix():
+    assert trace_key(bytes(range(32))) == bytes(range(8)).hex()
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_registry_rejects_undeclared_and_mistyped_names():
+    reg = Registry()
+    with pytest.raises(KeyError):
+        reg.counter("noise_ec_totally_made_up_total")
+    with pytest.raises(TypeError):
+        reg.counter("noise_ec_stage_seconds")  # declared histogram
+
+
+def test_registry_label_validation_and_child_identity():
+    reg = Registry()
+    fam = reg.counter("noise_ec_transport_shards_in_total")
+    with pytest.raises(ValueError):
+        fam.labels(nope="x")
+    c1 = fam.labels(peer="tcp://a:1")
+    c2 = fam.labels(peer="tcp://a:1")
+    assert c1 is c2
+    c1.add(2)
+    assert c1.value == 2
+
+
+def test_registry_callback_gauge_read_at_collect_time():
+    reg = Registry()
+    depth = {"v": 7}
+    reg.gauge("noise_ec_dispatch_queue_depth").set_callback(
+        lambda: depth["v"]
+    )
+    text = render_prometheus(reg)
+    assert "noise_ec_dispatch_queue_depth 7" in text
+    depth["v"] = 9
+    assert "noise_ec_dispatch_queue_depth 9" in render_prometheus(reg)
+
+
+# -- exposition format ------------------------------------------------------
+
+
+def test_label_escaping():
+    assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    reg = Registry()
+    reg.counter("noise_ec_transport_shards_in_total").labels(
+        peer='tcp://"evil"\n\\host:1'
+    ).add(1)
+    text = render_prometheus(reg)
+    assert (
+        'peer="tcp://\\"evil\\"\\n\\\\host:1"' in text
+    )
+
+
+def test_exposition_counter_and_histogram_lines():
+    reg = Registry()
+    reg.counter("noise_ec_transport_shards_in_total").labels(
+        peer="tcp://a:1"
+    ).add(3)
+    hist = reg.histogram("noise_ec_decode_seconds").labels()
+    hist.observe(0.5)
+    hist.observe(1.5)
+    text = render_prometheus(reg)
+    lines = text.splitlines()
+    assert "# TYPE noise_ec_transport_shards_in_total counter" in lines
+    assert 'noise_ec_transport_shards_in_total{peer="tcp://a:1"} 3' in lines
+    assert "# TYPE noise_ec_decode_seconds histogram" in lines
+    # Cumulative buckets, then the mandatory +Inf, sum, count lines.
+    inf = [ln for ln in lines if 'le="+Inf"' in ln]
+    assert inf and inf[0].endswith(" 2")
+    assert "noise_ec_decode_seconds_sum 2.0" in lines
+    assert "noise_ec_decode_seconds_count 2" in lines
+    # Buckets are cumulative (monotone non-decreasing).
+    counts = [
+        int(ln.rsplit(" ", 1)[1])
+        for ln in lines
+        if ln.startswith("noise_ec_decode_seconds_bucket")
+    ]
+    assert counts == sorted(counts)
+
+
+def test_exposition_includes_plain_counter_bags():
+    c = Counters()
+    c.add("decode_s", 1.25)
+    c.add("shards_in", 4)
+    text = render_prometheus(Registry(), {"noise_ec_plugin": c})
+    assert "noise_ec_plugin_decode_s 1.25" in text
+    assert "noise_ec_plugin_shards_in 4" in text
+    assert "# TYPE noise_ec_plugin_shards_in counter" in text
+
+
+# -- metric-name lint -------------------------------------------------------
+
+
+def test_check_metrics_source_tree_is_clean():
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        import check_metrics
+    finally:
+        sys.path.pop(0)
+    assert check_metrics.check() == []
+    # The scanner actually sees the instrumented call sites.
+    used = check_metrics.scan_source()
+    assert "noise_ec_stage_seconds" in used
+    assert "noise_ec_transport_shards_in_total" in used
+    assert set(used) <= set(METRICS)
+
+
+# -- loopback round-trip: the acceptance bar --------------------------------
+
+
+def _loopback_roundtrip(payload: bytes):
+    from noise_ec_tpu.host.plugin import ShardPlugin
+    from noise_ec_tpu.host.transport import LoopbackHub, LoopbackNetwork
+    from noise_ec_tpu.obs.trace import default_tracer
+
+    hub = LoopbackHub()
+    a = LoopbackNetwork(hub, "tcp://obs-a:1")
+    b = LoopbackNetwork(hub, "tcp://obs-b:1")
+    pa, pb = ShardPlugin(backend="numpy"), ShardPlugin(backend="numpy")
+    a.add_plugin(pa)
+    b.add_plugin(pb)
+    shards = pa.shard_and_broadcast(a, payload)
+    assert pb.counters.get("verified") == 1
+    return trace_key(shards[0].file_signature), default_tracer()
+
+
+def test_loopback_roundtrip_trace_covers_pipeline_stages():
+    """One message through the full pipeline leaves a span trace with at
+    least 6 distinct stages under ONE trace id (the acceptance bar; the
+    loopback in-process round trip records 9)."""
+    key, tracer = _loopback_roundtrip(b"end-to-end observability")
+    stages = tracer.stages(key)
+    assert stages >= {
+        "prepare", "sign", "encode", "wire_encode", "broadcast",
+        "deliver", "reassemble", "decode", "verify",
+    }
+    assert len(stages) >= 6
+    # Span dump is coherent: every span has timing and the trace id.
+    for d in tracer.dump(trace_id=key):
+        assert d["seconds"] >= 0.0
+        assert d["trace_id"] == key
+
+
+def test_loopback_roundtrip_per_peer_transport_series():
+    from noise_ec_tpu.obs.registry import default_registry
+
+    reg = default_registry()
+    before_fam = reg.counter("noise_ec_transport_shards_in_total")
+    pre = {k: v.value for k, v in before_fam.children()}
+    _loopback_roundtrip(b"per-peer series please!!")
+    child = before_fam.labels(peer="tcp://obs-a:1")
+    # 6 shards broadcast from a, all received by b, labeled by sender.
+    assert child.value - pre.get(("tcp://obs-a:1",), 0.0) == 6
+
+
+# -- HTTP endpoint ----------------------------------------------------------
+
+
+def _get(url: str) -> tuple[int, bytes]:
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.read()
+
+
+def test_stats_endpoint_serves_metrics_spans_health():
+    """Ephemeral-port endpoint: /metrics parses as exposition including a
+    histogram with correct p50/p99 against known samples; /spans dumps
+    the tracer ring; /healthz answers. Fast (no sleeps) — tier-1 safe."""
+    reg = Registry()
+    hist = reg.histogram("noise_ec_decode_seconds").labels()
+    # Known samples: bounds are powers of two; with all mass in one
+    # bucket (0.000512, 0.001024], interpolation stays inside it.
+    for _ in range(100):
+        hist.observe(0.001)
+    tr = Tracer(registry=reg)
+    with tr.span("decode", key="http-test"):
+        pass
+    bag = Counters()
+    bag.add("verified", 2)
+    srv = StatsServer(
+        port=0, registry=reg, tracer=tr,
+        extra_counters={"noise_ec_plugin": bag},
+    )
+    try:
+        status, body = _get(srv.url + "/metrics")
+        assert status == 200
+        text = body.decode()
+        count_line = [
+            ln for ln in text.splitlines()
+            if ln.startswith("noise_ec_decode_seconds_count")
+        ]
+        assert count_line == ["noise_ec_decode_seconds_count 100"]
+        assert "noise_ec_plugin_verified 2" in text
+        # The histogram the endpoint serves reproduces the known
+        # percentiles: every sample is in (0.000512, 0.001024].
+        assert 0.000512 < hist.p50 <= 0.001024
+        assert 0.000512 < hist.p99 <= 0.001024
+
+        status, body = _get(srv.url + "/spans?trace=http-test")
+        spans = json.loads(body)
+        assert [s["name"] for s in spans] == ["decode"]
+
+        status, body = _get(srv.url + "/healthz")
+        assert status == 200 and body == b"ok\n"
+
+        with pytest.raises(urllib.error.HTTPError):
+            _get(srv.url + "/nope")
+    finally:
+        srv.close()
+
+
+def test_periodic_reporter_logs_snapshots():
+    seen = []
+
+    class _Log:
+        def info(self, fmt, *args):
+            seen.append(args)
+
+        def warning(self, fmt, *args):
+            pass
+
+    rep = PeriodicReporter(0.05, lambda: {"x": 1}, _Log())
+    try:
+        deadline = time.time() + 5
+        while not seen and time.time() < deadline:
+            time.sleep(0.01)
+    finally:
+        rep.close()
+    assert seen and seen[0][0] == {"x": 1}

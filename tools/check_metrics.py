@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Static metric-name lint: source literals vs obs.registry.METRICS.
+
+Walks the package source for registry calls —
+``reg.counter("name")`` / ``.gauge("name")`` / ``.histogram("name")`` —
+and cross-checks every referenced name against the declarative registry:
+
+- **undeclared**: a call site uses a name METRICS does not declare
+  (a typo forks a time series silently in looser systems; here the
+  runtime Registry raises too, but only when the code path runs — this
+  catches it at lint time);
+- **type conflict**: the same name requested as two different types;
+- **unused**: a declared name no call site references (dead registry
+  entries rot the docs);
+- **suffix collision**: a histogram's generated series
+  (``_bucket``/``_sum``/``_count``) or a name pair differing only by
+  the ``_total`` convention colliding with another declared name.
+
+Run directly (``python tools/check_metrics.py``; exit 1 on problems) or
+through the tier-1 test that wraps it (tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "noise_ec_tpu"
+if str(REPO) not in sys.path:  # direct `python tools/check_metrics.py` runs
+    sys.path.insert(0, str(REPO))
+
+_CALL = re.compile(
+    r"\.(counter|gauge|histogram)\(\s*[\"']([A-Za-z0-9_:]+)[\"']"
+)
+
+
+def scan_source() -> dict[str, set[str]]:
+    """name -> set of requested types across the package source."""
+    used: dict[str, set[str]] = {}
+    for path in sorted(PKG.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        for mtype, name in _CALL.findall(text):
+            used.setdefault(name, set()).add(mtype)
+    return used
+
+
+def check() -> list[str]:
+    """All problems found (empty list = clean)."""
+    from noise_ec_tpu.obs.registry import METRICS
+
+    problems: list[str] = []
+    used = scan_source()
+    for name, types in sorted(used.items()):
+        decl = METRICS.get(name)
+        if decl is None:
+            problems.append(
+                f"undeclared metric {name!r} (used as {sorted(types)}); "
+                "declare it in noise_ec_tpu/obs/registry.py METRICS"
+            )
+            continue
+        for t in sorted(types):
+            if t != decl[0]:
+                problems.append(
+                    f"metric {name!r} declared {decl[0]} but requested "
+                    f"as {t}"
+                )
+    for name in METRICS:
+        if name not in used:
+            problems.append(
+                f"declared metric {name!r} has no call site; remove it "
+                "from METRICS or wire it up"
+            )
+    # Generated-series collisions: histogram suffixes and the _total
+    # convention must not alias another declared family.
+    names = set(METRICS)
+    for name, (mtype, _, _) in METRICS.items():
+        generated = (
+            [f"{name}_bucket", f"{name}_sum", f"{name}_count"]
+            if mtype == "histogram"
+            else []
+        )
+        for g in generated:
+            if g in names:
+                problems.append(
+                    f"histogram {name!r} generates {g!r}, which is also "
+                    "declared as its own metric"
+                )
+    return problems
+
+
+def main() -> int:
+    problems = check()
+    for p in problems:
+        print(f"check_metrics: {p}", file=sys.stderr)
+    if problems:
+        return 1
+    print(f"check_metrics: OK ({len(scan_source())} metric names)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
