@@ -71,6 +71,24 @@ def build_parser() -> argparse.ArgumentParser:
         help="chunk payload size for /send file streaming (bytes)",
     )
     p.add_argument(
+        "-store-dir",
+        default="",
+        metavar="DIR",
+        help="persist verified objects as erasure-coded stripes under DIR "
+        "(the stripe store, docs/store.md); enables degraded reads and "
+        "background repair. Empty disables unless -scrub-interval is set "
+        "(then the store runs in memory only)",
+    )
+    p.add_argument(
+        "-scrub-interval",
+        type=float,
+        default=0.0,
+        metavar="SECONDS",
+        help="walk the stripe store every SECONDS verifying parity and "
+        "queueing repairs (0 disables the scrubber; repairs triggered by "
+        "wire absorbs still run whenever the store is enabled)",
+    )
+    p.add_argument(
         "-metrics-port",
         type=int,
         default=-1,
@@ -134,7 +152,31 @@ def main(argv: list[str] | None = None) -> int:
             except OSError as exc:
                 log.error("could not save received object: %s", exc)
 
-    plugin = ShardPlugin(backend=args.backend, on_message=on_message)
+    store = scrubber = engine = None
+    if args.store_dir or args.scrub_interval > 0:
+        from noise_ec_tpu.store import RepairEngine, Scrubber, StripeStore
+
+        store = StripeStore(
+            args.store_dir or None, backend=args.backend
+        )
+        engine = RepairEngine(store, network=net)
+        engine.start()
+        if args.scrub_interval > 0:
+            scrubber = Scrubber(
+                store, engine, interval_seconds=args.scrub_interval
+            )
+            scrubber.start()
+        log.info(
+            "stripe store enabled (%s, %d stripes loaded, scrub %s)",
+            args.store_dir or "in-memory",
+            len(store),
+            f"every {args.scrub_interval}s" if args.scrub_interval > 0
+            else "disabled",
+        )
+
+    plugin = ShardPlugin(
+        backend=args.backend, on_message=on_message, store=store
+    )
     plugin.prewarm()  # compile the default geometry before traffic arrives
     net.add_plugin(plugin)
 
@@ -194,6 +236,10 @@ def main(argv: list[str] | None = None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if scrubber is not None:
+            scrubber.close()
+        if engine is not None:
+            engine.close()
         if reporter is not None:
             reporter.close()
         if stats_server is not None:

@@ -14,10 +14,12 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Optional
 
 from noise_ec_tpu.codec.fec import Share
+from noise_ec_tpu.obs.registry import default_registry
 
 __all__ = [
     "ShardPool",
@@ -91,6 +93,13 @@ class ShardPool:
     DEFAULT_MAX_POOLS = 65536
     DEFAULT_MAX_TOTAL_BYTES = 1 << 30  # 1 GiB of pinned share data
 
+    # Live pools for the aggregate occupancy gauges (same shape as the
+    # dispatcher queue-depth gauge: callback gauges over a WeakSet, so a
+    # dropped plugin's pool cannot pin itself through the registry).
+    _instances: "weakref.WeakSet[ShardPool]" = weakref.WeakSet()
+    _gauges_registered = False
+    _eviction_counters: dict = {}
+
     def __init__(
         self,
         ttl_seconds: Optional[float] = DEFAULT_TTL_SECONDS,
@@ -103,6 +112,24 @@ class ShardPool:
         self._max_pools = max_pools
         self._max_total_bytes = max_total_bytes
         self._total_bytes = 0
+        cls = type(self)
+        cls._instances.add(self)
+        if not ShardPool._gauges_registered:
+            ShardPool._gauges_registered = True
+            reg = default_registry()
+            reg.gauge("noise_ec_mempool_pools").set_callback(
+                lambda: sum(len(p) for p in list(ShardPool._instances))
+            )
+            reg.gauge("noise_ec_mempool_pinned_bytes").set_callback(
+                lambda: sum(
+                    p.pinned_bytes for p in list(ShardPool._instances)
+                )
+            )
+            fam = reg.counter("noise_ec_mempool_evictions_total")
+            ShardPool._eviction_counters = {
+                reason: fam.labels(reason=reason)
+                for reason in ("ttl", "explicit", "overflow")
+            }
 
     def add(
         self, key: str, share: Share, k: int, n: int
@@ -151,7 +178,7 @@ class ShardPool:
                 entry.shares[share.number] = share
                 self._total_bytes += len(share.data)
             if entry.distinct() > entry.n:
-                self._drop_locked(key)
+                self._drop_locked(key, reason="overflow")
                 raise PoolTooLargeError(
                     f"mempool for {key[:16]}… holds {entry.distinct()} distinct "
                     f"shares, more than total_shards={entry.n}"
@@ -159,11 +186,14 @@ class ShardPool:
             snapshot = [entry.shares[i] for i in sorted(entry.shares)]
             return snapshot, len(snapshot), was_new
 
-    def _drop_locked(self, key: str) -> None:
+    def _drop_locked(self, key: str, reason: str = "explicit") -> None:
         entry = self._pools.pop(key, None)
         if entry is not None:
             # every pooled share was length-checked against share_len
             self._total_bytes -= entry.share_len * len(entry.shares)
+            counter = ShardPool._eviction_counters.get(reason)
+            if counter is not None:
+                counter.add(1)
 
     def evict(self, key: str) -> None:
         with self._lock:
@@ -210,4 +240,4 @@ class ShardPool:
             key = next(iter(self._pools))
             if self._pools[key].created_at >= cutoff:
                 break
-            self._drop_locked(key)
+            self._drop_locked(key, reason="ttl")

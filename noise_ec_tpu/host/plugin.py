@@ -117,6 +117,7 @@ class ShardPlugin:
         pool_max_pools: int = ShardPool.DEFAULT_MAX_POOLS,
         pool_max_total_bytes: int = ShardPool.DEFAULT_MAX_TOTAL_BYTES,
         adjust_geometry: bool = True,
+        store=None,
     ):
         self.signature_policy = signature_policy or Ed25519Policy()
         self.hash_policy = hash_policy or Blake2bPolicy()
@@ -135,6 +136,13 @@ class ShardPlugin:
         # messages always use on_message.
         self.on_object = on_object
         self.adjust_geometry = adjust_geometry
+        # Optional stripe store (store.StripeStore): verified receives
+        # land in it as full stripes, and every arriving shard is offered
+        # to it first — a shard for a stripe we already hold is absorbed
+        # (or matched as a duplicate) there instead of re-walking the
+        # pool/decode/verify path, which is what makes the repair
+        # engine's anti-entropy exchange ride the plain SHARD opcode.
+        self.store = store
         self.pool = ShardPool(
             ttl_seconds=pool_ttl_seconds,
             max_pools=pool_max_pools,
@@ -393,6 +401,15 @@ class ShardPlugin:
         peers (main.go:201-210). Returns the shards for callers that want
         them (the reference discards them)."""
         shards = self.prepare_shards(network.id, network.keys, input_bytes)
+        # The origin keeps its own object too: anti-entropy repair
+        # (store/repair.py) can then serve any peer that rots, and the
+        # sender's stripe is the fleet's ground-truth copy.
+        self._store_put_raw(
+            shards[0].file_signature, input_bytes,
+            int(shards[0].minimum_needed_shards),
+            int(shards[0].total_shards),
+            network.id.address, bytes(network.keys.public_key),
+        )
         with span(
             "broadcast",
             key=trace_key(shards[0].file_signature),
@@ -533,6 +550,12 @@ class ShardPlugin:
                 serialize_message_parts(network.id, data),
             )
             ssp.set_key(trace_key(file_signature))
+        # Whole object already in memory: keep the origin copy (one
+        # stripe per object — the store's geometry, not the chunking).
+        self._store_put_raw(
+            file_signature, data, k, n,
+            network.id.address, bytes(network.keys.public_key),
+        )
         view = memoryview(data)
         chunks = (view[i * B : (i + 1) * B] for i in range(count))
         return self._emit_stream(
@@ -892,6 +915,12 @@ class ShardPlugin:
                     f"geometry ({k},{n}) vs ({st['k']},{st['n']}))"
                 )
 
+        if self.store is not None:
+            # Stream chunks never absorb into a stripe (the store holds
+            # whole objects as single stripes), but a stream shard for an
+            # object we already store IS peer interest — note_shard
+            # surfaces it to the repair engine and returns False.
+            self.store.note_shard(msg)
         share = Share(msg.shard_number, bytes(msg.shard_data))
         pool_key = f"{key}:{index}"
         try:
@@ -1065,6 +1094,12 @@ class ShardPlugin:
         if not self._mark_completed(key):
             self.counters.add("late_shards", 1)
             return None
+        # Store BEFORE delivery: the on_object path below transfers
+        # ownership of the reassembly buffer to the callee.
+        self._store_put(
+            ctx, msg, int(msg.minimum_needed_shards),
+            int(msg.total_shards), complete, sender,
+        )
         if self.on_object is not None and isinstance(complete, bytearray):
             # Zero-copy delivery: hand over the reassembly buffer itself.
             # _drop_stream first — the plugin must hold no reference to a
@@ -1164,6 +1199,42 @@ class ShardPlugin:
             for i in range(st["count"]):
                 self.pool.evict(f"{key}:{i}")
 
+    # ------------------------------------------------------------- store
+
+    def _store_put(
+        self, ctx: PluginContext, msg: Shard, k: int, n: int, data, sender
+    ) -> None:
+        """Land a signature-verified object in the stripe store (when one
+        is wired in). The sender identity rides along so the repair
+        engine can re-anchor error-corrected restores on the same
+        signature the receive path just checked. A store failure must
+        never break delivery."""
+        self._store_put_raw(
+            msg.file_signature, data, k, n,
+            sender.address, bytes(ctx.client_public_key()),
+        )
+
+    def _store_put_raw(
+        self, file_signature: bytes, data, k: int, n: int,
+        address: str, public_key: bytes,
+    ) -> None:
+        if self.store is None:
+            return
+        try:
+            self.store.put_object(
+                file_signature,
+                bytes(data),
+                k,
+                n,
+                sender_address=address,
+                sender_public_key=public_key,
+            )
+            self.counters.add("store_puts", 1)
+        except Exception as exc:  # noqa: BLE001 — delivery must proceed
+            self.counters.add("store_put_errors", 1)
+            log.warning("stripe store put failed for %s…: %s",
+                        file_signature[:8].hex(), exc)
+
     # -------------------------------------------------------- receive path
 
     def receive(self, ctx: PluginContext) -> Optional[bytes]:
@@ -1186,6 +1257,15 @@ class ShardPlugin:
         if msg.stream_chunk_count:
             return self._receive_stream(ctx, msg)
         key = msg.file_signature.hex()  # mempool key, main.go:55
+        if self.store is not None and self.store.note_shard(msg):
+            # The store consumed it (BEFORE the dedup window — an
+            # anti-entropy response arrives precisely for objects we
+            # completed, and absorbing it must not depend on timing):
+            # either a fill of a stripe we hold or a duplicate of a shard
+            # we already store (the interest signal peers answer). No
+            # pool work needed — the object is already durable locally.
+            self.counters.add("store_absorbed_shards", 1)
+            return None
         if self._recently_completed(key):
             self.counters.add("late_shards", 1)
             return None
@@ -1273,6 +1353,7 @@ class ShardPlugin:
                 self.counters.add("late_shards", 1)
                 return None
             self.counters.add("verified", 1)
+            self._store_put(ctx, msg, k, n, complete, sender)
             log.info("completed message %s… (%d bytes)", complete[:32].hex(), len(complete))
             if self.on_message is not None:
                 self.on_message(complete, sender)

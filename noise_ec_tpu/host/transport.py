@@ -922,6 +922,12 @@ class TCPNetwork:
             pass
 
     async def _dial(self, address: str) -> None:
+        # Idempotent: dialing an address we already hold a registered
+        # connection to is a no-op (repeat bootstrap calls, gossip
+        # re-learning a live peer) — no churn, no duplicate handshake.
+        with self._lock:
+            if any(p.pid.address == address for p in self.peers.values()):
+                return
         self._dialing.add(address)
         host, port = self._split(address)
         if address.startswith("kcp://") or (
@@ -930,11 +936,19 @@ class TCPNetwork:
             from noise_ec_tpu.host.kcp import open_kcp_connection as opener
         else:
             opener = asyncio.open_connection
-        # (For kcp the opener returns without any network round trip; the
-        # real unreachable-peer bound is conn.registered.wait below.)
-        reader, writer = await asyncio.wait_for(
-            opener(host, port), timeout=self.connection_timeout
-        )
+        try:
+            # (For kcp the opener returns without any network round trip;
+            # the real unreachable-peer bound is conn.registered.wait
+            # below.)
+            reader, writer = await asyncio.wait_for(
+                opener(host, port), timeout=self.connection_timeout
+            )
+        except Exception:
+            # Refund the dedup slot: a failed dial (bootstrap races the
+            # peer's startup, say) must not block discovery from ever
+            # dialing this address again.
+            self._dialing.discard(address)
+            raise
         conn = _Conn(is_dialer=True)
         try:
             writer.write(self._frame(_OP_HELLO, conn.nonce))
@@ -948,6 +962,7 @@ class TCPNetwork:
                 conn.registered.wait(), timeout=self.connection_timeout
             )
         except Exception:
+            self._dialing.discard(address)
             self._drop_writer(writer)
             raise
 
@@ -1024,8 +1039,14 @@ class TCPNetwork:
                 p for key, p in self.peers.items() if key != pid.public_key
             ]
             prev = self.peers.get(pid.public_key)
+            if prev is not None and prev.writer is writer:
+                # Idempotent re-registration (a replayed-but-valid ACK on
+                # the registered connection): nothing changed, so no
+                # gossip re-announce and no close-the-loser dance.
+                conn.registered.set()
+                return
             keep_new = True
-            if prev is not None and prev.writer is not writer:
+            if prev is not None:
                 if prev.is_dialer != conn.is_dialer:
                     keep_new = conn.is_dialer == (
                         self.keys.public_key < pid.public_key
@@ -1041,6 +1062,11 @@ class TCPNetwork:
             except Exception:  # noqa: BLE001
                 pass
         conn.registered.set()
+        if keep_new:
+            # INFO so operators (and the e2e tests) can observe exactly
+            # when a peer becomes reachable instead of probing with
+            # retried sends.
+            log.info("registered peer %s", pid.address)
         if self.discovery and others and keep_new:
             # Peer exchange (the reference's discovery.Plugin, main.go:151):
             # tell the newcomer who we know, and announce the newcomer to

@@ -52,6 +52,10 @@ PIPELINE_STAGES: tuple[str, ...] = (
     "decode",
     "verify",
     "reassemble",
+    # Store background work (docs/store.md): one span per scrub cycle and
+    # one per repair dispatch (batched group or single-stripe restore).
+    "scrub",
+    "repair",
 )
 
 # name -> (type, help, label names). The single source of truth for every
@@ -138,6 +142,108 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "counter",
         "Spans recorded by the in-process tracer, labeled by stage",
         ("stage",),
+    ),
+    # --- stripe store / scrub / repair (noise_ec_tpu/store, docs/store.md)
+    "noise_ec_store_stripes": (
+        "gauge",
+        "Stripes resident in the store(s)",
+        (),
+    ),
+    "noise_ec_store_shard_bytes": (
+        "gauge",
+        "Shard bytes pinned by the store(s)",
+        (),
+    ),
+    "noise_ec_store_degraded_reads_total": (
+        "counter",
+        "Reads served by on-demand reconstruction (data shards missing)",
+        (),
+    ),
+    "noise_ec_store_absorbed_shards_total": (
+        "counter",
+        "Wire shards absorbed into existing stripes (anti-entropy fill)",
+        (),
+    ),
+    "noise_ec_store_absorb_rejected_total": (
+        "counter",
+        "Wire shards rejected by the absorb consistency check",
+        (),
+    ),
+    "noise_ec_store_scrub_cycles_total": (
+        "counter",
+        "Completed scrub cycles",
+        (),
+    ),
+    "noise_ec_store_scrubbed_stripes_total": (
+        "counter",
+        "Stripes examined by the scrubber",
+        (),
+    ),
+    "noise_ec_store_missing_shards_total": (
+        "counter",
+        "Missing/unverified shards newly flagged by the scrubber",
+        (),
+    ),
+    "noise_ec_store_verify_failures_total": (
+        "counter",
+        "Stripes whose batched parity verify failed (corruption found)",
+        (),
+    ),
+    "noise_ec_store_corrupt_shards_total": (
+        "counter",
+        "Shards whose stored bytes disagreed with the repaired truth",
+        (),
+    ),
+    "noise_ec_store_repairs_completed_total": (
+        "counter",
+        "Stripes restored to full health by the repair engine",
+        (),
+    ),
+    "noise_ec_store_repair_failures_total": (
+        "counter",
+        "Repair attempts that could not restore the stripe",
+        (),
+    ),
+    "noise_ec_store_repair_batches_total": (
+        "counter",
+        "Batched device reconstruct dispatches (>= batch_min stripes each)",
+        (),
+    ),
+    "noise_ec_store_repair_batch_stripes_total": (
+        "counter",
+        "Stripes repaired through batched device dispatches",
+        (),
+    ),
+    "noise_ec_store_repair_queue_depth": (
+        "gauge",
+        "Stripes awaiting repair across live repair engines",
+        (),
+    ),
+    "noise_ec_store_anti_entropy_requests_total": (
+        "counter",
+        "Anti-entropy shard-fetch requests broadcast to peers",
+        (),
+    ),
+    "noise_ec_store_anti_entropy_responses_total": (
+        "counter",
+        "Anti-entropy responses answered with local shards",
+        (),
+    ),
+    # --- shard mempool (host/mempool.py)
+    "noise_ec_mempool_pools": (
+        "gauge",
+        "Reassembly pools open across live ShardPools",
+        (),
+    ),
+    "noise_ec_mempool_pinned_bytes": (
+        "gauge",
+        "Share bytes pinned across live ShardPools",
+        (),
+    ),
+    "noise_ec_mempool_evictions_total": (
+        "counter",
+        "Pools dropped, labeled by reason (ttl, explicit, overflow)",
+        ("reason",),
     ),
 }
 

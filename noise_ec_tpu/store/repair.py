@@ -1,0 +1,532 @@
+"""Repair engine: coalesce pending stripe repairs into batched device
+reconstructs, with anti-entropy peer fetch as the fallback.
+
+The queue holds at most one task per stripe key (re-enqueues upgrade the
+kind in place). A drain groups local reconstructions by *repair shape* —
+(k, n, field, shard length, trusted-slot pattern) — and runs each group
+of same-shape stripes as ONE batched device dispatch
+(``parallel.batch.BatchCodec.reconstruct_batch``: the (B, present, S)
+stack against one inverted submatrix), which is what turns a 0.03 ms
+per-stripe device reconstruct into an always-on background workload
+instead of B host round trips.
+
+Task kinds (classified by :meth:`StripeStore.classify`):
+
+- ``missing`` — >= k trusted shards: batched erasure reconstruct, holes
+  (and unverified slots) rewritten from the trusted basis.
+- ``restore`` — < k trusted but >= k present counting unverified wire
+  absorbs: the error-correcting whole-stripe decode (``codec.fec.FEC``,
+  Berlekamp-Welch radius), anchored by the stored sender signature when
+  available; on success every slot is rewritten/blessed.
+- ``fetch`` — < k present: local math cannot help. The engine broadcasts
+  the surviving trusted shards over the ordinary SHARD opcode (no new
+  wire surface); peers that hold the stripe notice the interest
+  (:meth:`StripeStore.note_shard` → :meth:`on_remote_interest`) and
+  answer with their shards, which the requester absorbs shard-by-shard.
+- ``respond`` — a peer showed interest in a stripe we hold with >= k
+  trusted shards: broadcast our trusted shards (rate-limited per key).
+
+Run it either as a background thread (:meth:`start`) that wakes on
+enqueue and lingers briefly to let same-shape work coalesce, or drive it
+deterministically with :meth:`drain_once` (tests, bench).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import weakref
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from noise_ec_tpu.host.wire import Shard
+from noise_ec_tpu.obs.registry import default_registry
+from noise_ec_tpu.obs.trace import span
+from noise_ec_tpu.store.stripe import StripeStore, UnknownStripeError
+
+__all__ = ["RepairEngine"]
+
+log = logging.getLogger("noise_ec_tpu.store")
+
+# Task kinds in escalation order: a re-enqueue may only upgrade towards
+# the network fallback, never downgrade a fetch back to local math (the
+# classifier re-checks at drain time anyway). "verify_failed" is the
+# scrubber's flag for a fully-present stripe whose parity disagrees —
+# classify() cannot see it (it only counts slots), so the kind survives
+# re-classification below.
+_KIND_RANK = {
+    "respond": 0, "missing": 1, "verify_failed": 2, "restore": 3, "fetch": 4,
+}
+
+
+class _EngineMetrics:
+    _registered = False
+    _instances: "weakref.WeakSet[RepairEngine]" = weakref.WeakSet()
+
+    def __init__(self):
+        reg = default_registry()
+        self.repairs = reg.counter(
+            "noise_ec_store_repairs_completed_total"
+        ).labels()
+        self.failures = reg.counter(
+            "noise_ec_store_repair_failures_total"
+        ).labels()
+        self.batches = reg.counter(
+            "noise_ec_store_repair_batches_total"
+        ).labels()
+        self.batch_stripes = reg.counter(
+            "noise_ec_store_repair_batch_stripes_total"
+        ).labels()
+        self.corrupt_shards = reg.counter(
+            "noise_ec_store_corrupt_shards_total"
+        ).labels()
+        self.requests = reg.counter(
+            "noise_ec_store_anti_entropy_requests_total"
+        ).labels()
+        self.responses = reg.counter(
+            "noise_ec_store_anti_entropy_responses_total"
+        ).labels()
+        cls = _EngineMetrics
+        if not cls._registered:
+            cls._registered = True
+            reg.gauge("noise_ec_store_repair_queue_depth").set_callback(
+                lambda: sum(e.queue_depth() for e in list(cls._instances))
+            )
+
+
+class RepairEngine:
+    """Batched repair worker over one :class:`StripeStore` (module doc)."""
+
+    def __init__(
+        self,
+        store: StripeStore,
+        network=None,
+        *,
+        batch_min: int = 2,
+        max_batch: int = 64,
+        linger_seconds: float = 0.05,
+        fetch_interval_seconds: float = 30.0,
+        respond_interval_seconds: float = 30.0,
+    ):
+        self.store = store
+        self.network = network
+        self.batch_min = batch_min
+        self.max_batch = max_batch
+        self.linger_seconds = linger_seconds
+        self.fetch_interval_seconds = fetch_interval_seconds
+        self.respond_interval_seconds = respond_interval_seconds
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: OrderedDict[str, str] = {}  # key -> kind
+        self._last_fetch: OrderedDict[str, float] = OrderedDict()
+        self._last_respond: OrderedDict[str, float] = OrderedDict()
+        self._batch_codecs: dict[tuple[int, int, str], object] = {}
+        self._fecs: dict[tuple[int, int, str], object] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self.metrics = _EngineMetrics()
+        _EngineMetrics._instances.add(self)
+        store.bind_engine(self)
+
+    # ------------------------------------------------------------- queue
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def enqueue(self, key: str, kind: str) -> None:
+        if kind not in _KIND_RANK:
+            raise ValueError(f"unknown repair kind {kind!r}")
+        with self._cond:
+            prev = self._queue.get(key)
+            if prev is None or _KIND_RANK[kind] > _KIND_RANK[prev]:
+                self._queue[key] = kind
+            self._cond.notify()
+
+    def enqueue_auto(self, key: str) -> None:
+        """Classify-and-enqueue (the absorb path calls this after filling
+        a hole; a healthy stripe enqueues nothing)."""
+        try:
+            kind = self.store.classify(key)
+        except UnknownStripeError:
+            return
+        if kind is not None:
+            self.enqueue(key, kind)
+
+    def on_remote_interest(self, key: str) -> None:
+        """A peer is moving shards of a stripe we hold (called from the
+        plugin receive path via the store — must stay cheap). Rate-limit
+        per key, then queue a respond task."""
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_respond.get(key)
+            if (
+                last is not None
+                and now - last < self.respond_interval_seconds
+            ):
+                return
+            self._last_respond[key] = now
+            while len(self._last_respond) > 4096:
+                self._last_respond.popitem(last=False)
+        self.enqueue(key, "respond")
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name="noise-ec-repair", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if self._closed:
+                    return
+            # Linger so same-shape repairs arriving in a burst (a scrub
+            # cycle, a dying device) coalesce into one batched dispatch.
+            if self.linger_seconds > 0:
+                time.sleep(self.linger_seconds)
+            try:
+                self.drain_once()
+            except Exception as exc:  # noqa: BLE001 — keep the worker up
+                log.error("repair drain failed: %s", exc)
+
+    # -------------------------------------------------------------- drain
+
+    def drain_once(self) -> int:
+        """Process everything currently queued; returns the number of
+        stripes repaired (fetch/respond count as processed, not
+        repaired). Deterministic entry point for tests and bench."""
+        with self._lock:
+            tasks = list(self._queue.items())[: self.max_batch]
+            for key, _ in tasks:
+                del self._queue[key]
+        if not tasks:
+            return 0
+        # Re-classify at drain time: absorbs since enqueue may have
+        # upgraded a fetch to a local reconstruct (or healed it outright).
+        groups: dict[tuple, list[tuple[str, list]]] = {}
+        singles: list[tuple[str, str]] = []
+        for key, kind in tasks:
+            if kind == "respond":
+                singles.append((key, "respond"))
+                continue
+            try:
+                now_kind = self.store.classify(key)
+            except UnknownStripeError:
+                continue
+            if now_kind is None:
+                # Slot-complete — but a scrub verify_failed flag means the
+                # bytes are wrong even though every slot is filled.
+                if kind == "verify_failed":
+                    singles.append((key, "verify_failed"))
+                continue
+            if now_kind == "missing":
+                meta, shards, unverified = self.store.snapshot(key)
+                trusted = tuple(
+                    i for i, s in enumerate(shards)
+                    if s is not None and i not in unverified
+                )
+                gkey = (
+                    meta.k, meta.n, meta.field, meta.shard_len, trusted
+                )
+                groups.setdefault(gkey, []).append((key, shards))
+            else:
+                singles.append((key, now_kind))
+        repaired = 0
+        for gkey, members in groups.items():
+            repaired += self._reconstruct_group(gkey, members)
+        for key, kind in singles:
+            if kind in ("restore", "verify_failed"):
+                repaired += self._restore(key)
+            elif kind == "fetch":
+                self._fetch(key)
+            elif kind == "respond":
+                self._respond(key)
+        return repaired
+
+    # ------------------------------------------------- local reconstruct
+
+    def _batch_codec(self, k: int, n: int, field: str):
+        bkey = (k, n, field)
+        bc = self._batch_codecs.get(bkey)
+        if bc is None:
+            from noise_ec_tpu.parallel.batch import BatchCodec
+
+            bc = self._batch_codecs[bkey] = BatchCodec(k, n - k, field=field)
+        return bc
+
+    def _sym_dtype(self, field: str):
+        return np.dtype("<u2") if field == "gf65536" else np.dtype(np.uint8)
+
+    def _reconstruct_group(self, gkey: tuple, members: list) -> int:
+        """Rebuild every non-trusted slot of a same-shape stripe group.
+        B >= batch_min stripes fold into one batched device dispatch;
+        smaller groups take the per-stripe codec path."""
+        k, n, fieldname, shard_len, trusted = gkey
+        wanted = [i for i in range(n) if i not in trusted]
+        if not wanted or len(trusted) < k:
+            return 0
+        dt = self._sym_dtype(fieldname)
+        repaired = 0
+        with span("repair", stripes=len(members), k=k, n=n):
+            if len(members) >= self.batch_min:
+                bc = self._batch_codec(k, n, fieldname)
+                stack = np.stack([
+                    np.stack([
+                        np.frombuffer(shards[i], dtype=np.uint8).view(dt)
+                        for i in trusted
+                    ])
+                    for _, shards in members
+                ])
+                full = np.asarray(
+                    bc.reconstruct_batch(stack, list(trusted))
+                )
+                self.metrics.batches.add(1)
+                self.metrics.batch_stripes.add(len(members))
+                rebuilt = [
+                    {
+                        i: np.ascontiguousarray(full[b, i])
+                        .view(np.uint8).tobytes()
+                        for i in wanted
+                    }
+                    for b in range(len(members))
+                ]
+            else:
+                rs = self.store.codec(k, n, fieldname)
+                required = [i in wanted for i in range(n)]
+                rebuilt = []
+                for _, shards in members:
+                    usable = [
+                        shards[i] if i in trusted else None for i in range(n)
+                    ]
+                    rows = rs.reconstruct_some(usable, required)
+                    rebuilt.append({
+                        i: np.ascontiguousarray(rows[i])
+                        .view(np.uint8).tobytes()
+                        for i in wanted
+                    })
+            for (key, shards), fixed in zip(members, rebuilt):
+                corrected = sum(
+                    1 for i in wanted
+                    if shards[i] is not None and shards[i] != fixed[i]
+                )
+                try:
+                    self.store.write_repaired(key, fixed)
+                except (UnknownStripeError, ValueError) as exc:
+                    self.metrics.failures.add(1)
+                    log.warning("repair write-back failed for %s: %s",
+                                key, exc)
+                    continue
+                if corrected:
+                    self.metrics.corrupt_shards.add(corrected)
+                self.metrics.repairs.add(1)
+                repaired += 1
+        return repaired
+
+    # -------------------------------------------------- restore / verify
+
+    def _fec(self, k: int, n: int, fieldname: str):
+        fkey = (k, n, fieldname)
+        fec = self._fecs.get(fkey)
+        if fec is None:
+            from noise_ec_tpu.codec.fec import FEC
+
+            fec = self._fecs[fkey] = FEC(
+                k, n, field=fieldname, backend="numpy"
+            )
+        return fec
+
+    def repair_corrupt(self, key: str) -> bool:
+        """Whole-stripe validation + correction: the scrubber sends
+        parity-inconsistent stripes here. Error-correcting decode over
+        every present shard, sender-signature check when the stripe
+        carries one, then re-encode and rewrite whatever disagreed."""
+        return self._restore(key) > 0
+
+    def _restore(self, key: str) -> int:
+        from noise_ec_tpu.codec.fec import Share
+
+        try:
+            meta, shards, unverified = self.store.snapshot(key)
+        except UnknownStripeError:
+            return 0
+        present = [i for i, s in enumerate(shards) if s is not None]
+        if len(present) < meta.k:
+            self.enqueue(key, "fetch")
+            return 0
+        fec = self._fec(meta.k, meta.n, meta.field)
+        with span("repair", key=key, kind="restore"):
+            try:
+                data_full = fec.decode(
+                    [Share(i, shards[i]) for i in present]
+                )
+            except Exception as exc:  # noqa: BLE001 — undecodable as-is
+                self.metrics.failures.add(1)
+                log.warning("restore decode failed for %s: %s", key, exc)
+                self.enqueue(key, "fetch")
+                return 0
+            obj = data_full[: meta.object_len]
+            if meta.sender_public_key:
+                if not self._signature_ok(meta, obj):
+                    self.metrics.failures.add(1)
+                    log.warning(
+                        "restore of %s decodes but fails the stored "
+                        "sender signature; keeping slots unverified", key
+                    )
+                    self.enqueue(key, "fetch")
+                    return 0
+            elif len(present) == meta.k and unverified:
+                # k mixed trusted/unverified shards, no redundancy and no
+                # signature anchor: nothing vouches for the decode.
+                self.metrics.failures.add(1)
+                self.enqueue(key, "fetch")
+                return 0
+            rs = self.store.codec(meta.k, meta.n, meta.field)
+            stride = meta.shard_len // self._sym_dtype(meta.field).itemsize
+            D = (
+                np.frombuffer(data_full, dtype=np.uint8)
+                .view(self._sym_dtype(meta.field))
+                .reshape(meta.k, stride)
+            )
+            truth = [
+                np.ascontiguousarray(row).view(np.uint8).tobytes()
+                for row in rs.encode(list(D))
+            ]
+            fixed = {
+                i: truth[i]
+                for i in range(meta.n)
+                if shards[i] != truth[i]
+            }
+            corrupt = sum(
+                1 for i in fixed if shards[i] is not None
+            )
+            try:
+                if fixed:
+                    self.store.write_repaired(key, fixed)
+                self.store.mark_trusted(key, range(meta.n))
+            except (UnknownStripeError, ValueError) as exc:
+                self.metrics.failures.add(1)
+                log.warning("restore write-back failed for %s: %s", key, exc)
+                return 0
+            if corrupt:
+                self.metrics.corrupt_shards.add(corrupt)
+            self.metrics.repairs.add(1)
+        return 1
+
+    def _signature_ok(self, meta, obj: bytes) -> bool:
+        from noise_ec_tpu.host.crypto import (
+            Blake2bPolicy,
+            Ed25519Policy,
+            PeerID,
+            serialize_message,
+            verify,
+        )
+
+        try:
+            return verify(
+                Ed25519Policy(),
+                Blake2bPolicy(),
+                meta.sender_public_key,
+                serialize_message(
+                    PeerID.create(
+                        meta.sender_address, meta.sender_public_key
+                    ),
+                    obj,
+                ),
+                meta.file_signature,
+            )
+        except Exception:  # noqa: BLE001 — malformed stored identity
+            return False
+
+    # ------------------------------------------------------ anti-entropy
+
+    def _broadcast_shards(self, meta, shards, numbers) -> int:
+        sent = 0
+        for i in numbers:
+            if shards[i] is None:
+                continue
+            self.network.broadcast(Shard(
+                file_signature=meta.file_signature,
+                shard_data=shards[i],
+                shard_number=i,
+                total_shards=meta.n,
+                minimum_needed_shards=meta.k,
+            ))
+            sent += 1
+        return sent
+
+    def _fetch(self, key: str) -> None:
+        """Anti-entropy request: re-broadcast our surviving trusted
+        shards over the plain SHARD opcode. Peers holding the stripe see
+        shards they already have, which is the interest signal their
+        engine answers (``respond``); their shards then heal us via the
+        absorb path."""
+        if self.network is None:
+            return
+        peers = getattr(self.network, "peers", None)
+        if peers is not None and not peers:
+            # Nobody to ask yet (startup races peer registration): do NOT
+            # burn the per-key rate-limit window on a broadcast to zero
+            # peers — the next scrub cycle re-enqueues the fetch and it
+            # goes out once a peer registers.
+            return
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_fetch.get(key)
+            if (
+                last is not None
+                and now - last < self.fetch_interval_seconds
+            ):
+                return
+            self._last_fetch[key] = now
+            while len(self._last_fetch) > 4096:
+                self._last_fetch.popitem(last=False)
+        try:
+            meta, shards, unverified = self.store.snapshot(key)
+        except UnknownStripeError:
+            return
+        trusted = [
+            i for i, s in enumerate(shards)
+            if s is not None and i not in unverified
+        ]
+        self._broadcast_shards(meta, shards, trusted)
+        self.metrics.requests.add(1)
+        log.info(
+            "anti-entropy request for stripe %s (%d/%d trusted shards "
+            "survive)", key, len(trusted), meta.n,
+        )
+
+    def _respond(self, key: str) -> None:
+        if self.network is None:
+            return
+        try:
+            meta, shards, unverified = self.store.snapshot(key)
+        except UnknownStripeError:
+            return
+        trusted = [
+            i for i, s in enumerate(shards)
+            if s is not None and i not in unverified
+        ]
+        if len(trusted) < meta.k:
+            return  # we are the one needing help here
+        sent = self._broadcast_shards(meta, shards, trusted)
+        if sent:
+            self.metrics.responses.add(1)

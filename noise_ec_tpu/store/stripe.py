@@ -1,0 +1,643 @@
+"""Content-addressed stripe store with degraded reads and disk persistence.
+
+A *stripe* is one object's full erasure-coded shard set plus geometry
+metadata, addressed by the 16-hex signature prefix that obs tracing and
+the plugin's pool keys already use (:func:`obs.trace.trace_key`). The
+store is the durability layer the reference lacks: verified receives land
+here instead of being dropped after reassembly, and the object stays
+readable while up to n-k shards are missing (reconstructed on demand —
+the degraded-read path).
+
+Trust model (mirrors the plugin's): shards written by :meth:`put_object`
+come from a signature-verified object and are *trusted*. Shards absorbed
+from the wire (:meth:`note_shard`, the anti-entropy fill path) are
+verified against the trusted remainder when >= k trusted shards exist
+(reconstruct-and-compare); otherwise they are held *unverified* until the
+repair engine can validate the whole stripe (error-correcting decode,
+plus the stored sender signature when available). Degraded reads use
+trusted shards only.
+
+Thread safety: one lock guards the stripe table and every stripe
+mutation; codec construction happens outside it. Disk writes are atomic
+(tmp + rename) so a torn write can never leave a wrong-content shard
+under a content-derived name.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import weakref
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Optional
+
+import numpy as np
+
+from noise_ec_tpu.codec.rs import ReedSolomon
+from noise_ec_tpu.obs.registry import default_registry
+from noise_ec_tpu.obs.trace import trace_key
+
+__all__ = [
+    "DegradedReadError",
+    "StripeMeta",
+    "StripeStore",
+    "UnknownStripeError",
+]
+
+log = logging.getLogger("noise_ec_tpu.store")
+
+_FIELD_SYM = {"gf256": 1, "gf65536": 2}
+
+
+class UnknownStripeError(KeyError):
+    """No stripe under this key."""
+
+
+class DegradedReadError(RuntimeError):
+    """Fewer than k trusted shards survive: the object cannot be served
+    locally. The repair engine's anti-entropy fetch is the recovery path."""
+
+
+@dataclass
+class StripeMeta:
+    """Geometry + identity metadata for one stripe (persisted as JSON)."""
+
+    file_signature: bytes
+    k: int
+    n: int
+    shard_len: int
+    object_len: int
+    field: str = "gf256"
+    # Sender identity captured at put time: lets the repair engine verify
+    # an error-corrected restore against the object signature, the same
+    # end-to-end anchor the plugin's receive path uses. Optional — a
+    # stripe stored outside the plugin path has no sender.
+    sender_address: str = ""
+    sender_public_key: bytes = b""
+
+    @property
+    def key(self) -> str:
+        return trace_key(self.file_signature)
+
+
+@dataclass
+class _Stripe:
+    meta: StripeMeta
+    shards: list  # Optional[bytes] per slot, length n
+    unverified: set = field(default_factory=set)  # slot numbers
+
+    def present(self) -> list[int]:
+        return [i for i, s in enumerate(self.shards) if s is not None]
+
+    def trusted(self) -> list[int]:
+        return [
+            i for i, s in enumerate(self.shards)
+            if s is not None and i not in self.unverified
+        ]
+
+
+class _StoreMetrics:
+    """Cached registry children for the store metric family (resolved
+    once; the scrub/repair loops record per stripe)."""
+
+    _registered = False
+    _instances: "weakref.WeakSet[StripeStore]" = weakref.WeakSet()
+
+    def __init__(self):
+        reg = default_registry()
+        self.degraded_reads = reg.counter(
+            "noise_ec_store_degraded_reads_total"
+        ).labels()
+        self.absorbed = reg.counter(
+            "noise_ec_store_absorbed_shards_total"
+        ).labels()
+        self.absorb_rejected = reg.counter(
+            "noise_ec_store_absorb_rejected_total"
+        ).labels()
+        cls = _StoreMetrics
+        if not cls._registered:
+            cls._registered = True
+            reg.gauge("noise_ec_store_stripes").set_callback(
+                lambda: sum(len(s) for s in list(cls._instances))
+            )
+            reg.gauge("noise_ec_store_shard_bytes").set_callback(
+                lambda: sum(s.shard_bytes for s in list(cls._instances))
+            )
+
+
+class StripeStore:
+    """Content-addressed stripe store (see module docstring).
+
+    ``store_dir=None`` keeps stripes in memory only; with a directory,
+    every stripe persists as ``<dir>/<key>/meta.json`` + per-shard files
+    and :meth:`load` (called from ``__init__``) restores them on startup.
+    """
+
+    def __init__(
+        self,
+        store_dir: Optional[str] = None,
+        *,
+        backend: str = "numpy",
+        max_stripes: int = 65536,
+    ):
+        self.store_dir = store_dir
+        self.backend = backend
+        self.max_stripes = max_stripes
+        self._lock = threading.Lock()
+        self._stripes: dict[str, _Stripe] = {}
+        self._codecs: dict[tuple[int, int, str], ReedSolomon] = {}
+        self._codec_lock = threading.Lock()
+        self.shard_bytes = 0
+        # The repair engine registers itself so note_shard can classify
+        # newly fillable stripes and surface remote interest; weakref so
+        # a dropped engine cannot pin the store (or vice versa).
+        self._engine = lambda: None
+        self._metrics = _StoreMetrics()
+        _StoreMetrics._instances.add(self)
+        if store_dir:
+            os.makedirs(store_dir, exist_ok=True)
+            self.load()
+
+    # ------------------------------------------------------------- codecs
+
+    def codec(self, k: int, n: int, field: str = "gf256") -> ReedSolomon:
+        ckey = (k, n, field)
+        with self._codec_lock:
+            rs = self._codecs.get(ckey)
+            if rs is not None:
+                return rs
+        rs = ReedSolomon(k, n - k, field=field, backend=self.backend)
+        with self._codec_lock:
+            return self._codecs.setdefault(ckey, rs)
+
+    def bind_engine(self, engine) -> None:
+        self._engine = weakref.ref(engine)
+
+    # ------------------------------------------------------------ writes
+
+    def put_object(
+        self,
+        file_signature: bytes,
+        data: bytes,
+        k: int,
+        n: int,
+        *,
+        field: str = "gf256",
+        sender_address: str = "",
+        sender_public_key: bytes = b"",
+    ) -> str:
+        """Encode a (verified) object into a full trusted stripe; returns
+        the store key. Re-putting the same key replaces the stripe — the
+        put path only ever runs on signature-verified bytes, so the
+        replacement is at worst identical."""
+        if not data:
+            raise ValueError("cannot store an empty object")
+        if not 1 <= k <= n:
+            raise ValueError(f"invalid geometry k={k} n={n}")
+        rs = self.codec(k, n, field)
+        shards = [
+            np.ascontiguousarray(s).view(np.uint8).tobytes()
+            for s in rs.encode(rs.split(data))
+        ]
+        meta = StripeMeta(
+            file_signature=bytes(file_signature),
+            k=k,
+            n=n,
+            shard_len=len(shards[0]),
+            object_len=len(data),
+            field=field,
+            sender_address=sender_address,
+            sender_public_key=bytes(sender_public_key),
+        )
+        stripe = _Stripe(meta=meta, shards=list(shards))
+        with self._lock:
+            if (
+                meta.key not in self._stripes
+                and len(self._stripes) >= self.max_stripes
+            ):
+                raise RuntimeError(
+                    f"stripe store full ({self.max_stripes} stripes)"
+                )
+            self._replace_locked(meta.key, stripe)
+        self._persist_stripe(stripe)
+        return meta.key
+
+    def write_repaired(
+        self, key: str, repaired: dict[int, bytes], *, corrected: bool = False
+    ) -> None:
+        """Install repaired shard bytes as trusted slots (repair engine
+        write-back). ``corrected`` marks overwrites of previously-present
+        shards (corruption fixes) as opposed to hole fills."""
+        with self._lock:
+            stripe = self._stripes.get(key)
+            if stripe is None:
+                raise UnknownStripeError(key)
+            for num, blob in repaired.items():
+                if not 0 <= num < stripe.meta.n:
+                    raise ValueError(f"shard number {num} out of range")
+                if len(blob) != stripe.meta.shard_len:
+                    raise ValueError(
+                        f"repaired shard {num} length {len(blob)} != "
+                        f"{stripe.meta.shard_len}"
+                    )
+                if stripe.shards[num] is None:
+                    self.shard_bytes += len(blob)
+                stripe.shards[num] = bytes(blob)
+                stripe.unverified.discard(num)
+        for num in repaired:
+            self._persist_shard(key, num)
+
+    def mark_trusted(self, key: str, numbers: Iterable[int]) -> None:
+        """Clear the unverified flag (repair engine: whole-stripe
+        validation succeeded for these slots as-is)."""
+        with self._lock:
+            stripe = self._stripes.get(key)
+            if stripe is None:
+                raise UnknownStripeError(key)
+            for num in numbers:
+                stripe.unverified.discard(num)
+        self._persist_meta(key)
+
+    def drop_shard(self, key: str, number: int) -> bool:
+        """Remove one shard (device loss / test fault injection)."""
+        with self._lock:
+            stripe = self._stripes.get(key)
+            if stripe is None or stripe.shards[number] is None:
+                return False
+            self.shard_bytes -= len(stripe.shards[number])
+            stripe.shards[number] = None
+            stripe.unverified.discard(number)
+        if self.store_dir:
+            try:
+                os.unlink(self._shard_path(key, number))
+            except OSError:
+                pass
+        return True
+
+    def corrupt_shard(self, key: str, number: int, mutate: Callable) -> bool:
+        """Apply ``mutate(bytes) -> bytes`` to a stored shard in place —
+        the test hook the scrub story is exercised through (pairs with
+        ``FaultInjector.apply``). Returns False if the shard is absent."""
+        with self._lock:
+            stripe = self._stripes.get(key)
+            if stripe is None or stripe.shards[number] is None:
+                return False
+            old = stripe.shards[number]
+            new = bytes(mutate(old))
+            if len(new) != len(old):
+                raise ValueError("corruption must preserve shard length")
+            stripe.shards[number] = new
+        self._persist_shard(key, number)
+        return True
+
+    def evict(self, key: str) -> bool:
+        with self._lock:
+            stripe = self._stripes.pop(key, None)
+            if stripe is None:
+                return False
+            self.shard_bytes -= sum(
+                len(s) for s in stripe.shards if s is not None
+            )
+        if self.store_dir:
+            self._rmtree_stripe(key)
+        return True
+
+    def _replace_locked(self, key: str, stripe: _Stripe) -> None:
+        old = self._stripes.get(key)
+        if old is not None:
+            self.shard_bytes -= sum(
+                len(s) for s in old.shards if s is not None
+            )
+        self._stripes[key] = stripe
+        self.shard_bytes += sum(
+            len(s) for s in stripe.shards if s is not None
+        )
+
+    # ------------------------------------------------------------- reads
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._stripes)
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return list(self._stripes)
+
+    def meta(self, key: str) -> StripeMeta:
+        with self._lock:
+            stripe = self._stripes.get(key)
+            if stripe is None:
+                raise UnknownStripeError(key)
+            return stripe.meta
+
+    def status(self, key: str) -> dict:
+        """Snapshot of one stripe's health (counts + slot lists)."""
+        with self._lock:
+            stripe = self._stripes.get(key)
+            if stripe is None:
+                raise UnknownStripeError(key)
+            present = stripe.present()
+            trusted = stripe.trusted()
+            return {
+                "k": stripe.meta.k,
+                "n": stripe.meta.n,
+                "present": present,
+                "trusted": trusted,
+                "unverified": sorted(stripe.unverified),
+                "missing": [
+                    i for i in range(stripe.meta.n) if i not in present
+                ],
+            }
+
+    def snapshot(self, key: str) -> tuple[StripeMeta, list, set]:
+        """(meta, shard list copy, unverified copy) under the lock —
+        what the scrubber and repair engine work from."""
+        with self._lock:
+            stripe = self._stripes.get(key)
+            if stripe is None:
+                raise UnknownStripeError(key)
+            return stripe.meta, list(stripe.shards), set(stripe.unverified)
+
+    def read(self, key: str) -> bytes:
+        """Serve the object byte-identically from whatever trusted shards
+        survive (the degraded-read API). With the k data shards present
+        this is a join; with any k-of-n trusted subset the missing data
+        shards are reconstructed on demand through the codec backend.
+        Raises :class:`DegradedReadError` below k trusted shards."""
+        meta, shards, unverified = self.snapshot(key)
+        k = meta.k
+        usable = [
+            s if (s is not None and i not in unverified) else None
+            for i, s in enumerate(shards)
+        ]
+        if all(usable[i] is not None for i in range(k)):
+            blob = b"".join(usable[:k])
+            return blob[: meta.object_len]
+        trusted = [i for i, s in enumerate(usable) if s is not None]
+        if len(trusted) < k:
+            raise DegradedReadError(
+                f"stripe {key} has {len(trusted)} trusted shards, "
+                f"need {k}"
+            )
+        self._metrics.degraded_reads.add(1)
+        rs = self.codec(k, meta.n, meta.field)
+        full = rs.reconstruct_data(usable)
+        return rs.join(full, meta.object_len)
+
+    def classify(self, key: str) -> Optional[str]:
+        """Repair-need classification for one stripe:
+
+        - ``None`` — fully present, all trusted (verify is scrub's job);
+        - ``"missing"`` — >= k trusted, but holes or unverified slots:
+          locally reconstructable from the trusted basis;
+        - ``"restore"`` — < k trusted but >= k present including
+          unverified: needs the error-correcting whole-stripe decode;
+        - ``"fetch"`` — < k present: only peers can help (anti-entropy).
+        """
+        meta, shards, unverified = self.snapshot(key)
+        present = [i for i, s in enumerate(shards) if s is not None]
+        trusted = [i for i in present if i not in unverified]
+        if len(trusted) == meta.n:
+            return None
+        if len(trusted) >= meta.k:
+            return "missing"
+        if len(present) >= meta.k:
+            return "restore"
+        return "fetch"
+
+    # ----------------------------------------------------- wire absorb
+
+    def note_shard(self, msg) -> bool:
+        """Feed one arriving wire shard (a ``host.wire.Shard``) to the
+        store — the plugin calls this for every delivery when a store is
+        wired in. Two jobs:
+
+        - *absorb*: if the shard names a stripe we hold with that slot
+          empty, verify it against >= k trusted shards
+          (reconstruct-and-compare) and fill the hole; below k trusted it
+          is held unverified for the repair engine's whole-stripe
+          validation. This is how anti-entropy responses (and plain
+          re-broadcasts) heal local stripes without a decode.
+        - *interest*: notify the repair engine that a peer is moving
+          shards of a stripe we hold — if we are healthy and the traffic
+          is an anti-entropy request, the engine answers with our shards.
+
+        Returns True iff the shard was *consumed* (absorbed, matched a
+        stored duplicate, or rejected as inconsistent with the verified
+        stripe) — the plugin then skips the pool/decode path: the object
+        is already durable here. Never raises: a store problem must not
+        break plugin delivery.
+        """
+        try:
+            return self._note_shard(msg)
+        except Exception as exc:  # noqa: BLE001 — advisory path only
+            log.warning("store note_shard failed: %s", exc)
+            return False
+
+    def _note_shard(self, msg) -> bool:
+        key = trace_key(msg.file_signature)
+        with self._lock:
+            stripe = self._stripes.get(key)
+            if stripe is None:
+                return False
+            meta = stripe.meta
+            num = int(msg.shard_number)
+            if (
+                bytes(msg.file_signature) != meta.file_signature
+                or int(msg.minimum_needed_shards) != meta.k
+                or int(msg.total_shards) != meta.n
+                or not 0 <= num < meta.n
+                or len(msg.shard_data) != meta.shard_len
+                or getattr(msg, "stream_chunk_count", 0)
+            ):
+                engine = self._engine()
+                if engine is not None:
+                    engine.on_remote_interest(key)
+                return False
+            slot_empty = stripe.shards[num] is None
+            duplicate = (
+                not slot_empty and stripe.shards[num] == bytes(msg.shard_data)
+            )
+            shards = list(stripe.shards)
+            unverified = set(stripe.unverified)
+        engine = self._engine()
+        if not slot_empty:
+            # A shard we already hold: the interest signal anti-entropy
+            # requests ride on. A DIFFERING copy of an occupied slot is
+            # not consumed — the normal pool path keeps its evidence (and
+            # scrub adjudicates our own copy against parity).
+            if engine is not None:
+                engine.on_remote_interest(key)
+            return duplicate
+        blob = bytes(msg.shard_data)
+        trusted = [
+            i for i, s in enumerate(shards)
+            if s is not None and i not in unverified
+        ]
+        if len(trusted) >= meta.k:
+            rs = self.codec(meta.k, meta.n, meta.field)
+            usable = [
+                shards[i] if i in trusted else None for i in range(meta.n)
+            ]
+            want = rs.reconstruct_some(
+                usable, [i == num for i in range(meta.n)]
+            )[num]
+            if np.ascontiguousarray(want).view(np.uint8).tobytes() != blob:
+                # Inconsistent with the verified stripe: drop it here —
+                # the stripe already vouches for the object, so the bad
+                # copy must not reach the pool either.
+                self._metrics.absorb_rejected.add(1)
+                return True
+            accepted_unverified = False
+        else:
+            accepted_unverified = True
+        with self._lock:
+            stripe = self._stripes.get(key)
+            if (
+                stripe is None
+                or stripe.meta is not meta
+                or stripe.shards[num] is not None
+            ):
+                return False
+            stripe.shards[num] = blob
+            if accepted_unverified:
+                stripe.unverified.add(num)
+            self.shard_bytes += len(blob)
+        self._metrics.absorbed.add(1)
+        self._persist_shard(key, num)
+        if engine is not None:
+            engine.enqueue_auto(key)
+        return True
+
+    # ------------------------------------------------------- persistence
+
+    def _stripe_dir(self, key: str) -> str:
+        return os.path.join(self.store_dir, key)
+
+    def _shard_path(self, key: str, num: int) -> str:
+        return os.path.join(self._stripe_dir(key), f"shard.{num:03d}")
+
+    @staticmethod
+    def _atomic_write(path: str, blob: bytes) -> None:
+        tmp = path + ".part"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+
+    def _persist_stripe(self, stripe: _Stripe) -> None:
+        if not self.store_dir:
+            return
+        key = stripe.meta.key
+        os.makedirs(self._stripe_dir(key), exist_ok=True)
+        self._persist_meta(key)
+        with self._lock:
+            live = self._stripes.get(key)
+            shards = list(live.shards) if live is not None else []
+        for num, blob in enumerate(shards):
+            if blob is not None:
+                self._atomic_write(self._shard_path(key, num), blob)
+
+    def _persist_meta(self, key: str) -> None:
+        if not self.store_dir:
+            return
+        with self._lock:
+            stripe = self._stripes.get(key)
+            if stripe is None:
+                return
+            m = stripe.meta
+            doc = {
+                "file_signature": m.file_signature.hex(),
+                "k": m.k,
+                "n": m.n,
+                "shard_len": m.shard_len,
+                "object_len": m.object_len,
+                "field": m.field,
+                "sender_address": m.sender_address,
+                "sender_public_key": m.sender_public_key.hex(),
+                "unverified": sorted(stripe.unverified),
+            }
+        os.makedirs(self._stripe_dir(key), exist_ok=True)
+        self._atomic_write(
+            os.path.join(self._stripe_dir(key), "meta.json"),
+            json.dumps(doc).encode(),
+        )
+
+    def _persist_shard(self, key: str, num: int) -> None:
+        if not self.store_dir:
+            return
+        with self._lock:
+            stripe = self._stripes.get(key)
+            blob = stripe.shards[num] if stripe is not None else None
+        if blob is not None:
+            os.makedirs(self._stripe_dir(key), exist_ok=True)
+            self._atomic_write(self._shard_path(key, num), blob)
+        self._persist_meta(key)
+
+    def _rmtree_stripe(self, key: str) -> None:
+        d = self._stripe_dir(key)
+        try:
+            for name in os.listdir(d):
+                os.unlink(os.path.join(d, name))
+            os.rmdir(d)
+        except OSError:
+            pass
+
+    def load(self) -> int:
+        """Restore stripes from ``store_dir``; returns the stripe count.
+        A shard file whose length disagrees with the metadata is treated
+        as missing (the scrubber will flag and repair it)."""
+        if not self.store_dir:
+            return 0
+        loaded = 0
+        for key in sorted(os.listdir(self.store_dir)):
+            meta_path = os.path.join(self.store_dir, key, "meta.json")
+            if not os.path.isfile(meta_path):
+                continue
+            try:
+                with open(meta_path, "rb") as f:
+                    doc = json.load(f)
+                meta = StripeMeta(
+                    file_signature=bytes.fromhex(doc["file_signature"]),
+                    k=int(doc["k"]),
+                    n=int(doc["n"]),
+                    shard_len=int(doc["shard_len"]),
+                    object_len=int(doc["object_len"]),
+                    field=doc.get("field", "gf256"),
+                    sender_address=doc.get("sender_address", ""),
+                    sender_public_key=bytes.fromhex(
+                        doc.get("sender_public_key", "")
+                    ),
+                )
+            except (ValueError, KeyError, json.JSONDecodeError) as exc:
+                log.warning("skipping unreadable stripe %s: %s", key, exc)
+                continue
+            if meta.key != key or not 1 <= meta.k <= meta.n:
+                log.warning("skipping inconsistent stripe dir %s", key)
+                continue
+            shards: list[Optional[bytes]] = [None] * meta.n
+            for num in range(meta.n):
+                try:
+                    with open(self._shard_path(key, num), "rb") as f:
+                        blob = f.read()
+                except OSError:
+                    continue
+                if len(blob) == meta.shard_len:
+                    shards[num] = blob
+            stripe = _Stripe(
+                meta=meta,
+                shards=shards,
+                unverified={
+                    int(i) for i in doc.get("unverified", [])
+                    if 0 <= int(i) < meta.n
+                },
+            )
+            with self._lock:
+                self._replace_locked(key, stripe)
+            loaded += 1
+        return loaded
+
+    def close(self) -> None:
+        """Flush nothing (writes are synchronous); kept for symmetry with
+        the scrubber/engine lifecycle in cli.py."""

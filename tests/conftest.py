@@ -24,6 +24,14 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running soak tests excluded from tier-1 "
+        "(-m 'not slow')",
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0x5EED)

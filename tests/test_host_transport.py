@@ -604,11 +604,57 @@ def test_tcp_discovery_regossip_heals_partition():
         assert c.keys.public_key in a.peers and a.keys.public_key in c.peers, (
             a.errors, b.errors, c.errors
         )
+        # Registration is idempotent: however many gossip ticks and
+        # mutual dials the heal took, each node holds exactly one entry
+        # per peer identity.
+        assert len(a.peers) == 2 and len(b.peers) == 2 and len(c.peers) == 2
         a.plugins[0].shard_and_broadcast(a, b"healed reach!!!!")
         deadline = time.time() + 10
         while time.time() < deadline and not inboxes[2]:
             time.sleep(0.02)
         assert inboxes[2] == [b"healed reach!!!!"], (a.errors, b.errors, c.errors)
+    finally:
+        for net in nets:
+            net.close()
+
+
+def test_tcp_dial_and_registration_idempotent():
+    """Repeat bootstraps to a live peer are no-ops (no connection churn,
+    no duplicate peer entries) and a failed bootstrap dial refunds the
+    discovery dedup slot so gossip can retry the address later."""
+    nets = []
+    try:
+        a = TCPNetwork(host="127.0.0.1", port=0)
+        b = TCPNetwork(host="127.0.0.1", port=0)
+        for net in (a, b):
+            net.add_plugin(ShardPlugin(backend="numpy"))
+            net.listen()
+            nets.append(net)
+        for _ in range(3):
+            a.bootstrap([b.id.address])
+        deadline = time.time() + 10
+        while time.time() < deadline and (not a.peers or not b.peers):
+            time.sleep(0.02)
+        assert len(a.peers) == 1 and len(b.peers) == 1, (a.errors, b.errors)
+        # The repeat dials short-circuited on the registered address: no
+        # mutual-dial teardown errors recorded on either side.
+        churn = [
+            e for e in list(a.errors) + list(b.errors)
+            if "disconnected" in repr(e)
+        ]
+        assert churn == []
+
+        # A dial to a dead address fails AND refunds its _dialing slot —
+        # otherwise discovery could never retry it (the lost-introduction
+        # partition the re-gossip heal exists for).
+        import socket as _socket
+
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead = f"tcp://127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        a.bootstrap([dead])
+        assert dead not in a._dialing
     finally:
         for net in nets:
             net.close()

@@ -14,7 +14,16 @@ and cross-checks every referenced name against the declarative registry:
   entries rot the docs);
 - **suffix collision**: a histogram's generated series
   (``_bucket``/``_sum``/``_count``) or a name pair differing only by
-  the ``_total`` convention colliding with another declared name.
+  the ``_total`` convention colliding with another declared name;
+- **naming convention**: counters must end in ``_total``; gauges and
+  histograms must not (Prometheus convention — the store metric family
+  and everything after it is held to it);
+- **unbounded span stages**: every ``span("name")`` literal in the
+  source must appear in ``obs.registry.PIPELINE_STAGES`` — span names
+  become ``stage`` label values on ``noise_ec_stage_seconds`` /
+  ``noise_ec_spans_total``, and the label set stays bounded only if the
+  tuple is the single source of truth (the scrub/repair spans joined it
+  this way).
 
 Run directly (``python tools/check_metrics.py``; exit 1 on problems) or
 through the tier-1 test that wraps it (tests/test_obs.py).
@@ -34,6 +43,7 @@ if str(REPO) not in sys.path:  # direct `python tools/check_metrics.py` runs
 _CALL = re.compile(
     r"\.(counter|gauge|histogram)\(\s*[\"']([A-Za-z0-9_:]+)[\"']"
 )
+_SPAN = re.compile(r"(?<![\w.])span\(\s*[\"']([A-Za-z0-9_]+)[\"']")
 
 
 def scan_source() -> dict[str, set[str]]:
@@ -43,6 +53,18 @@ def scan_source() -> dict[str, set[str]]:
         text = path.read_text(encoding="utf-8")
         for mtype, name in _CALL.findall(text):
             used.setdefault(name, set()).add(mtype)
+    return used
+
+
+def scan_spans() -> dict[str, set[str]]:
+    """span stage name -> set of files using it across the package."""
+    used: dict[str, set[str]] = {}
+    for path in sorted(PKG.rglob("*.py")):
+        text = path.read_text(encoding="utf-8")
+        for name in _SPAN.findall(text):
+            used.setdefault(name, set()).add(
+                str(path.relative_to(REPO))
+            )
     return used
 
 
@@ -87,6 +109,27 @@ def check() -> list[str]:
                     f"histogram {name!r} generates {g!r}, which is also "
                     "declared as its own metric"
                 )
+    # Naming convention: counters carry _total, nothing else does.
+    for name, (mtype, _, _) in METRICS.items():
+        if mtype == "counter" and not name.endswith("_total"):
+            problems.append(
+                f"counter {name!r} must end in '_total' (Prometheus "
+                "convention)"
+            )
+        if mtype != "counter" and name.endswith("_total"):
+            problems.append(
+                f"{mtype} {name!r} must not end in '_total'"
+            )
+    # Span stages must come from the bounded PIPELINE_STAGES tuple: span
+    # names turn into 'stage' label values on the tracer's families.
+    from noise_ec_tpu.obs.registry import PIPELINE_STAGES
+
+    for stage, files in sorted(scan_spans().items()):
+        if stage not in PIPELINE_STAGES:
+            problems.append(
+                f"span stage {stage!r} (used in {sorted(files)}) is not "
+                "declared in obs.registry.PIPELINE_STAGES"
+            )
     return problems
 
 
