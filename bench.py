@@ -245,6 +245,19 @@ def main() -> None:
         payload = payloads[0]
         stats["host_node_roundtrip_msgs_per_s"] = round(1.0 / t_host, 1)
         stats["host_node_roundtrip_mb_per_s"] = round(len(payload) / t_host / 1e6, 1)
+        # Tail latency from the receive path's own e2e histogram
+        # (noise_ec_e2e_latency_seconds{outcome="ok"}): the loopback
+        # deliveries above are this process's only ok-outcome events, so
+        # the p99 here is the round trip's tail, not just its mean.
+        from noise_ec_tpu.obs.registry import default_registry
+
+        e2e_hist = default_registry().histogram(
+            "noise_ec_e2e_latency_seconds"
+        ).labels(outcome="ok")
+        if e2e_hist.count:
+            stats["host_node_roundtrip_p99_ms"] = round(
+                e2e_hist.p99 * 1e3, 3
+            )
 
         # --- large-object streaming: one 64 MiB object node-to-node as
         # 4 MiB erasure-coded chunks (sign once -> chunked encode ->

@@ -24,11 +24,27 @@ import sys
 from noise_ec_tpu.host.crypto import KeyPair, PeerID
 from noise_ec_tpu.host.plugin import ShardPlugin
 from noise_ec_tpu.host.transport import TCPNetwork
+from noise_ec_tpu.obs.health import default_slo
 from noise_ec_tpu.obs.profiling import device_trace, kernel_counters
+from noise_ec_tpu.obs.registry import set_build_info
 from noise_ec_tpu.obs.server import PeriodicReporter, StatsServer
+from noise_ec_tpu.obs.trace import default_tracer
 from noise_ec_tpu.utils.logging import setup_logging
 
 log = logging.getLogger("noise_ec_tpu.host.cli")
+
+
+def _kernel_label(backend: str) -> str:
+    """The kernel tier actually serving this node, for the
+    noise_ec_build_info deployment-identity gauge."""
+    if backend != "device":
+        return "numpy"
+    try:
+        import jax
+
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    except Exception:  # noqa: BLE001 — identity gauge must not kill startup
+        return "unknown"
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -104,6 +120,30 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="SECONDS",
         help="log a stats snapshot every SECONDS while running "
         "(0 disables; stats always log once at shutdown)",
+    )
+    p.add_argument(
+        "-trace-peers",
+        default="",
+        metavar="URLS",
+        help="comma-separated peer metrics endpoints "
+        "(http://host:port) whose /spans this node pulls and merges "
+        "into distributed traces (docs/observability.md)",
+    )
+    p.add_argument(
+        "-collect-traces",
+        default="",
+        metavar="PATH",
+        help="write the merged local+peer spans as Chrome "
+        "trace-event JSON to PATH at shutdown (open in Perfetto or "
+        "chrome://tracing); implies periodic collection while running",
+    )
+    p.add_argument(
+        "-collect-interval",
+        type=float,
+        default=10.0,
+        metavar="SECONDS",
+        help="poll interval for -trace-peers span collection "
+        "(default 10)",
     )
     return p
 
@@ -183,6 +223,12 @@ def main(argv: list[str] | None = None) -> int:
     net.listen()  # background accept loop (go net.Listen(), main.go:169)
     log.info("listening for peers on %s", net.id.address)
 
+    # Node identity for distributed tracing: every span dump this node
+    # serves is stamped with the transport address + pubkey prefix, so a
+    # collector can merge it with other nodes' dumps unambiguously.
+    default_tracer().set_node(net.id.address, keys.public_key)
+    set_build_info(backend=args.backend, kernel=_kernel_label(args.backend))
+
     def stats_snapshot() -> dict:
         stats = plugin.counters.snapshot()
         stats.update(kernel_counters.snapshot())
@@ -196,10 +242,28 @@ def main(argv: list[str] | None = None) -> int:
                 "noise_ec_plugin": plugin.counters,
                 "noise_ec_kernel": kernel_counters,
             },
+            # /healthz answers 503 with the verdict JSON once the
+            # receive path burns the rolling SLO window (obs/health.py)
+            # — orchestrators can restart/deweight on it.
+            slo=default_slo(),
         )
         log.info("metrics endpoint on %s/metrics", stats_server.url)
     if args.stats_interval > 0:
         reporter = PeriodicReporter(args.stats_interval, stats_snapshot, log)
+
+    collector = None
+    trace_peers = [u for u in args.trace_peers.split(",") if u]
+    if trace_peers or args.collect_traces:
+        from noise_ec_tpu.obs.collector import TraceCollector
+
+        # handshake_rtts is passed as the bound method: hints re-read
+        # every poll, so peers dialed later still tighten clock sync.
+        collector = TraceCollector(trace_peers, rtt_hints=net.handshake_rtts)
+        collector.start(interval=max(args.collect_interval, 1.0))
+        log.info(
+            "collecting distributed traces from %d peer endpoint(s)",
+            len(trace_peers),
+        )
 
     peers = [a for a in args.peers.split(",") if a]
     if peers:
@@ -242,6 +306,23 @@ def main(argv: list[str] | None = None) -> int:
             engine.close()
         if reporter is not None:
             reporter.close()
+        if collector is not None:
+            collector.close()
+            try:
+                collector.poll()  # final sweep before the transport dies
+                if args.collect_traces:
+                    from noise_ec_tpu.obs.perfetto import write_chrome_trace
+
+                    spans = collector.merged_spans()
+                    doc = write_chrome_trace(args.collect_traces, spans)
+                    log.info(
+                        "wrote %d spans from %d node(s) to %s "
+                        "(open in Perfetto / chrome://tracing)",
+                        len(spans), len(doc["otherData"]["nodes"]),
+                        args.collect_traces,
+                    )
+            except Exception as exc:  # noqa: BLE001 — telemetry teardown
+                log.error("trace export failed: %s", exc)
         if stats_server is not None:
             stats_server.close()
         net.close()

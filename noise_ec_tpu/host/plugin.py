@@ -36,6 +36,7 @@ from noise_ec_tpu.host.crypto import (
 )
 from noise_ec_tpu.host.mempool import PoolLimitError, PoolTooLargeError, ShardPool
 from noise_ec_tpu.host.wire import Shard
+from noise_ec_tpu.obs.health import SLOEvaluator, record_e2e
 from noise_ec_tpu.obs.metrics import Counters, Timer
 from noise_ec_tpu.obs.registry import default_registry
 from noise_ec_tpu.obs.trace import span, trace_key
@@ -118,6 +119,7 @@ class ShardPlugin:
         pool_max_total_bytes: int = ShardPool.DEFAULT_MAX_TOTAL_BYTES,
         adjust_geometry: bool = True,
         store=None,
+        slo: Optional[SLOEvaluator] = None,
     ):
         self.signature_policy = signature_policy or Ed25519Policy()
         self.hash_policy = hash_policy or Blake2bPolicy()
@@ -149,6 +151,11 @@ class ShardPlugin:
             max_total_bytes=pool_max_total_bytes,
         )
         self.counters = Counters()
+        # End-to-end outcome events (obs/health.py): every completed or
+        # failed object records into noise_ec_e2e_latency_seconds and an
+        # SLO evaluator. None routes to the process default (the one the
+        # CLI wires to /healthz); tests pass their own.
+        self.slo = slo
         # Decode-path histograms (p50/p99 surfaces — the flat decode_s
         # sum stays for back-compat but cannot answer tail questions).
         # Children resolved once; observe is a lock + bisect + adds.
@@ -1013,7 +1020,11 @@ class ShardPlugin:
             log.error("stream chunk %d decode failed for %s…: %s",
                       index, key[:16], exc)
             if distinct >= n:
+                with self._streams_lock:
+                    st = self._streams.get(key)
+                    started = st["created"] if st is not None else None
                 self._drop_stream(key)
+                self._record_outcome("corrupt", started)
                 raise CorruptionError(
                     f"all {n} shards of stream chunk {index} arrived for "
                     f"{key[:16]}… but decode fails: {exc}"
@@ -1073,6 +1084,9 @@ class ShardPlugin:
         as bytes only on delivery); None on failure (caller decides
         repair/unrecoverability)."""
         sender = ctx.sender()
+        with self._streams_lock:
+            st0 = self._streams.get(key)
+            started = st0["created"] if st0 is not None else None
         with span("verify", key=trace_key(msg.file_signature),
                   nbytes=len(complete)):
             ok = verify_parts(
@@ -1090,10 +1104,12 @@ class ShardPlugin:
                 st = self._streams.get(key)
                 if st is not None:
                     st["failed"] = True
+            self._record_outcome("verify_failed", started)
             return None
         if not self._mark_completed(key):
             self.counters.add("late_shards", 1)
             return None
+        self._record_outcome("ok", started)
         # Store BEFORE delivery: the on_object path below transfers
         # ownership of the reassembly buffer to the callee.
         self._store_put(
@@ -1176,7 +1192,11 @@ class ShardPlugin:
                 self.counters.add("stream_repairs", 1)
                 return delivered
         if self._stream_has_all_shards(key, count, n):
+            with self._streams_lock:
+                st = self._streams.get(key)
+                started = st["created"] if st is not None else None
             self._drop_stream(key)
+            self._record_outcome("corrupt", started)
             raise CorruptionError(
                 f"stream object {key[:16]}… has all {n} shards of all "
                 f"{count} chunks but the signature does not verify"
@@ -1236,6 +1256,20 @@ class ShardPlugin:
                         file_signature[:8].hex(), exc)
 
     # -------------------------------------------------------- receive path
+
+    def _record_outcome(self, outcome: str, started) -> None:
+        """One e2e outcome event (obs/health.py): latency measured from
+        the object's first-seen time (pool/stream creation) when known,
+        0.0 otherwise (the outcome still burns or feeds the SLO)."""
+        seconds = (
+            max(0.0, time.monotonic() - started) if started is not None
+            else 0.0
+        )
+        record_e2e(outcome, seconds, slo=self.slo)
+
+    def _pool_started(self, key: str):
+        entry = self.pool.get(key)
+        return entry.created_at if entry is not None else None
 
     def receive(self, ctx: PluginContext) -> Optional[bytes]:
         """Shard-reassembly state machine (main.go:52-107).
@@ -1326,7 +1360,9 @@ class ShardPlugin:
             self.counters.add("decode_errors", 1)
             log.error("decode failed for %s…: %s", key[:16], exc)
             if distinct >= n:
+                started = self._pool_started(key)
                 self.pool.evict(key)
+                self._record_outcome("corrupt", started)
                 raise CorruptionError(
                     f"all {n} shards arrived for {key[:16]}… but decode "
                     f"fails: {exc}"
@@ -1346,6 +1382,7 @@ class ShardPlugin:
                 msg.file_signature,
             )
         if ok:
+            started = self._pool_started(key)
             self.pool.evict(key)  # main.go:90-93
             if not self._mark_completed(key):
                 # A concurrent receive() already delivered this object
@@ -1353,6 +1390,7 @@ class ShardPlugin:
                 self.counters.add("late_shards", 1)
                 return None
             self.counters.add("verified", 1)
+            self._record_outcome("ok", started)
             self._store_put(ctx, msg, k, n, complete, sender)
             log.info("completed message %s… (%d bytes)", complete[:32].hex(), len(complete))
             if self.on_message is not None:
@@ -1361,13 +1399,16 @@ class ShardPlugin:
 
         self.counters.add("verify_failures", 1)
         log.warning("signature verify failed for %s…", key[:16])
+        started = self._pool_started(key)
         if distinct >= n:
             # Every shard arrived and the object still fails verification:
             # unrecoverable (main.go:96-98 made reachable — see
             # CorruptionError docstring).
             self.pool.evict(key)
+            self._record_outcome("corrupt", started)
             raise CorruptionError(
                 f"all {n} shards arrived for {key[:16]}… but the signature "
                 "does not verify"
             )
+        self._record_outcome("verify_failed", started)
         return None

@@ -600,6 +600,13 @@ class TCPNetwork:
         # claimed address every interval forever, flooding self.errors.
         self._dial_backoff: dict[str, tuple[float, float]] = {}
         self._gossip_task: Optional[asyncio.Task] = None
+        # Handshake timing: dialed address -> seconds between sending
+        # HELLO and the peer registering (≈ one network round trip plus
+        # two Ed25519 verifies). The distributed-trace collector uses it
+        # to tighten per-peer clock-offset uncertainty (obs/collector.py)
+        # — the TCP-level handshake is a truer delay floor than an HTTP
+        # poll of /spans.
+        self._handshake_rtt: dict[str, float] = {}
 
     # ------------------------------------------------------------ lifecycle
 
@@ -693,6 +700,11 @@ class TCPNetwork:
     def _record_error(self, exc: Exception) -> None:
         self.errors.append(exc)
         self.error_count += 1
+
+    def handshake_rtts(self) -> dict[str, float]:
+        """HELLO round-trip seconds per dialed peer address (the
+        clock-sync hint consumed by ``obs.collector.TraceCollector``)."""
+        return dict(self._handshake_rtt)
 
     # --------------------------------------------------------------- wire
 
@@ -951,6 +963,7 @@ class TCPNetwork:
             raise
         conn = _Conn(is_dialer=True)
         try:
+            t_hello = time.perf_counter()
             writer.write(self._frame(_OP_HELLO, conn.nonce))
             task = asyncio.create_task(self._read_loop(reader, writer, conn))
             self._tasks.add(task)
@@ -961,6 +974,7 @@ class TCPNetwork:
             await asyncio.wait_for(
                 conn.registered.wait(), timeout=self.connection_timeout
             )
+            self._handshake_rtt[address] = time.perf_counter() - t_hello
         except Exception:
             self._dialing.discard(address)
             self._drop_writer(writer)

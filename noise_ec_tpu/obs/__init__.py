@@ -17,20 +17,30 @@ has to come from structured telemetry, not log archaeology:
   trace hook (absorbs ``utils.profiling``);
 - :mod:`obs.export` — Prometheus text-format exposition;
 - :mod:`obs.server` — the optional stdlib-``http.server`` stats
-  endpoint and the periodic reporter thread the CLI flags drive.
+  endpoint and the periodic reporter thread the CLI flags drive;
+- :mod:`obs.collector` — distributed trace collection: pull peer
+  ``/spans`` dumps, align clocks, merge spans into fleet-wide traces;
+- :mod:`obs.perfetto` — Chrome trace-event (Perfetto) export of merged
+  traces;
+- :mod:`obs.health` — end-to-end outcome recording and the rolling SLO
+  evaluator whose verdict drives ``/healthz``.
 
 ``utils.metrics`` / ``utils.profiling`` remain as compatible re-export
 shims, so existing imports keep working.
 """
 
+from noise_ec_tpu.obs.collector import TraceCollector
+from noise_ec_tpu.obs.health import SLOEvaluator, default_slo, record_e2e
 from noise_ec_tpu.obs.metrics import Counters, Histogram, Timer
+from noise_ec_tpu.obs.perfetto import to_chrome_trace, write_chrome_trace
 from noise_ec_tpu.obs.registry import (
     METRICS,
     PIPELINE_STAGES,
     Registry,
     default_registry,
+    set_build_info,
 )
-from noise_ec_tpu.obs.trace import Tracer, default_tracer, span
+from noise_ec_tpu.obs.trace import Tracer, default_tracer, node_attrs, span
 
 __all__ = [
     "Counters",
@@ -38,9 +48,17 @@ __all__ = [
     "METRICS",
     "PIPELINE_STAGES",
     "Registry",
+    "SLOEvaluator",
     "Timer",
+    "TraceCollector",
     "Tracer",
     "default_registry",
+    "default_slo",
     "default_tracer",
+    "node_attrs",
+    "record_e2e",
+    "set_build_info",
     "span",
+    "to_chrome_trace",
+    "write_chrome_trace",
 ]

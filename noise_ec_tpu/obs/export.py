@@ -53,9 +53,15 @@ def _labels_str(names: tuple[str, ...], values: tuple[str, ...],
 
 
 def _render_family(fam, out: list[str]) -> None:
+    children = sorted(fam.children())
+    if not children:
+        # Empty-family suppression: a family touched but never labeled
+        # has no samples; bare HELP/TYPE lines would make scrapers
+        # ingest a sampleless family forever.
+        return
     out.append(f"# HELP {fam.name} {fam.help}")
     out.append(f"# TYPE {fam.name} {fam.type}")
-    for values, child in sorted(fam.children()):
+    for values, child in children:
         lbl = _labels_str(fam.label_names, values)
         if fam.type == "counter":
             out.append(f"{fam.name}{lbl} {_fmt(child.value)}")

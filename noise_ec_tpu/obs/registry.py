@@ -36,6 +36,7 @@ __all__ = [
     "PIPELINE_STAGES",
     "Registry",
     "default_registry",
+    "set_build_info",
 ]
 
 # The span/stage model (docs/observability.md): every pipeline stage a
@@ -62,6 +63,19 @@ PIPELINE_STAGES: tuple[str, ...] = (
 # exported series; obs/export.py renders HELP/TYPE from it and
 # tools/check_metrics.py cross-checks source literals against it.
 METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
+    "noise_ec_build_info": (
+        "gauge",
+        "Deployment identity (value is always 1), labeled by codec "
+        "backend, kernel and package version — the pivot for dashboards "
+        "comparing rollouts",
+        ("backend", "kernel", "version"),
+    ),
+    "noise_ec_e2e_latency_seconds": (
+        "histogram",
+        "End-to-end receive-path latency (first shard seen to object "
+        "completion), labeled by outcome (ok, verify_failed, corrupt)",
+        ("outcome",),
+    ),
     "noise_ec_stage_seconds": (
         "histogram",
         "Pipeline stage latency (span durations), labeled by stage",
@@ -390,3 +404,19 @@ _default = Registry()
 def default_registry() -> Registry:
     """The process-wide registry the instrumented layers record into."""
     return _default
+
+
+def set_build_info(backend: str, kernel: str,
+                   version: Optional[str] = None,
+                   registry: Optional[Registry] = None) -> None:
+    """Publish the ``noise_ec_build_info`` identity gauge (value 1).
+
+    Scrapes pivot dashboards on it (``noise_ec_build_info * on()
+    group_left(version) ...``); call once at node startup with the codec
+    backend and kernel actually in use."""
+    if version is None:
+        from noise_ec_tpu import __version__ as version
+    reg = registry if registry is not None else default_registry()
+    reg.gauge("noise_ec_build_info").labels(
+        backend=backend, kernel=kernel, version=version
+    ).set(1)

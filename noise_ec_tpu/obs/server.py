@@ -4,9 +4,14 @@
 
 - ``GET /metrics`` — Prometheus text exposition (obs/export.py): the
   registry's labeled families plus any attached plain counter bags;
-- ``GET /spans`` — JSON dump of the tracer ring buffer (optionally
-  ``?trace=<id>`` / ``?limit=<n>``);
-- ``GET /healthz`` — liveness.
+- ``GET /spans`` — the tracer ring buffer as a JSON *dump document*
+  ``{"node", "clock", "next_since", "spans"}`` (``?trace=<id>`` /
+  ``?limit=<n>`` newest-N / ``?since=<seq>`` cursor) — the unit the
+  distributed-trace collector (obs/collector.py) pulls and merges;
+- ``GET /healthz`` — SLO-aware health: 200 ``ok`` while the wired
+  :class:`~noise_ec_tpu.obs.health.SLOEvaluator` (if any) judges the
+  rolling window healthy, 503 with the JSON verdict once the error
+  budget is burned. With no evaluator wired it is plain liveness.
 
 ``PeriodicReporter`` logs a structured stats snapshot every N seconds so
 a node without a scraper still surfaces its counters during the run, not
@@ -24,11 +29,16 @@ from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
 from noise_ec_tpu.obs.export import render_prometheus
+from noise_ec_tpu.obs.health import SLOEvaluator
 from noise_ec_tpu.obs.metrics import Counters
 from noise_ec_tpu.obs.registry import Registry
-from noise_ec_tpu.obs.trace import Tracer, default_tracer
+from noise_ec_tpu.obs.trace import Tracer, clock_anchor, default_tracer
 
-__all__ = ["PeriodicReporter", "StatsServer"]
+__all__ = ["PeriodicReporter", "SPANS_DOC_FIELDS", "StatsServer"]
+
+# Top-level keys of the /spans dump document; tools/check_metrics.py
+# lints that docs/observability.md documents each one.
+SPANS_DOC_FIELDS: tuple[str, ...] = ("node", "clock", "next_since", "spans")
 
 log = logging.getLogger("noise_ec_tpu.obs")
 
@@ -41,6 +51,8 @@ class StatsServer:
     ``port=0`` binds an ephemeral port (tests); the bound port is
     ``self.port`` after construction. ``extra_counters`` maps exposition
     prefixes to plain :class:`Counters` bags (see obs/export.py).
+    ``slo`` wires a :class:`SLOEvaluator` verdict into ``/healthz``
+    (None keeps the plain always-200 liveness probe).
     """
 
     def __init__(
@@ -51,10 +63,12 @@ class StatsServer:
         registry: Optional[Registry] = None,
         tracer: Optional[Tracer] = None,
         extra_counters: Optional[dict[str, Counters]] = None,
+        slo: Optional[SLOEvaluator] = None,
     ):
         self.registry = registry
         self.tracer = tracer if tracer is not None else default_tracer()
         self.extra_counters = dict(extra_counters or {})
+        self.slo = slo
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -67,21 +81,41 @@ class StatsServer:
                     self._reply(200, _PROM_CONTENT_TYPE, body)
                 elif url.path == "/spans":
                     q = parse_qs(url.query)
-                    limit = None
-                    if "limit" in q:
-                        try:
+                    limit = since = None
+                    try:
+                        if "limit" in q:
                             limit = int(q["limit"][0])
-                        except ValueError:
-                            self._reply(400, "text/plain", b"bad limit\n")
-                            return
+                        if "since" in q:
+                            since = int(q["since"][0])
+                    except ValueError:
+                        self._reply(400, "text/plain", b"bad cursor\n")
+                        return
                     trace = q.get("trace", [None])[0]
-                    body = json.dumps(
-                        outer.tracer.dump(trace_id=trace, limit=limit),
-                        indent=1,
-                    ).encode()
+                    # next_since is read BEFORE the dump: a span landing
+                    # between the two reads is then re-sent next poll
+                    # rather than skipped forever.
+                    doc = {
+                        "node": outer.tracer.node or {},
+                        "clock": clock_anchor(),
+                        "next_since": outer.tracer.last_seq(),
+                        "spans": outer.tracer.dump(
+                            trace_id=trace, limit=limit, since=since
+                        ),
+                    }
+                    body = json.dumps(doc, indent=1).encode()
                     self._reply(200, "application/json", body)
                 elif url.path == "/healthz":
-                    self._reply(200, "text/plain", b"ok\n")
+                    if outer.slo is None:
+                        self._reply(200, "text/plain", b"ok\n")
+                        return
+                    verdict = outer.slo.verdict()
+                    if verdict["healthy"]:
+                        self._reply(200, "text/plain", b"ok\n")
+                    else:
+                        self._reply(
+                            503, "application/json",
+                            json.dumps(verdict, indent=1).encode(),
+                        )
                 else:
                     self._reply(404, "text/plain", b"not found\n")
 

@@ -19,6 +19,15 @@ the ``noise_ec_stage_seconds`` histogram + ``noise_ec_spans_total``
 counter in the default registry, so the dump API serves forensics while
 the export surface serves percentiles.
 
+Cross-node mergeability (docs/observability.md "Distributed tracing"):
+every finished span carries a monotonically increasing ``seq`` (the
+``?since=`` cursor on ``/spans``), the tracer carries an optional *node
+identity* (transport address + pubkey prefix, :meth:`Tracer.set_node`),
+and :func:`clock_anchor` publishes the process's monotonic→wall-clock
+anchor — together enough for ``obs/collector.py`` to pull dumps from
+many processes, align their clocks and join spans sharing a signature
+prefix into one distributed trace.
+
 Overhead per span: two clock reads, one deque append under a lock, one
 histogram observe — per *message stage*, not per kernel call, so the
 encode hot loop (``record_kernel``) keeps its two counter adds.
@@ -26,15 +35,31 @@ encode hot loop (``record_kernel``) keeps its two counter adds.
 
 from __future__ import annotations
 
-import itertools
 import threading
 import time
 from collections import deque
-from typing import Iterator, Optional
+from typing import Optional
 
 from noise_ec_tpu.obs.registry import Registry, default_registry
 
-__all__ = ["Span", "Tracer", "default_tracer", "span", "trace_key"]
+__all__ = [
+    "SPAN_FIELDS",
+    "Span",
+    "Tracer",
+    "clock_anchor",
+    "default_tracer",
+    "node_attrs",
+    "span",
+    "trace_key",
+]
+
+# Every key a span dict (Span.as_dict / Tracer.dump / GET /spans) may
+# carry. tools/check_metrics.py lints that docs/observability.md
+# documents each one, so the schema cannot drift silently.
+SPAN_FIELDS: tuple[str, ...] = (
+    "seq", "trace_id", "name", "start", "seconds", "parent", "attrs",
+    "error",
+)
 
 
 def trace_key(file_signature: bytes) -> str:
@@ -50,6 +75,14 @@ _WALL0 = time.time()
 _PERF0 = time.perf_counter()
 
 
+def clock_anchor() -> dict:
+    """The process's monotonic→wall-clock anchor plus a fresh wall-clock
+    reading. ``/spans`` publishes this so a collector can estimate the
+    peer clock offset from the request RTT midpoint (``now`` is the
+    server's wall clock at render time)."""
+    return {"wall": _WALL0, "perf": _PERF0, "now": time.time()}
+
+
 class Span:
     """One live (then finished) stage timing. Mutable until exit.
 
@@ -59,7 +92,7 @@ class Span:
 
     __slots__ = (
         "name", "key", "attrs", "parent", "start", "end",
-        "trace_id", "error", "_tracer",
+        "trace_id", "error", "seq", "_tracer",
     )
 
     def __init__(self, tracer: "Tracer", name: str, key: Optional[str],
@@ -73,6 +106,7 @@ class Span:
         self.end = 0.0
         self.trace_id: Optional[str] = None
         self.error: Optional[str] = None
+        self.seq = 0
 
     def __enter__(self) -> "Span":
         stack = self._tracer._stack()
@@ -88,8 +122,10 @@ class Span:
             self.error = repr(exc)
         tracer = self._tracer
         tracer._stack().pop()
-        self.trace_id = self._resolve_trace_id(tracer._anon)
+        self.trace_id = self._resolve_trace_id(tracer)
         with tracer._lock:
+            tracer._seq += 1
+            self.seq = tracer._seq
             tracer._ring.append(self)
         tracer._record_stage(self)
         return False  # propagate any exception
@@ -102,7 +138,7 @@ class Span:
     def seconds(self) -> float:
         return self.end - self.start
 
-    def _resolve_trace_id(self, anon: Iterator[int]) -> str:
+    def _resolve_trace_id(self, tracer: "Tracer") -> str:
         # Own key wins; else nearest ancestor's key/resolved id; else a
         # fresh anonymous id (standalone spans still dump coherently).
         if self.key is not None:
@@ -114,10 +150,11 @@ class Span:
             if node.trace_id is not None:
                 return node.trace_id
             node = node.parent
-        return f"anon-{next(anon)}"
+        return f"anon-{tracer._next_anon()}"
 
     def as_dict(self) -> dict:
         d = {
+            "seq": self.seq,
             "trace_id": self.trace_id,
             "name": self.name,
             "start": _WALL0 + (self.start - _PERF0),
@@ -154,20 +191,47 @@ class Tracer:
                  registry: Optional[Registry] = None):
         self.enabled = True
         self.capacity = capacity
-        self._ring: deque[Span] = deque(maxlen=capacity)
+        self._ring: deque = deque(maxlen=capacity)  # Span or ingested dict
         self._lock = threading.Lock()
         self._local = threading.local()
-        self._anon = itertools.count(1)
+        self._anon_n = 0
+        self._seq = 0
         self._registry = registry
         self._stage_hist = None
         self._span_counter = None
         self._stage_children: dict[str, object] = {}
+        # Node identity (set_node): stamps this process's dumps so a
+        # collector can tell whose spans it merged.
+        self.node: Optional[dict] = None
 
     def _stack(self) -> list:
         st = getattr(self._local, "stack", None)
         if st is None:
             st = self._local.stack = []
         return st
+
+    def _next_anon(self) -> int:
+        with self._lock:
+            self._anon_n += 1
+            return self._anon_n
+
+    # --------------------------------------------------------- node identity
+
+    def set_node(self, address: str, public_key: Optional[bytes] = None) -> None:
+        """Attach this process's node identity (transport address + pubkey
+        prefix) to the tracer. ``/spans`` publishes it as the dump's
+        ``node`` metadata; the short ``id`` is what collectors use as the
+        per-node track name in merged traces."""
+        pk8 = bytes(public_key[:8]).hex() if public_key else ""
+        self.node = {
+            "address": address,
+            "pubkey": pk8,
+            "id": f"{address}#{pk8}" if pk8 else address,
+        }
+
+    def node_label(self) -> str:
+        """Short node id (``address#pk8``) or '' when unset."""
+        return self.node["id"] if self.node is not None else ""
 
     def _record_stage(self, sp: Span) -> None:
         reg = self._registry if self._registry is not None else default_registry()
@@ -196,16 +260,42 @@ class Tracer:
     # ------------------------------------------------------------- dump API
 
     def dump(self, trace_id: Optional[str] = None,
-             limit: Optional[int] = None) -> list[dict]:
+             limit: Optional[int] = None,
+             since: Optional[int] = None) -> list[dict]:
         """Finished spans (oldest first), optionally filtered to one
-        trace and/or truncated to the newest ``limit``."""
+        trace, to spans recorded after the ``since`` cursor (a span
+        ``seq``, exclusive), and/or truncated to the NEWEST ``limit`` —
+        never the oldest, so a small limit still reports current work."""
         with self._lock:
-            spans = list(self._ring)
+            spans = [
+                s.as_dict() if isinstance(s, Span) else s
+                for s in self._ring
+            ]
+        if since is not None:
+            spans = [s for s in spans if s["seq"] > since]
         if trace_id is not None:
-            spans = [s for s in spans if s.trace_id == trace_id]
+            spans = [s for s in spans if s["trace_id"] == trace_id]
         if limit is not None:
             spans = spans[-limit:]
-        return [s.as_dict() for s in spans]
+        return spans
+
+    def last_seq(self) -> int:
+        """The newest span's ``seq`` — the ``since`` cursor a caller
+        passes next time to receive only spans recorded after now."""
+        with self._lock:
+            return self._seq
+
+    def ingest(self, span_dicts: list[dict]) -> None:
+        """Load pre-finished span dicts (the :meth:`dump` shape) into the
+        ring, assigning fresh local ``seq`` cursors. This is how a
+        collector process re-serves merged spans — and how tests build a
+        multi-node topology inside one process."""
+        with self._lock:
+            for d in span_dicts:
+                d = dict(d)
+                self._seq += 1
+                d["seq"] = self._seq
+                self._ring.append(d)
 
     def traces(self) -> dict[str, list[dict]]:
         """Spans grouped by trace id (insertion-ordered)."""
@@ -234,3 +324,12 @@ def default_tracer() -> Tracer:
 def span(name: str, key: Optional[str] = None, **attrs):
     """``default_tracer().span(...)`` — the call sites' one-liner."""
     return _default.span(name, key, **attrs)
+
+
+def node_attrs() -> dict:
+    """``{"node": <short id>}`` when the default tracer carries a node
+    identity, else ``{}`` — for background-work spans (scrub/repair)
+    whose traces are often anonymous: the attr keeps per-node
+    attribution visible even after a fleet-wide merge."""
+    label = _default.node_label()
+    return {"node": label} if label else {}

@@ -44,7 +44,7 @@ import numpy as np
 
 from noise_ec_tpu.host.wire import Shard
 from noise_ec_tpu.obs.registry import default_registry
-from noise_ec_tpu.obs.trace import span
+from noise_ec_tpu.obs.trace import node_attrs, span
 from noise_ec_tpu.store.stripe import StripeStore, UnknownStripeError
 
 __all__ = ["RepairEngine"]
@@ -286,7 +286,7 @@ class RepairEngine:
             return 0
         dt = self._sym_dtype(fieldname)
         repaired = 0
-        with span("repair", stripes=len(members), k=k, n=n):
+        with span("repair", stripes=len(members), k=k, n=n, **node_attrs()):
             if len(members) >= self.batch_min:
                 bc = self._batch_codec(k, n, fieldname)
                 stack = np.stack([
@@ -373,7 +373,7 @@ class RepairEngine:
             self.enqueue(key, "fetch")
             return 0
         fec = self._fec(meta.k, meta.n, meta.field)
-        with span("repair", key=key, kind="restore"):
+        with span("repair", key=key, kind="restore", **node_attrs()):
             try:
                 data_full = fec.decode(
                     [Share(i, shards[i]) for i in present]

@@ -32,7 +32,7 @@ from typing import Optional
 import numpy as np
 
 from noise_ec_tpu.obs.registry import default_registry
-from noise_ec_tpu.obs.trace import span
+from noise_ec_tpu.obs.trace import node_attrs, span
 from noise_ec_tpu.store.stripe import StripeStore, UnknownStripeError
 
 __all__ = ["Scrubber"]
@@ -112,7 +112,10 @@ class Scrubber:
         stats = {"scrubbed": 0, "flagged_missing": 0, "flagged_corrupt": 0}
         # Same-shape fully-trusted stripes batch into one verify dispatch.
         verify_groups: dict[tuple, list[tuple[str, list]]] = {}
-        with span("scrub", stripes=len(keys)):
+        # Scrub traces are usually anonymous (no message key), so the
+        # node identity rides as a span attr — after a fleet-wide merge
+        # the background work still attributes to the node that did it.
+        with span("scrub", stripes=len(keys), **node_attrs()):
             for key in keys:
                 try:
                     meta, shards, unverified = self.store.snapshot(key)

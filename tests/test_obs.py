@@ -270,6 +270,99 @@ def test_exposition_includes_plain_counter_bags():
     assert "# TYPE noise_ec_plugin_shards_in counter" in text
 
 
+# -- exposition edge cases --------------------------------------------------
+
+
+def test_escape_label_value_round_trips_specials():
+    """\\n, \" and \\ survive escape + spec-unescape for any mix —
+    peer addresses are attacker-influenced strings."""
+
+    def unescape(v: str) -> str:
+        # The exposition spec's reader: \\ -> \, \" -> ", \n -> newline.
+        out, i = [], 0
+        while i < len(v):
+            if v[i] == "\\" and i + 1 < len(v):
+                nxt = v[i + 1]
+                out.append({"\\": "\\", '"': '"', "n": "\n"}[nxt])
+                i += 2
+            else:
+                out.append(v[i])
+                i += 1
+        return "".join(out)
+
+    for raw in (
+        'plain', 'a"b', "a\\b", "a\nb", '\\"', '\n\\"', "\\n",
+        'tcp://"evil"\n\\host:1', "\\\\", 'trailing\\',
+    ):
+        assert unescape(escape_label_value(raw)) == raw
+
+
+def test_exposition_inf_bucket_always_rendered():
+    """Every histogram family ends its buckets with the mandatory
+    le=\"+Inf\" line equal to the total count — even when all mass
+    overflows the finite bounds."""
+    reg = Registry()
+    hist = reg.histogram("noise_ec_decode_seconds").labels()
+    for _ in range(3):
+        hist.observe(1e9)  # far past the top finite bound
+    lines = render_prometheus(reg).splitlines()
+    inf = [ln for ln in lines if 'le="+Inf"' in ln]
+    assert inf == ['noise_ec_decode_seconds_bucket{le="+Inf"} 3']
+    assert "noise_ec_decode_seconds_count 3" in lines
+
+
+def test_exposition_suppresses_empty_families():
+    """A family touched but never labeled has no samples; bare
+    HELP/TYPE lines would make scrapers ingest a sampleless family."""
+    reg = Registry()
+    reg.counter("noise_ec_transport_shards_in_total")  # no .labels()
+    reg.counter("noise_ec_dispatch_overflows_total").labels().add(1)
+    text = render_prometheus(reg)
+    assert "noise_ec_transport_shards_in_total" not in text
+    assert "noise_ec_dispatch_overflows_total 1" in text
+
+
+# -- /spans pagination ------------------------------------------------------
+
+
+def test_dump_limit_returns_newest_and_since_cursors():
+    tr = Tracer(registry=Registry())
+    for i in range(6):
+        with tr.span("decode", key=f"t{i}"):
+            pass
+    # limit: the NEWEST N, not the ring head.
+    newest = tr.dump(limit=2)
+    assert [d["trace_id"] for d in newest] == ["t4", "t5"]
+    # since: strictly-after cursoring; seq is monotone per process.
+    cursor = tr.dump(limit=3)[0]["seq"]
+    after = tr.dump(since=cursor)
+    assert [d["trace_id"] for d in after] == ["t4", "t5"]
+    assert tr.last_seq() == 6
+    assert tr.dump(since=tr.last_seq()) == []
+
+
+def test_spans_endpoint_since_and_limit():
+    tr = Tracer(registry=Registry())
+    for i in range(5):
+        with tr.span("decode", key=f"t{i}"):
+            pass
+    srv = StatsServer(port=0, registry=Registry(), tracer=tr)
+    try:
+        _, body = _get(srv.url + "/spans?limit=2")
+        doc = json.loads(body)
+        assert [s["trace_id"] for s in doc["spans"]] == ["t3", "t4"]
+        assert doc["next_since"] == 5
+        # The collector's loop: pass next_since back, get only news.
+        _, body = _get(srv.url + f"/spans?since={doc['next_since']}")
+        assert json.loads(body)["spans"] == []
+        with tr.span("verify", key="t5"):
+            pass
+        _, body = _get(srv.url + f"/spans?since={doc['next_since']}")
+        assert [s["trace_id"] for s in json.loads(body)["spans"]] == ["t5"]
+    finally:
+        srv.close()
+
+
 # -- metric-name lint -------------------------------------------------------
 
 
@@ -378,8 +471,12 @@ def test_stats_endpoint_serves_metrics_spans_health():
         assert 0.000512 < hist.p99 <= 0.001024
 
         status, body = _get(srv.url + "/spans?trace=http-test")
-        spans = json.loads(body)
-        assert [s["name"] for s in spans] == ["decode"]
+        doc = json.loads(body)
+        assert set(doc) >= {"node", "clock", "next_since", "spans"}
+        assert [s["name"] for s in doc["spans"]] == ["decode"]
+        # The clock anchor is what the distributed-trace collector
+        # aligns against: a wall/perf pair plus the render-time reading.
+        assert set(doc["clock"]) == {"wall", "perf", "now"}
 
         status, body = _get(srv.url + "/healthz")
         assert status == 200 and body == b"ok\n"

@@ -1,0 +1,342 @@
+"""Distributed tracing + SLO health tests: collector clock alignment,
+two-node span merge over real /spans endpoints, Chrome trace-event
+export schema, critical-path reporting, and the SLO-driven /healthz
+flip — the ISSUE 3 acceptance bar."""
+
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import pytest
+
+from noise_ec_tpu.obs.collector import TraceCollector, estimate_offset
+from noise_ec_tpu.obs.health import SLOEvaluator, record_e2e
+from noise_ec_tpu.obs.perfetto import to_chrome_trace, write_chrome_trace
+from noise_ec_tpu.obs.registry import Registry, set_build_info
+from noise_ec_tpu.obs.server import StatsServer
+from noise_ec_tpu.obs.trace import Tracer
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+try:
+    import trace_report
+finally:
+    sys.path.pop(0)
+
+# Send-side vs receive-side pipeline stages: how the one-process
+# loopback roundtrip's spans split into the two logical nodes below.
+_SEND_STAGES = {"prepare", "sign", "encode", "wire_encode", "broadcast"}
+_RECV_STAGES = {"deliver", "reassemble", "decode", "verify"}
+
+
+# -- clock offset estimation ------------------------------------------------
+
+
+def test_estimate_offset_midpoint_and_uncertainty():
+    # Local bracket [10.0, 10.4]; peer rendered its clock (1000.3) at
+    # the midpoint 10.2 under the model => offset 990.1, rtt 0.4.
+    c = estimate_offset(10.0, 10.4, 1000.3)
+    assert c.offset == pytest.approx(990.1)
+    assert c.rtt == pytest.approx(0.4)
+    assert c.uncertainty == pytest.approx(0.2)
+
+
+def test_estimate_offset_handshake_hint_tightens_uncertainty():
+    loose = estimate_offset(10.0, 10.4, 1000.3)
+    tight = estimate_offset(10.0, 10.4, 1000.3, handshake_rtt=0.05)
+    assert tight.offset == loose.offset  # the midpoint does not move
+    assert tight.uncertainty == pytest.approx(0.025)
+    # A hint WORSE than the HTTP rtt must not loosen the bound.
+    worse = estimate_offset(10.0, 10.4, 1000.3, handshake_rtt=3.0)
+    assert worse.uncertainty == pytest.approx(0.2)
+
+
+class _SkewedSpanServer:
+    """A fake /spans endpoint whose clock (and span timestamps) run
+    ``skew`` seconds ahead of the collector's — the cross-process case
+    the RTT-midpoint estimate exists for."""
+
+    def __init__(self, skew: float, spans: list[dict], node_id: str):
+        outer_spans = [dict(s, start=s["start"] + skew) for s in spans]
+
+        class _H(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                doc = {
+                    "node": {"id": node_id, "address": "tcp://skewed:1"},
+                    "clock": {"now": time.time() + skew},
+                    "next_since": max(
+                        (s["seq"] for s in outer_spans), default=0
+                    ),
+                    "spans": outer_spans,
+                }
+                body = json.dumps(doc).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+        threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        ).start()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def test_collector_corrects_peer_clock_skew():
+    """Spans from a peer whose wall clock is 500 s ahead land within
+    the RTT uncertainty of their true local time after merging."""
+    t_true = time.time() - 0.050
+    spans = [{
+        "seq": 1, "trace_id": "k", "name": "decode",
+        "start": t_true, "seconds": 0.010, "parent": None,
+    }]
+    srv = _SkewedSpanServer(500.0, spans, "tcp://skewed:1#ab")
+    try:
+        coll = TraceCollector([srv.url], tracer=Tracer())
+        assert coll.poll() == 1
+        (got,) = coll.merged_spans()
+        clock = coll.clock(srv.url)
+        assert abs(clock.offset - 500.0) <= clock.rtt + 0.01
+        assert got["node"] == "tcp://skewed:1#ab"
+        assert abs(got["start"] - t_true) <= clock.rtt + 0.01
+    finally:
+        srv.close()
+
+
+# -- the two-node acceptance bar --------------------------------------------
+
+
+def _loopback_two_node_trace():
+    """Run one message through the REAL loopback pipeline, then split
+    its spans into the two logical nodes (send stages vs receive
+    stages) exactly as two separate processes would have recorded them,
+    each behind its own /spans endpoint with its own node identity."""
+    from noise_ec_tpu.host.plugin import ShardPlugin
+    from noise_ec_tpu.host.transport import LoopbackHub, LoopbackNetwork
+    from noise_ec_tpu.obs.trace import default_tracer, trace_key
+
+    hub = LoopbackHub()
+    a = LoopbackNetwork(hub, "tcp://trace-a:1")
+    b = LoopbackNetwork(hub, "tcp://trace-b:1")
+    pa, pb = ShardPlugin(backend="numpy"), ShardPlugin(backend="numpy")
+    a.add_plugin(pa)
+    b.add_plugin(pb)
+    before = default_tracer().last_seq()
+    shards = pa.shard_and_broadcast(a, b"distributed tracing end to end!!")
+    key = trace_key(shards[0].file_signature)
+    assert pb.counters.get("verified") == 1
+    run_spans = [
+        s for s in default_tracer().dump(trace_id=key, since=before)
+    ]
+    tr_a, tr_b = Tracer(registry=Registry()), Tracer(registry=Registry())
+    tr_a.set_node(a.id.address, a.keys.public_key)
+    tr_b.set_node(b.id.address, b.keys.public_key)
+    tr_a.ingest([s for s in run_spans if s["name"] in _SEND_STAGES])
+    tr_b.ingest([s for s in run_spans if s["name"] in _RECV_STAGES])
+    return key, tr_a, tr_b
+
+
+def test_two_node_collect_merge_export_and_report(tmp_path):
+    """The acceptance bar: collect spans from both nodes' /spans
+    endpoints, merge them into ONE distributed trace, export valid
+    Chrome trace-event JSON (every slice has pid/tid/ts/dur; tracks
+    named by node), and have trace_report name the dominant stage."""
+    key, tr_a, tr_b = _loopback_two_node_trace()
+    srv_a = StatsServer(port=0, registry=Registry(), tracer=tr_a)
+    srv_b = StatsServer(port=0, registry=Registry(), tracer=tr_b)
+    try:
+        coll = TraceCollector([srv_a.url, srv_b.url], tracer=Tracer())
+        assert coll.poll() > 0
+        traces = coll.traces()
+        assert key in traces
+        trace = traces[key]
+        nodes = {s["node"] for s in trace}
+        assert len(nodes) == 2  # both endpoints contributed
+        stages = {s["name"] for s in trace}
+        assert stages >= (_SEND_STAGES | _RECV_STAGES)
+        # Spans are on one ordered timeline: send precedes receive end.
+        assert trace == sorted(trace, key=lambda s: s["start"])
+
+        # A second poll moves nothing: the since cursor held.
+        assert coll.poll() == 0
+
+        # -- Chrome trace-event export, schema-checked.
+        path = tmp_path / "mesh.json"
+        doc = write_chrome_trace(str(path), coll.merged_spans())
+        loaded = json.loads(path.read_text())
+        assert loaded["traceEvents"] == doc["traceEvents"]
+        slices = [e for e in loaded["traceEvents"] if e["ph"] == "X"]
+        assert len(slices) == len(trace)
+        for e in slices:
+            assert {"pid", "tid", "ts", "dur", "name", "args"} <= set(e)
+            assert e["ts"] >= 0 and e["dur"] >= 0
+        tracks = {
+            e["args"]["name"]
+            for e in loaded["traceEvents"]
+            if e["name"] == "process_name"
+        }
+        assert tracks == {tr_a.node_label(), tr_b.node_label()}
+
+        # -- critical path: the dominant (node, stage) is named.
+        report = trace_report.render_report(traces, (0.5, 0.99))
+        cp = trace_report.critical_path(trace)
+        assert cp["dominant"] is not None
+        assert cp["dominant"]["stage"] in (_SEND_STAGES | _RECV_STAGES)
+        assert cp["e2e_seconds"] > 0
+        assert "dominant:" in report and key in report
+        # Self-time never exceeds the end-to-end interval.
+        assert sum(s["seconds"] for s in cp["stages"]) <= (
+            cp["e2e_seconds"] * (1 + 1e-6)
+        )
+    finally:
+        srv_a.close()
+        srv_b.close()
+
+
+def test_trace_report_loads_span_dump_files(tmp_path):
+    """The offline path: /spans dump documents saved to disk feed the
+    same report (node-stamped from each document's own metadata)."""
+    key, tr_a, tr_b = _loopback_two_node_trace()
+    from noise_ec_tpu.obs.trace import clock_anchor
+
+    paths = []
+    for tr, name in ((tr_a, "a.json"), (tr_b, "b.json")):
+        p = tmp_path / name
+        p.write_text(json.dumps({
+            "node": tr.node,
+            "clock": clock_anchor(),
+            "next_since": tr.last_seq(),
+            "spans": tr.dump(),
+        }))
+        paths.append(str(p))
+    spans = trace_report.load_spans(paths)
+    assert {s["node"] for s in spans} == {
+        tr_a.node_label(), tr_b.node_label()
+    }
+    traces = trace_report.group_traces(spans)
+    assert key in traces
+    out = trace_report.render_report(traces)
+    assert "dominant:" in out
+
+
+# -- SLO evaluator + /healthz -----------------------------------------------
+
+
+def test_slo_insufficient_data_reads_healthy():
+    slo = SLOEvaluator(window_seconds=60.0, min_events=10)
+    for _ in range(9):
+        slo.record("verify_failed", 0.1)
+    assert slo.verdict()["healthy"] is True  # 9 < min_events
+
+
+def test_slo_success_rate_burn_and_window_slide():
+    slo = SLOEvaluator(window_seconds=10.0, min_events=5)
+    t0 = 1000.0
+    for i in range(20):
+        slo.record("ok" if i % 2 else "verify_failed", 0.01, now=t0)
+    v = slo.verdict(now=t0 + 1)
+    assert v["healthy"] is False
+    assert "success rate" in v["reason"]
+    assert v["success_rate"] == pytest.approx(0.5)
+    # The window slides past the bad minute: healthy again.
+    assert slo.verdict(now=t0 + 11)["healthy"] is True
+
+
+def test_slo_p99_objective():
+    slo = SLOEvaluator(
+        window_seconds=10.0, min_events=5, p99_target_seconds=0.5
+    )
+    t0 = 50.0
+    for _ in range(20):
+        slo.record("ok", 2.0, now=t0)
+    v = slo.verdict(now=t0)
+    assert v["healthy"] is False and "p99" in v["reason"]
+    assert v["p99_seconds"] == pytest.approx(2.0)
+
+
+def test_record_e2e_feeds_histogram_and_evaluator():
+    reg = Registry()
+    slo = SLOEvaluator(window_seconds=60.0, min_events=1)
+    record_e2e("ok", 0.25, registry=reg, slo=slo)
+    record_e2e("verify_failed", 0.1, registry=reg, slo=slo)
+    fam = reg.histogram("noise_ec_e2e_latency_seconds")
+    assert fam.labels(outcome="ok").count == 1
+    assert fam.labels(outcome="verify_failed").count == 1
+    assert slo.verdict()["events"] == 2
+
+
+def _get_status(url: str) -> tuple[int, bytes]:
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read()
+
+
+def test_healthz_flips_503_on_burned_slo_and_recovers():
+    """The acceptance bar: enough verify failures inside the window
+    flip /healthz to 503 with a JSON reason; once the window slides the
+    endpoint recovers to 200 — with the failures injected through the
+    REAL receive path (shards whose object signature cannot verify)."""
+    from noise_ec_tpu.host.plugin import ShardPlugin
+    from noise_ec_tpu.host.transport import LoopbackHub, LoopbackNetwork
+
+    slo = SLOEvaluator(window_seconds=0.6, min_events=3)
+    hub = LoopbackHub()
+    a = LoopbackNetwork(hub, "tcp://slo-a:1")
+    b = LoopbackNetwork(hub, "tcp://slo-b:1")
+    pa = ShardPlugin(backend="numpy")
+    pb = ShardPlugin(backend="numpy", slo=slo)
+    a.add_plugin(pa)
+    b.add_plugin(pb)
+    srv = StatsServer(port=0, registry=Registry(), slo=slo)
+    try:
+        status, body = _get_status(srv.url + "/healthz")
+        assert (status, body) == (200, b"ok\n")
+        for i in range(4):
+            shards = pa.prepare_shards(
+                a.id, a.keys, (b"burn the error budget %d" % i).ljust(32, b"!")
+            )
+            for s in shards:
+                # Tamper the object signature (distinct per message so
+                # each pools separately): every reassembly verify on the
+                # receiver fails, and once all n shards arrive the
+                # object is CorruptionError-unrecoverable.
+                s.file_signature = bytes([i + 1]) * len(s.file_signature)
+                a.broadcast(s)
+        assert b.error_count > 0  # CorruptionErrors recorded, not raised
+        assert pb.counters.get("verify_failures") > 0
+        status, body = _get_status(srv.url + "/healthz")
+        assert status == 503
+        verdict = json.loads(body)
+        assert verdict["healthy"] is False
+        assert "success rate" in verdict["reason"]
+        # The window slides past the injected failures: healthy again.
+        time.sleep(0.7)
+        status, body = _get_status(srv.url + "/healthz")
+        assert (status, body) == (200, b"ok\n")
+    finally:
+        srv.close()
+
+
+def test_build_info_gauge_exported():
+    from noise_ec_tpu.obs.export import render_prometheus
+
+    reg = Registry()
+    set_build_info("device", "pallas", version="9.9.9", registry=reg)
+    text = render_prometheus(reg)
+    assert (
+        'noise_ec_build_info{backend="device",kernel="pallas",'
+        'version="9.9.9"} 1' in text
+    )

@@ -23,7 +23,15 @@ and cross-checks every referenced name against the declarative registry:
   become ``stage`` label values on ``noise_ec_stage_seconds`` /
   ``noise_ec_spans_total``, and the label set stays bounded only if the
   tuple is the single source of truth (the scrub/repair spans joined it
-  this way).
+  this way);
+- **docs drift**: every declared registry family must appear in
+  ``docs/observability.md`` — an undocumented series is invisible to
+  the operator the docs' metric table exists for;
+- **span schema drift**: every span dict field
+  (``obs.trace.SPAN_FIELDS``) and every ``/spans`` dump-document key
+  (``obs.server.SPANS_DOC_FIELDS``) must be documented (backticked) in
+  ``docs/observability.md`` — the distributed-trace collector and any
+  external tooling parse exactly that schema.
 
 Run directly (``python tools/check_metrics.py``; exit 1 on problems) or
 through the tier-1 test that wraps it (tests/test_obs.py).
@@ -129,6 +137,41 @@ def check() -> list[str]:
             problems.append(
                 f"span stage {stage!r} (used in {sorted(files)}) is not "
                 "declared in obs.registry.PIPELINE_STAGES"
+            )
+    problems.extend(check_docs())
+    return problems
+
+
+def check_docs() -> list[str]:
+    """Docs-vs-code drift: every registry family and every span/dump
+    schema field must be documented in docs/observability.md."""
+    from noise_ec_tpu.obs.registry import METRICS
+    from noise_ec_tpu.obs.server import SPANS_DOC_FIELDS
+    from noise_ec_tpu.obs.trace import SPAN_FIELDS
+
+    doc_path = REPO / "docs" / "observability.md"
+    problems: list[str] = []
+    if not doc_path.exists():
+        return [f"docs file {doc_path} missing"]
+    text = doc_path.read_text(encoding="utf-8")
+    for name in METRICS:
+        if not re.search(rf"\b{re.escape(name)}\b", text):
+            problems.append(
+                f"metric {name!r} is not documented in "
+                "docs/observability.md (registry table)"
+            )
+    for field in SPAN_FIELDS:
+        if f"`{field}`" not in text:
+            problems.append(
+                f"span field {field!r} (obs.trace.SPAN_FIELDS) is not "
+                "documented in docs/observability.md"
+            )
+    for field in SPANS_DOC_FIELDS:
+        if f"`{field}`" not in text:
+            problems.append(
+                f"/spans document key {field!r} "
+                "(obs.server.SPANS_DOC_FIELDS) is not documented in "
+                "docs/observability.md"
             )
     return problems
 

@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Critical-path report over merged distributed traces.
+
+Answers "where did this broadcast spend its 500 ms" across a mesh: given
+spans collected from one or more nodes' ``/spans`` endpoints (live, via
+``--peers``) or saved dump documents (file arguments), the report groups
+them into distributed traces, ranks traces by end-to-end latency, and
+for the p50/p99 traces prints the critical path — per-(node, stage)
+*self time* (span duration minus time covered by its child spans, so
+``prepare`` does not double-count ``sign``/``encode`` nested inside it),
+the share of the end-to-end interval each consumed, the uncovered
+"idle/network" remainder, and the single dominant (node, stage).
+
+Usage:
+
+    python tools/trace_report.py dump_a.json dump_b.json
+    python tools/trace_report.py --peers http://127.0.0.1:9464,http://127.0.0.1:9465
+    python tools/trace_report.py --quantiles 0.5,0.9,0.99 dump.json
+
+File arguments may be ``/spans`` dump documents (``{"node", "spans",
+...}`` — spans are stamped with the document's node id) or plain JSON
+lists of already-merged span dicts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # direct `python tools/trace_report.py` runs
+    sys.path.insert(0, str(REPO))
+
+
+def load_spans(paths: list[str]) -> list[dict]:
+    """Spans from dump-document or merged-list JSON files, node-stamped."""
+    out: list[dict] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        if isinstance(doc, dict):
+            node = (doc.get("node") or {}).get("id") or path
+            for s in doc.get("spans", []):
+                d = dict(s)
+                d.setdefault("node", node)
+                out.append(d)
+        else:
+            out.extend(dict(s) for s in doc)
+    return out
+
+
+def group_traces(spans: list[dict]) -> dict[str, list[dict]]:
+    out: dict[str, list[dict]] = {}
+    for s in sorted(spans, key=lambda d: float(d.get("start", 0.0))):
+        out.setdefault(str(s.get("trace_id")), []).append(s)
+    return out
+
+
+def _interval(s: dict) -> tuple[float, float]:
+    lo = float(s.get("start", 0.0))
+    return lo, lo + max(0.0, float(s.get("seconds", 0.0)))
+
+
+def _union_length(intervals: list[tuple[float, float]]) -> float:
+    total = 0.0
+    end = float("-inf")
+    for lo, hi in sorted(intervals):
+        if hi <= end:
+            continue
+        total += hi - max(lo, end)
+        end = hi
+    return total
+
+
+def e2e_seconds(trace: list[dict]) -> float:
+    """End-to-end interval of one trace: earliest start to latest end."""
+    if not trace:
+        return 0.0
+    return max(hi for _, hi in map(_interval, trace)) - min(
+        lo for lo, _ in map(_interval, trace)
+    )
+
+
+def _self_seconds(sp: dict, trace: list[dict]) -> float:
+    """Span duration minus time covered by its children (``parent``
+    naming this span, starting inside it). Children are matched across
+    nodes on purpose: an in-process (loopback) pipeline nests the
+    receive stages inside the sender's ``broadcast`` span, and without
+    the subtraction the same wall time would count twice; in genuinely
+    multi-process traces a child's parent link never crosses a process,
+    so the cross-node match is a no-op there."""
+    lo, hi = _interval(sp)
+    kids = []
+    for s in trace:
+        if s is sp or s.get("parent") != sp.get("name"):
+            continue
+        klo, khi = _interval(s)
+        if lo <= klo < hi:
+            kids.append((klo, min(khi, hi)))
+    return (hi - lo) - _union_length(kids)
+
+
+def critical_path(trace: list[dict]) -> dict:
+    """Per-(node, stage) self-time breakdown of one distributed trace.
+
+    Returns ``{"e2e_seconds", "idle_seconds", "stages": [{"node",
+    "stage", "seconds", "share"}...] (descending), "dominant"}`` where
+    ``dominant`` is the largest contributor — the headline answer to
+    "which stage on which node dominated".
+    """
+    e2e = e2e_seconds(trace)
+    totals: dict[tuple[str, str], float] = {}
+    for sp in trace:
+        key = (str(sp.get("node", "") or "unknown"), str(sp.get("name")))
+        totals[key] = totals.get(key, 0.0) + _self_seconds(sp, trace)
+    stages = [
+        {
+            "node": node,
+            "stage": stage,
+            "seconds": secs,
+            "share": (secs / e2e) if e2e > 0 else 0.0,
+        }
+        for (node, stage), secs in totals.items()
+    ]
+    stages.sort(key=lambda d: -d["seconds"])
+    idle = e2e - _union_length([_interval(s) for s in trace])
+    return {
+        "e2e_seconds": e2e,
+        "idle_seconds": max(0.0, idle),
+        "stages": stages,
+        "dominant": stages[0] if stages else None,
+    }
+
+
+def pick_quantile(
+    ranked: list[tuple[str, float]], q: float
+) -> tuple[str, float]:
+    """The (trace id, e2e) at quantile ``q`` of the ascending ranking."""
+    i = min(len(ranked) - 1, int(round(q * (len(ranked) - 1))))
+    return ranked[i]
+
+
+def render_report(
+    traces: dict[str, list[dict]], quantiles: tuple[float, ...] = (0.5, 0.99)
+) -> str:
+    """The full text report for a set of distributed traces."""
+    ranked = sorted(
+        ((tid, e2e_seconds(tr)) for tid, tr in traces.items()),
+        key=lambda p: p[1],
+    )
+    if not ranked:
+        return "no traces collected\n"
+    lines = [
+        f"{len(ranked)} traces; e2e min {ranked[0][1] * 1e3:.2f} ms, "
+        f"max {ranked[-1][1] * 1e3:.2f} ms"
+    ]
+    for q in quantiles:
+        tid, e2e = pick_quantile(ranked, q)
+        trace = traces[tid]
+        cp = critical_path(trace)
+        nodes = {str(s.get("node", "") or "unknown") for s in trace}
+        lines.append("")
+        lines.append(
+            f"== p{int(q * 100)} trace {tid}: e2e {e2e * 1e3:.2f} ms, "
+            f"{len(trace)} spans across {len(nodes)} node(s)"
+        )
+        for st in cp["stages"]:
+            lines.append(
+                f"   {st['stage']:<12} {st['node']:<32} "
+                f"{st['seconds'] * 1e3:9.3f} ms  {st['share'] * 100:5.1f}%"
+            )
+        lines.append(
+            f"   {'(idle/network)':<45} "
+            f"{cp['idle_seconds'] * 1e3:9.3f} ms  "
+            f"{(cp['idle_seconds'] / e2e if e2e else 0) * 100:5.1f}%"
+        )
+        dom = cp["dominant"]
+        if dom is not None:
+            lines.append(
+                f"   dominant: {dom['stage']} on {dom['node']} "
+                f"({dom['share'] * 100:.1f}% of e2e)"
+            )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="trace-report",
+        description="critical-path report over merged distributed traces",
+    )
+    p.add_argument("dumps", nargs="*", help="/spans dump JSON files")
+    p.add_argument(
+        "-peers", "--peers", default="",
+        help="comma-separated peer metrics endpoints to poll live",
+    )
+    p.add_argument(
+        "-quantiles", "--quantiles", default="0.5,0.99",
+        help="comma-separated quantiles to report (default 0.5,0.99)",
+    )
+    args = p.parse_args(argv)
+    spans: list[dict] = []
+    if args.peers:
+        from noise_ec_tpu.obs.collector import TraceCollector
+        from noise_ec_tpu.obs.trace import Tracer
+
+        # A fresh empty tracer: the report wants the PEERS' spans, not
+        # whatever this tool process happened to record.
+        coll = TraceCollector(
+            [u for u in args.peers.split(",") if u], tracer=Tracer()
+        )
+        coll.poll()
+        spans.extend(coll.merged_spans())
+    spans.extend(load_spans(args.dumps))
+    if not spans:
+        print("no spans found (pass dump files or --peers)", file=sys.stderr)
+        return 1
+    quantiles = tuple(float(x) for x in args.quantiles.split(",") if x)
+    print(render_report(group_traces(spans), quantiles), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
