@@ -370,6 +370,86 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — secondary stat only
         stats["store_repair_error"] = str(exc)[:80]
 
+    # --- chaos recovery: partition-heal -> first successful delivery
+    # latency through the REAL transport behind the chaos proxy
+    # (docs/resilience.md). Three scheduled 1 s directional partitions
+    # sever the payload direction while the sender keeps broadcasting;
+    # partition_recovery_p50_ms is the median time from each heal to the
+    # first outcome=ok delivery after it — the end-to-end cost of the
+    # reconnect/NACK/announce healing loop, not of any one kernel.
+    try:
+        from noise_ec_tpu.host.plugin import ShardPlugin as _SP
+        from noise_ec_tpu.host.transport import TCPNetwork
+        from noise_ec_tpu.resilience.chaos import ChaosProfile, ChaosProxy
+        from noise_ec_tpu.store import RepairEngine as _RE
+        from noise_ec_tpu.store import StripeStore as _SS
+
+        heals = [1.5, 3.5, 5.5]
+        profile = ChaosProfile.parse(",".join(
+            f"partition@{h - 1.0}:1.0:b2a" for h in heals  # b2a = payloads
+        ))
+        a_net = TCPNetwork(host="127.0.0.1", port=0, discovery=False)
+        a_store = _SS()
+        a_engine = _RE(
+            a_store, network=a_net, respond_interval_seconds=0.2,
+            linger_seconds=0.0, announce_interval_seconds=0.2,
+            announce_window_seconds=30.0, announce_max_stripes=256,
+        )
+        a_engine.start()
+        a_plug = _SP(backend="numpy", store=a_store)
+        a_net.add_plugin(a_plug)
+        a_net.listen()
+        proxy = ChaosProxy(
+            "127.0.0.1", a_net.port, profile=profile, seed=99
+        ).start()
+        deliveries: list[float] = []
+        b_net = TCPNetwork(host="127.0.0.1", port=0, discovery=False)
+        b_plug = _SP(
+            backend="numpy",
+            on_message=lambda m, s: deliveries.append(proxy.now()),
+        )
+        b_plug.nack_grace_seconds = 0.2
+        b_plug.nack_backoff_base = 0.2
+        b_net.add_plugin(b_plug)
+        b_net.listen()
+        b_net.bootstrap([proxy.address])
+        t_end = time.time() + 20
+        while time.time() < t_end and (not a_net.peers or not b_net.peers):
+            time.sleep(0.02)
+        check_smoke(bool(a_net.peers and b_net.peers),
+                    "chaos bench peers never registered")
+        seq = 0
+        while proxy.now() < heals[-1] + 1.5:
+            a_plug.shard_and_broadcast(
+                a_net, f"chaos bench payload {seq:06d}!".encode()  # 25 B
+            )
+            seq += 1
+            time.sleep(0.025)
+        t_end = time.time() + 20
+        recoveries = None
+        while time.time() < t_end:
+            after = [
+                min((t for t in list(deliveries) if t >= h), default=None)
+                for h in heals
+            ]
+            if all(x is not None for x in after):
+                recoveries = [x - h for x, h in zip(after, heals)]
+                break
+            time.sleep(0.1)
+        check_smoke(recoveries is not None,
+                    "no post-heal delivery within the window")
+        stats["partition_recovery_p50_ms"] = round(
+            float(np.median(recoveries)) * 1e3, 1
+        )
+        proxy.close()
+        a_net.close()
+        b_net.close()
+        a_engine.close()
+    except SmokeMismatch:
+        raise
+    except Exception as exc:  # noqa: BLE001 — secondary stat only
+        stats["chaos_recovery_error"] = str(exc)[:80]
+
     if dev.kernel == "pallas":
         # Correctness smoke BEFORE any timing: the bench must not be the
         # first time a shape runs on real hardware — one small fused encode

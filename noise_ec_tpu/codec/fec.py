@@ -230,7 +230,15 @@ class FEC:
                 nums,
                 [dedup[i] for i in nums],
                 G=self._golden.G,
-                device=self._rs._dev if self.bw_route == "device" else None,
+                # The device syndrome route also honors the codec
+                # breaker (ops/dispatch.py): while it is open, decode's
+                # syndrome/solve matmuls stay on the host shim rather
+                # than feeding a known-broken device more work.
+                device=(
+                    self._rs._dev
+                    if self.bw_route == "device" and self._rs.device_route_ok()
+                    else None
+                ),
             )
             if res is None:
                 m = len(nums)

@@ -22,6 +22,7 @@ Backends:
 
 from __future__ import annotations
 
+import logging
 from typing import Optional, Sequence, Union
 
 import numpy as np
@@ -32,6 +33,8 @@ from noise_ec_tpu.matrix.hostmath import host_matvec
 from noise_ec_tpu.matrix.linalg import reconstruction_matrix
 
 Buffer = Union[bytes, bytearray, memoryview, np.ndarray]
+
+_rslog = logging.getLogger("noise_ec_tpu.codec")
 
 _FIELDS = {"gf256": GF256, "gf65536": GF65536}
 
@@ -91,11 +94,17 @@ class ReedSolomon:
                 "systematic layout (use golden.GoldenCodec for evaluation codes)"
             )
         if backend == "device":
-            from noise_ec_tpu.ops.dispatch import DeviceCodec
+            from noise_ec_tpu.ops.dispatch import DeviceCodec, codec_breaker
 
             self._dev: Optional["DeviceCodec"] = DeviceCodec(field=field)
+            # Process-wide device-route breaker (ops/dispatch.py): a
+            # dispatch failure after one retry trips it and every codec
+            # degrades to the golden host arithmetic until the
+            # background half-open probe re-closes it.
+            self._breaker = codec_breaker()
         elif backend == "numpy":
             self._dev = None
+            self._breaker = None
         else:
             raise ValueError(f"unknown backend {backend!r}")
 
@@ -103,15 +112,59 @@ class ReedSolomon:
 
     def _mul(self, M: np.ndarray, D: np.ndarray) -> np.ndarray:
         if self._dev is not None:
-            try:
-                return self._dev.matmul_stripes(M, D)
-            except NotImplementedError:
-                # Defensive: the stripes entry routes every geometry
-                # today (baked or MXU); if a future backend reintroduces
-                # an unsupported region, the native host tier is the
-                # designed fallback for codec callers, not an error.
-                pass
+            if self._breaker.allow():
+                out = self._mul_device(M, D)
+                if out is not None:
+                    return out
+            else:
+                from noise_ec_tpu.ops.dispatch import record_codec_fallback
+
+                record_codec_fallback("open")
+        # Graceful degradation: the golden host arithmetic — bit-exact
+        # with the device kernels (that equivalence is the golden codec's
+        # whole job), so a breaker trip costs throughput, never bytes.
         return host_matvec(self.gf, M, D)
+
+    def _mul_device(self, M: np.ndarray, D: np.ndarray):
+        """One device matmul under the breaker: retry a failure once
+        in-call (transient), trip the breaker on the second, and report
+        the outcome so a half-open probe slot is always released.
+        Returns None when the caller must run the host fallback."""
+        from noise_ec_tpu.ops.dispatch import (
+            ensure_codec_prober,
+            record_codec_fallback,
+        )
+
+        last_exc = None
+        for attempt in range(2):
+            try:
+                out = self._dev.matmul_stripes(M, D)
+            except NotImplementedError:
+                # Designed host-tier routing, not a device fault: the
+                # breaker must not trip (and a half-open probe counts as
+                # answered — the device route itself is fine).
+                self._breaker.record_success()
+                return None
+            except Exception as exc:  # noqa: BLE001 — XLA runtime faults
+                last_exc = exc
+                continue
+            self._breaker.record_success()
+            return out
+        self._breaker.record_failure()
+        ensure_codec_prober()
+        record_codec_fallback("error")
+        _rslog.warning(
+            "device codec dispatch failed twice (%s); breaker %s — "
+            "degrading to the golden host codec", last_exc,
+            self._breaker.state(),
+        )
+        return None
+
+    def device_route_ok(self) -> bool:
+        """Cheap gate for callers choosing a device-resident route up
+        front (e.g. FEC's bw_route) — True only with a device codec AND
+        a closed breaker; never consumes the half-open probe slot."""
+        return self._dev is not None and self._breaker.closed
 
     def _to_sym(self, buf: Buffer, name: str) -> np.ndarray:
         arr = np.frombuffer(buf, dtype=np.uint8) if not isinstance(buf, np.ndarray) else buf

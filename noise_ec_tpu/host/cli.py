@@ -105,6 +105,35 @@ def build_parser() -> argparse.ArgumentParser:
         "wire absorbs still run whenever the store is enabled)",
     )
     p.add_argument(
+        "-announce-interval",
+        type=float,
+        default=0.0,
+        metavar="SECONDS",
+        help="broadcast one shard of each recently stored stripe every "
+        "SECONDS (anti-entropy announce, docs/resilience.md): peers that "
+        "silently lost an object — e.g. through a partition — discover "
+        "and NACK-repair it. 0 disables; requires the stripe store "
+        "(enabled automatically when set)",
+    )
+    p.add_argument(
+        "-chaos-profile",
+        default="",
+        metavar="PROFILE",
+        help="dial every -peers address through an in-process chaos "
+        "proxy applying PROFILE (e.g. "
+        "'drop=0.05,corrupt=0.01,partition@2:2:a2b,reset@5' — "
+        "docs/resilience.md for the grammar). Fault injection for the "
+        "REAL transport; empty disables",
+    )
+    p.add_argument(
+        "-chaos-seed",
+        type=int,
+        default=0,
+        metavar="SEED",
+        help="seed for -chaos-profile fault decisions (same seed + "
+        "profile + frame order reproduces the run)",
+    )
+    p.add_argument(
         "-metrics-port",
         type=int,
         default=-1,
@@ -193,13 +222,16 @@ def main(argv: list[str] | None = None) -> int:
                 log.error("could not save received object: %s", exc)
 
     store = scrubber = engine = None
-    if args.store_dir or args.scrub_interval > 0:
+    if args.store_dir or args.scrub_interval > 0 or args.announce_interval > 0:
         from noise_ec_tpu.store import RepairEngine, Scrubber, StripeStore
 
         store = StripeStore(
             args.store_dir or None, backend=args.backend
         )
-        engine = RepairEngine(store, network=net)
+        engine = RepairEngine(
+            store, network=net,
+            announce_interval_seconds=args.announce_interval,
+        )
         engine.start()
         if args.scrub_interval > 0:
             scrubber = Scrubber(
@@ -246,6 +278,12 @@ def main(argv: list[str] | None = None) -> int:
             # receive path burns the rolling SLO window (obs/health.py)
             # — orchestrators can restart/deweight on it.
             slo=default_slo(),
+            # The peer supervisor's circuit-breaker summary rides the
+            # /healthz JSON body (503, or 200 with ?verbose=1).
+            health_details=(
+                net.supervisor.health_summary
+                if net.supervisor is not None else None
+            ),
         )
         log.info("metrics endpoint on %s/metrics", stats_server.url)
     if args.stats_interval > 0:
@@ -266,6 +304,22 @@ def main(argv: list[str] | None = None) -> int:
         )
 
     peers = [a for a in args.peers.split(",") if a]
+    chaos_proxies = []
+    if peers and args.chaos_profile:
+        from noise_ec_tpu.resilience.chaos import ChaosProfile, ChaosProxy
+
+        profile = ChaosProfile.parse(args.chaos_profile)
+        proxied = []
+        for addr in peers:
+            host, port = TCPNetwork._split(addr)
+            proxy = ChaosProxy(
+                host, port, profile=profile, seed=args.chaos_seed
+            ).start()
+            chaos_proxies.append(proxy)
+            proxied.append(proxy.address)
+            log.info("chaos proxy %s -> %s (seed %d)",
+                     proxy.address, addr, args.chaos_seed)
+        peers = proxied
     if peers:
         net.bootstrap(peers)
 
@@ -326,6 +380,9 @@ def main(argv: list[str] | None = None) -> int:
         if stats_server is not None:
             stats_server.close()
         net.close()
+        for proxy in chaos_proxies:
+            proxy.close()
+            log.info("chaos stats: %s", proxy.stats())
         stats = stats_snapshot()
         if stats:
             log.info("session stats: %s", stats)

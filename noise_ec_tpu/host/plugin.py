@@ -20,6 +20,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import weakref
 from collections import OrderedDict
 from typing import Callable, Optional, Protocol
 
@@ -222,6 +223,40 @@ class ShardPlugin:
         self._novel_global: list = []
         self._novel_lock = threading.Lock()
         self._fec_host_cache: OrderedDict[tuple[int, int], FEC] = OrderedDict()
+        # NACK live shard repair (docs/resilience.md): a pool stuck with
+        # 0 < have < k distinct shards past the grace timeout re-sends
+        # its held shards — the PR-2 anti-entropy interest framing, over
+        # the plain SHARD opcode — first directly to the original sender
+        # (transport ``send_to``), then broadcast to peers; a peer (or
+        # the sender) storing the stripe answers with its trusted
+        # shards, which complete the pool through the ordinary receive
+        # path. Retries back off exponentially (capped); exhausting the
+        # budget records an ``outcome=incomplete`` e2e event.
+        # ``nack_grace_seconds = 0`` disables. The sweeper thread starts
+        # on the first stuck pool and exits when none remain.
+        self.nack_grace_seconds = 1.0
+        self.nack_max_retries = 4
+        self.nack_backoff_base = 0.5
+        self.nack_backoff_cap = 8.0
+        self._nack_lock = threading.Lock()
+        self._nack: OrderedDict[str, dict] = {}
+        self._nack_thread: Optional[threading.Thread] = None
+        self._network = lambda: None  # weakref to the attached transport
+        self._nack_requests = reg.counter(
+            "noise_ec_nack_requests_total"
+        ).labels()
+        self._nack_repaired = reg.counter(
+            "noise_ec_nack_repaired_total"
+        ).labels()
+        self._nack_giveups = reg.counter(
+            "noise_ec_nack_giveups_total"
+        ).labels()
+
+    def attach_network(self, network) -> None:
+        """Give the receive path a transport handle for NACK repair
+        (transports call this from ``add_plugin``; weakly held so a
+        plugin can never pin a closed network)."""
+        self._network = weakref.ref(network)
 
     # ---------------------------------------------------------------- codec
 
@@ -1255,6 +1290,125 @@ class ShardPlugin:
             log.warning("stripe store put failed for %s…: %s",
                         file_signature[:8].hex(), exc)
 
+    # ------------------------------------------------- NACK shard repair
+
+    def _nack_note(self, key: str, msg: Shard, ctx: PluginContext) -> None:
+        """An arriving shard left pool ``key`` below k: arm (or keep) its
+        NACK timer. Runs on the dispatch path — one lock, no I/O."""
+        if self.nack_grace_seconds <= 0 or self._network() is None:
+            return
+        now = time.monotonic()
+        with self._nack_lock:
+            st = self._nack.get(key)
+            if st is None:
+                self._nack[key] = {
+                    "sig": bytes(msg.file_signature),
+                    "k": int(msg.minimum_needed_shards),
+                    "n": int(msg.total_shards),
+                    "sender": self._sender_key(ctx),
+                    "retries": 0,
+                    "next_at": now + self.nack_grace_seconds,
+                }
+                # Bounded: keys are attacker-suppliable (one per forged
+                # first shard); evict oldest state, the pool TTL still
+                # owns the shares themselves.
+                while len(self._nack) > 4096:
+                    self._nack.popitem(last=False)
+            if self._nack_thread is None:
+                self._nack_thread = threading.Thread(
+                    target=self._nack_run, name="noise-ec-nack", daemon=True
+                )
+                self._nack_thread.start()
+
+    def _nack_resolve(self, key: str, delivered: bool = True) -> None:
+        """The pool completed (or became unrecoverable): retire its NACK
+        state; a delivery that needed at least one NACK round counts as
+        a repair."""
+        with self._nack_lock:
+            st = self._nack.pop(key, None)
+        if st is not None and delivered and st["retries"] > 0:
+            self._nack_repaired.add(1)
+
+    def _nack_run(self) -> None:
+        while True:
+            tick = max(
+                0.05, min(self.nack_grace_seconds, self.nack_backoff_base) / 4
+            )
+            time.sleep(tick)
+            try:
+                self._nack_sweep()
+            except Exception as exc:  # noqa: BLE001 — keep the sweeper up
+                log.warning("NACK sweep failed: %s", exc)
+            with self._nack_lock:
+                if not self._nack:
+                    # Idle: let the thread die; the next stuck pool
+                    # restarts it (tests build many short-lived plugins).
+                    self._nack_thread = None
+                    return
+
+    def _nack_sweep(self) -> None:
+        now = time.monotonic()
+        with self._nack_lock:
+            items = list(self._nack.items())
+        net = self._network()
+        for key, st in items:
+            entry = self.pool.get(key)
+            if entry is None:
+                # TTL'd or evicted underneath us: nothing left to repair.
+                with self._nack_lock:
+                    self._nack.pop(key, None)
+                continue
+            if entry.distinct() >= st["k"]:
+                continue  # decode path owns it; resolve happens there
+            if now < st["next_at"]:
+                continue
+            if st["retries"] >= self.nack_max_retries:
+                with self._nack_lock:
+                    self._nack.pop(key, None)
+                self._nack_giveups.add(1)
+                self._record_outcome("incomplete", entry.created_at)
+                log.warning(
+                    "object %s… stuck at %d/%d shards after %d NACK "
+                    "rounds; recording incomplete (pool TTL keeps the "
+                    "shards for late repair)", key[:16], entry.distinct(),
+                    st["k"], st["retries"],
+                )
+                continue
+            if net is None:
+                continue
+            shares, _ = self.pool.snapshot(key)
+            if not shares:
+                continue
+            shards = [
+                Shard(
+                    file_signature=st["sig"],
+                    shard_data=bytes(s.data),
+                    shard_number=s.number,
+                    total_shards=st["n"],
+                    minimum_needed_shards=st["k"],
+                )
+                for s in shares
+            ]
+            # Round 0 goes straight to the original sender (it stores
+            # its own broadcasts); on sender-silence the later rounds
+            # broadcast so any peer holding the stripe can answer.
+            sent_direct = False
+            send_to = getattr(net, "send_to", None)
+            if st["retries"] == 0 and st["sender"] and send_to is not None:
+                sent_direct = all(send_to(st["sender"], sh) for sh in shards)
+            if not sent_direct:
+                for sh in shards:
+                    net.broadcast(sh)
+            self._nack_requests.add(1)
+            with self._nack_lock:
+                cur = self._nack.get(key)
+                if cur is st:
+                    st["retries"] += 1
+                    st["next_at"] = now + min(
+                        self.nack_backoff_cap,
+                        self.nack_backoff_base * (2 ** (st["retries"] - 1)),
+                    )
+
     # -------------------------------------------------------- receive path
 
     def _record_outcome(self, outcome: str, started) -> None:
@@ -1335,7 +1489,11 @@ class ShardPlugin:
             self.counters.add("rejected_shards", 1)
             raise
         if distinct < k:
-            return None  # CASE A/B: keep accumulating (main.go:56-71)
+            # CASE A/B: keep accumulating (main.go:56-71) — and arm the
+            # NACK timer so a stalled pool asks for its missing shards
+            # instead of silently waiting out the TTL.
+            self._nack_note(key, msg, ctx)
+            return None
         if not was_new:
             # A replayed duplicate adds no information; don't pay another
             # decode + verify for it.
@@ -1362,6 +1520,7 @@ class ShardPlugin:
             if distinct >= n:
                 started = self._pool_started(key)
                 self.pool.evict(key)
+                self._nack_resolve(key, delivered=False)
                 self._record_outcome("corrupt", started)
                 raise CorruptionError(
                     f"all {n} shards arrived for {key[:16]}… but decode "
@@ -1384,6 +1543,7 @@ class ShardPlugin:
         if ok:
             started = self._pool_started(key)
             self.pool.evict(key)  # main.go:90-93
+            self._nack_resolve(key)
             if not self._mark_completed(key):
                 # A concurrent receive() already delivered this object
                 # between our pool snapshot and now; exactly-once holds.
@@ -1405,6 +1565,7 @@ class ShardPlugin:
             # unrecoverable (main.go:96-98 made reachable — see
             # CorruptionError docstring).
             self.pool.evict(key)
+            self._nack_resolve(key, delivered=False)
             self._record_outcome("corrupt", started)
             raise CorruptionError(
                 f"all {n} shards arrived for {key[:16]}… but the signature "

@@ -148,11 +148,13 @@ class Ctx:
 
 
 class FaultInjector:
-    """Deterministic link-fault model for the loopback transport.
+    """Deterministic link-fault model shared by the loopback transport and
+    the TCP chaos proxy (resilience/chaos.py).
 
     The reference has no fault-injection story at all (SURVEY.md §5 failure
     row); this is the first-class harness it calls for. Faults apply
-    per-delivery, driven by a seeded generator so every run reproduces:
+    per-delivery, driven by a seeded generator (``seed`` may be an int or
+    a ``numpy.random.SeedSequence``) so every run reproduces:
 
     - ``drop``: probability a delivery is discarded;
     - ``duplicate``: probability a delivery is made twice;
@@ -167,7 +169,7 @@ class FaultInjector:
 
     def __init__(
         self,
-        seed: int = 0,
+        seed=0,
         drop: float = 0.0,
         duplicate: float = 0.0,
         corrupt: float = 0.0,
@@ -217,6 +219,23 @@ class FaultInjector:
                     self.stats["delivered"] += 1
         return out
 
+    @property
+    def pending(self) -> int:
+        """Reorder-held deliveries not yet released (at most one per
+        link). The accounting identity every caller can rely on:
+        ``delivered + dropped + pending == inputs + duplicated``."""
+        return len(self._slots)
+
+    def flush(self, link: str = "") -> Optional[bytes]:
+        """Release ``link``'s reorder-held delivery, if any. Stream-end
+        hook (the chaos proxy calls it when a connection closes): a held
+        frame must be forwarded, not silently become an unaccounted
+        drop. Counts as delivered."""
+        held = self._slots.pop(link, None)
+        if held is not None:
+            self.stats["delivered"] += 1
+        return held
+
 
 class LoopbackHub:
     """An in-process peer set: every registered network sees every other."""
@@ -260,6 +279,9 @@ class LoopbackNetwork:
 
     def add_plugin(self, plugin) -> None:
         self.plugins.append(plugin)
+        attach = getattr(plugin, "attach_network", None)
+        if attach is not None:
+            attach(self)
 
     def _record_error(self, exc: Exception) -> None:
         self.errors.append(exc)
@@ -454,6 +476,12 @@ class _Peer:
     pid: PeerID
     writer: asyncio.StreamWriter
     is_dialer: bool = False  # we initiated the registered connection
+    # The address WE dialed to reach this peer (None on accepted
+    # connections). Distinct from pid.address — the peer's self-claimed
+    # address — and the one the supervisor must re-dial on loss: with a
+    # chaos proxy (or NAT) in between, the dialable address and the
+    # claimed address differ.
+    dial_address: Optional[str] = None
 
 
 class _Conn:
@@ -466,11 +494,13 @@ class _Conn:
     connection verifies as a signature but never matches the new nonce and
     never binds the victim's identity to the attacker's socket."""
 
-    def __init__(self, is_dialer: bool = False):
+    def __init__(self, is_dialer: bool = False,
+                 dial_address: Optional[str] = None):
         self.nonce = os.urandom(_NONCE_LEN)
         self.peer: Optional[PeerID] = None
         self.registered = asyncio.Event()
         self.is_dialer = is_dialer  # we initiated this connection
+        self.dial_address = dial_address  # the address we dialed (dialer side)
 
 
 class TCPNetwork:
@@ -509,6 +539,7 @@ class TCPNetwork:
         discovery: bool = True,
         max_discovered_peers: int = 64,
         discovery_interval: float = 2.0,
+        reconnect: bool = True,
     ):
         """Tuning knobs default to the reference's builder options
         (/root/reference/main.go:27-33): connection timeout 60s, recv/send
@@ -536,6 +567,12 @@ class TCPNetwork:
         mutual dials where each side keeps a different connection and
         closes the other's survivor, leaves a pair partitioned with no new
         registration event to retry on).
+
+        ``reconnect`` enables the self-healing peer lifecycle
+        (resilience/peers.py): loss of an ESTABLISHED connection we
+        dialed triggers supervised re-dial with exponential backoff +
+        full jitter, gated by a per-peer circuit breaker fed by dial
+        failures and write-timeout disconnects.
         """
         if protocol not in ("tcp", "kcp"):
             raise ValueError(
@@ -607,6 +644,14 @@ class TCPNetwork:
         # — the TCP-level handshake is a truer delay floor than an HTTP
         # poll of /spans.
         self._handshake_rtt: dict[str, float] = {}
+        # Set at the top of close(): the supervisor must not re-dial peers
+        # whose connections we are tearing down ourselves.
+        self._closing = False
+        self.supervisor = None
+        if reconnect:
+            from noise_ec_tpu.resilience.peers import PeerSupervisor
+
+            self.supervisor = PeerSupervisor(self)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -672,6 +717,10 @@ class TCPNetwork:
                 log.error("bootstrap %s failed: %s", addr, exc)
 
     def close(self) -> None:
+        self._closing = True
+        if self.supervisor is not None:
+            self.supervisor.close()
+
         async def _shutdown():
             if self._server is not None:
                 self._server.close()
@@ -696,6 +745,11 @@ class TCPNetwork:
 
     def add_plugin(self, plugin) -> None:
         self.plugins.append(plugin)
+        # Plugins that can talk back on the receive path (the NACK shard
+        # repair in host/plugin.py) get a transport handle.
+        attach = getattr(plugin, "attach_network", None)
+        if attach is not None:
+            attach(self)
 
     def _record_error(self, exc: Exception) -> None:
         self.errors.append(exc)
@@ -774,6 +828,26 @@ class TCPNetwork:
         for w in writers:
             self._loop.call_soon_threadsafe(self._enqueue_frame, w, frame)
 
+    def send_to(self, public_key: bytes, msg: Shard) -> bool:
+        """Send one signed shard frame to a single registered peer
+        (directed NACK repair — host/plugin.py; broadcast semantics are
+        otherwise unchanged). Returns False when no registered peer holds
+        ``public_key``."""
+        with self._lock:
+            peer = self.peers.get(bytes(public_key))
+            if peer is None:
+                return False
+            writer = peer.writer
+            address = peer.pid.address
+        frame = self._frame(_OP_SHARD, msg.marshal())
+        transport_metrics().record_out(address, len(frame))
+        with self._lock:
+            self._posted_bytes[writer] = (
+                self._posted_bytes.get(writer, 0) + len(frame)
+            )
+        self._loop.call_soon_threadsafe(self._enqueue_frame, writer, frame)
+        return True
+
     def wait_writable(
         self,
         soft_cap: Optional[int] = None,
@@ -798,6 +872,14 @@ class TCPNetwork:
         caller proceeds — a genuinely stalled peer is then the hard
         cap's and write_timeout's job to drop.
         """
+        if threading.get_ident() == self._thread.ident:
+            # Called on the event-loop thread: the drain this would wait
+            # for runs ON this thread, so blocking here deadlocks until
+            # the timeout with zero progress. No current caller does this
+            # (the stream emitter runs on the producer's thread); the
+            # guard keeps a future loop-side caller from wedging the
+            # whole transport. No-op — the hard cap still protects memory.
+            return
         if soft_cap is None:
             # Derive from the hard cap MINUS what the caller is about to
             # enqueue (``headroom``): waiting to "half full" is not
@@ -893,7 +975,10 @@ class TCPNetwork:
             self._record_error(
                 RuntimeError(f"write timeout ({self.write_timeout}s); disconnected")
             )
-            self._drop_writer(writer)
+            # "write_timeout" feeds the peer's circuit breaker: a reader
+            # that cannot drain is peer-health evidence, not just a
+            # buffer-management event.
+            self._drop_writer(writer, reason="write_timeout")
         except Exception as exc:  # noqa: BLE001
             self._record_error(exc)
             self._drop_writer(writer)
@@ -914,13 +999,18 @@ class TCPNetwork:
         except Exception as exc:  # noqa: BLE001
             self._record_error(exc)
 
-    def _drop_writer(self, writer: asyncio.StreamWriter) -> None:
+    def _drop_writer(self, writer: asyncio.StreamWriter,
+                     reason: str = "") -> None:
+        lost_dialed: list[str] = []
         with self._lock:
             for key, p in list(self.peers.items()):
                 if p.writer is writer:
                     del self.peers[key]
                     # Allow gossip to re-establish a churned peer.
                     self._dialing.discard(p.pid.address)
+                    if p.dial_address is not None:
+                        self._dialing.discard(p.dial_address)
+                        lost_dialed.append(p.dial_address)
         handle = self._flush_handles.pop(writer, None)
         if handle is not None:
             handle.cancel()
@@ -932,6 +1022,13 @@ class TCPNetwork:
             writer.close()
         except Exception:  # noqa: BLE001
             pass
+        # Established-connection loss of a peer WE dialed: hand the dialed
+        # address to the supervisor for backoff-gated re-dial. After the
+        # peer-table cleanup above, so the supervisor's is-alive check
+        # cannot race the stale entry.
+        if self.supervisor is not None and not self._closing:
+            for address in lost_dialed:
+                self.supervisor.on_connection_lost(address, reason)
 
     async def _dial(self, address: str) -> None:
         # Idempotent: dialing an address we already hold a registered
@@ -961,7 +1058,7 @@ class TCPNetwork:
             # dialing this address again.
             self._dialing.discard(address)
             raise
-        conn = _Conn(is_dialer=True)
+        conn = _Conn(is_dialer=True, dial_address=address)
         try:
             t_hello = time.perf_counter()
             writer.write(self._frame(_OP_HELLO, conn.nonce))
@@ -1066,7 +1163,10 @@ class TCPNetwork:
                         self.keys.public_key < pid.public_key
                     )
             if keep_new:
-                self.peers[pid.public_key] = _Peer(pid, writer, conn.is_dialer)
+                self.peers[pid.public_key] = _Peer(
+                    pid, writer, conn.is_dialer,
+                    dial_address=conn.dial_address,
+                )
         if prev is not None and prev.writer is not writer:
             # Close the loser; its read-loop teardown calls _drop_writer,
             # which only removes entries whose writer matches — the
@@ -1081,6 +1181,10 @@ class TCPNetwork:
             # when a peer becomes reachable instead of probing with
             # retried sends.
             log.info("registered peer %s", pid.address)
+            if self.supervisor is not None and conn.dial_address is not None:
+                # Any successful dial (bootstrap, discovery or supervised
+                # re-dial) closes the address's breaker.
+                self.supervisor.breaker(conn.dial_address).record_success()
         if self.discovery and others and keep_new:
             # Peer exchange (the reference's discovery.Plugin, main.go:151):
             # tell the newcomer who we know, and announce the newcomer to

@@ -413,12 +413,27 @@ def _data_from_coeffs(
 # gather/scatter traffic exceeds the extra matmul width.
 _GATHER_CAP = 1 << 16
 
-# Speculative fused single-row decode: probe this many leading columns; if
-# most are bad and one received basis row explains the sampled ones, run
-# the one-pass fused kernel over the full width. Only worth arming above
-# _SPECULATE_MIN_S (below it the generic path's extra passes are cheap).
+# Speculative fused single-row decode: probe this many leading BYTES'
+# worth of columns; if most are bad and one received basis row explains
+# the sampled ones, run the one-pass fused kernel over the full width.
+# Only worth arming above _SPECULATE_MIN_S (below it the generic path's
+# extra passes are cheap). Both thresholds are BYTE budgets — the
+# pass-cost they model scales with bytes moved — while ``rows[0].size``
+# counts SYMBOLS, so the gate must scale by the field's symbol width
+# (without it, GF(2^16) armed at 2x the intended threshold and probed 2x
+# the intended prefix — advisor r5).
 _PROBE_S = 32 << 10
 _SPECULATE_MIN_S = 256 << 10
+
+
+def _probe_symbols(gf: "GF") -> int:
+    """_PROBE_S expressed in this field's symbols."""
+    return _PROBE_S // np.dtype(gf.dtype).itemsize
+
+
+def _speculate_min_symbols(gf: "GF") -> int:
+    """_SPECULATE_MIN_S expressed in this field's symbols."""
+    return _SPECULATE_MIN_S // np.dtype(gf.dtype).itemsize
 
 
 def _try_fused_single_row(
@@ -457,7 +472,7 @@ def _try_fused_single_row(
     from noise_ec_tpu.shim import gf16_decode1_fused, gf_decode1_fused
 
     S = rows[0].size
-    probe = min(_PROBE_S, S)
+    probe = min(_probe_symbols(gf), S)
     res = _syndrome(gf, A, [r_[:probe] for r_ in rows], k)
     s_p, counts_p = res
     bad_p = np.flatnonzero(counts_p > e)
@@ -511,7 +526,8 @@ def _maybe_fused_single_row(
     max_support into ``speculate``). NotImplemented = generic path."""
     if not (
         speculate and e >= 1 and device is None
-        and gf.degree in (8, 16) and rows[0].size >= _SPECULATE_MIN_S
+        and gf.degree in (8, 16)
+        and rows[0].size >= _speculate_min_symbols(gf)
     ):
         return NotImplemented
     try:

@@ -17,7 +17,11 @@ Outcomes (the bounded ``outcome`` label set):
 - ``verify_failed`` — a reassembled object failed its signature verify
   (may later repair and also record ``ok``);
 - ``corrupt`` — unrecoverable (`CorruptionError`): every shard arrived
-  and the object still cannot decode/verify.
+  and the object still cannot decode/verify;
+- ``incomplete`` — a pool stuck below k shards exhausted the NACK
+  repair budget (host/plugin.py) without completing; the object may
+  still arrive later (announce / late shards) and then also record
+  ``ok``.
 """
 
 from __future__ import annotations
@@ -31,7 +35,8 @@ from noise_ec_tpu.obs.registry import Registry, default_registry
 
 __all__ = ["SLOEvaluator", "default_slo", "record_e2e"]
 
-E2E_OUTCOMES: tuple[str, ...] = ("ok", "verify_failed", "corrupt")
+E2E_OUTCOMES: tuple[str, ...] = ("ok", "verify_failed", "corrupt",
+                                 "incomplete")
 
 
 class SLOEvaluator:

@@ -73,7 +73,8 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
     "noise_ec_e2e_latency_seconds": (
         "histogram",
         "End-to-end receive-path latency (first shard seen to object "
-        "completion), labeled by outcome (ok, verify_failed, corrupt)",
+        "completion), labeled by outcome (ok, verify_failed, corrupt, "
+        "incomplete)",
         ("outcome",),
     ),
     "noise_ec_stage_seconds": (
@@ -241,6 +242,55 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
     "noise_ec_store_anti_entropy_responses_total": (
         "counter",
         "Anti-entropy responses answered with local shards",
+        (),
+    ),
+    # --- resilience (noise_ec_tpu/resilience, docs/resilience.md)
+    "noise_ec_peer_circuit_state": (
+        "gauge",
+        "Per-peer re-dial circuit breaker state (0 closed, 1 open, "
+        "2 half-open), labeled by dialed peer address",
+        ("peer",),
+    ),
+    "noise_ec_reconnect_total": (
+        "counter",
+        "Supervised re-dials of lost established connections, labeled "
+        "by result (ok, failed)",
+        ("result",),
+    ),
+    "noise_ec_nack_requests_total": (
+        "counter",
+        "NACK shard-repair requests sent for pools stuck below k after "
+        "the grace timeout",
+        (),
+    ),
+    "noise_ec_nack_repaired_total": (
+        "counter",
+        "Objects delivered after at least one NACK repair round",
+        (),
+    ),
+    "noise_ec_nack_giveups_total": (
+        "counter",
+        "NACK repairs abandoned after the retry budget (records an "
+        "outcome=incomplete e2e event)",
+        (),
+    ),
+    "noise_ec_codec_fallback_total": (
+        "counter",
+        "Encode/reconstruct calls served by the golden host codec "
+        "instead of the device route, labeled by reason (error = device "
+        "dispatch failed after retry, open = breaker short-circuit)",
+        ("reason",),
+    ),
+    "noise_ec_codec_circuit_state": (
+        "gauge",
+        "Codec device-route circuit breaker state (0 closed, 1 open, "
+        "2 half-open)",
+        (),
+    ),
+    "noise_ec_store_announces_total": (
+        "counter",
+        "Anti-entropy announce broadcasts of recently stored stripes "
+        "(one shard each; silent-partition recovery)",
         (),
     ),
     # --- shard mempool (host/mempool.py)

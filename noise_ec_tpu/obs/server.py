@@ -53,6 +53,10 @@ class StatsServer:
     prefixes to plain :class:`Counters` bags (see obs/export.py).
     ``slo`` wires a :class:`SLOEvaluator` verdict into ``/healthz``
     (None keeps the plain always-200 liveness probe).
+    ``health_details`` is an optional zero-arg callable whose dict is
+    folded into the ``/healthz`` JSON body (e.g. the peer supervisor's
+    circuit-breaker summary, resilience/peers.py) — served alongside the
+    verdict on 503, and on 200 via ``/healthz?verbose=1``.
     """
 
     def __init__(
@@ -64,11 +68,13 @@ class StatsServer:
         tracer: Optional[Tracer] = None,
         extra_counters: Optional[dict[str, Counters]] = None,
         slo: Optional[SLOEvaluator] = None,
+        health_details: Optional[Callable[[], dict]] = None,
     ):
         self.registry = registry
         self.tracer = tracer if tracer is not None else default_tracer()
         self.extra_counters = dict(extra_counters or {})
         self.slo = slo
+        self.health_details = health_details
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -105,12 +111,25 @@ class StatsServer:
                     body = json.dumps(doc, indent=1).encode()
                     self._reply(200, "application/json", body)
                 elif url.path == "/healthz":
-                    if outer.slo is None:
-                        self._reply(200, "text/plain", b"ok\n")
-                        return
-                    verdict = outer.slo.verdict()
+                    verbose = "verbose" in parse_qs(url.query)
+                    verdict = (
+                        outer.slo.verdict() if outer.slo is not None
+                        else {"healthy": True, "reason": None}
+                    )
+                    if outer.health_details is not None:
+                        try:
+                            verdict["details"] = outer.health_details()
+                        except Exception as exc:  # noqa: BLE001 — health
+                            # detail must never break the probe itself
+                            verdict["details"] = {"error": str(exc)}
                     if verdict["healthy"]:
-                        self._reply(200, "text/plain", b"ok\n")
+                        if verbose:
+                            self._reply(
+                                200, "application/json",
+                                json.dumps(verdict, indent=1).encode(),
+                            )
+                        else:
+                            self._reply(200, "text/plain", b"ok\n")
                     else:
                         self._reply(
                             503, "application/json",

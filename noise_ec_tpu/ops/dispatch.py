@@ -19,6 +19,10 @@ Kernel selection:
 from __future__ import annotations
 
 import functools
+import logging
+import threading
+import time
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -41,8 +45,132 @@ from noise_ec_tpu.obs.profiling import record_kernel
 
 _FIELDS = {"gf256": GF256, "gf65536": GF65536}
 
+log = logging.getLogger("noise_ec_tpu.ops")
+
 # Jitted shape-generic planes-level matmul (retraces per shape, cached by jit).
 _gf2_matmul_jax_jit = jax.jit(gf2_matmul_jax)
+
+
+# ------------------------------------------------- codec graceful degradation
+#
+# The device is ONE process-wide resource: when a dispatch fails (XLA
+# runtime error, preempted/recycled device, injected fault), every codec
+# sharing it will fail the same way — so the circuit breaker guarding the
+# device route is process-wide too. codec callers (codec/rs.py _mul)
+# consult it around each device matmul: a failure is retried once
+# in-call (transient allocator hiccups recover), a second failure trips
+# the breaker and the call — and every call while it is open — runs the
+# golden host arithmetic instead (noise_ec_codec_fallback_total{reason}).
+# A background prober re-tries a tiny canary matmul on the breaker's
+# widening half-open schedule and closes it when the device answers
+# correctly again (noise_ec_codec_circuit_state 1 -> 2 -> 0).
+
+_codec_breaker = None
+_codec_breaker_lock = threading.Lock()
+_fallback_children: dict[str, object] = {}
+_prober_thread: Optional[threading.Thread] = None
+_probe_dev = None
+
+
+def codec_breaker():
+    """The process-wide device-route breaker (lazy singleton)."""
+    global _codec_breaker
+    with _codec_breaker_lock:
+        if _codec_breaker is None:
+            from noise_ec_tpu.obs.registry import default_registry
+            from noise_ec_tpu.resilience.breakers import CircuitBreaker
+
+            _codec_breaker = CircuitBreaker(
+                failure_threshold=1,  # the in-call retry already absorbed
+                # one failure; a second is a tripped route
+                reset_timeout=5.0,
+                max_reset_timeout=60.0,
+            )
+            default_registry().gauge(
+                "noise_ec_codec_circuit_state"
+            ).set_callback(lambda: _codec_breaker.state_code())
+        return _codec_breaker
+
+
+def configure_codec_breaker(**kwargs):
+    """Replace the process breaker (tests shrink the timeouts; a fresh
+    instance also resets state). Returns the new breaker."""
+    global _codec_breaker
+    from noise_ec_tpu.obs.registry import default_registry
+    from noise_ec_tpu.resilience.breakers import CircuitBreaker
+
+    with _codec_breaker_lock:
+        _codec_breaker = CircuitBreaker(
+            failure_threshold=kwargs.pop("failure_threshold", 1), **kwargs
+        )
+        default_registry().gauge("noise_ec_codec_circuit_state").set_callback(
+            lambda: _codec_breaker.state_code()
+        )
+        return _codec_breaker
+
+
+def record_codec_fallback(reason: str) -> None:
+    child = _fallback_children.get(reason)
+    if child is None:
+        from noise_ec_tpu.obs.registry import default_registry
+
+        child = _fallback_children[reason] = default_registry().counter(
+            "noise_ec_codec_fallback_total"
+        ).labels(reason=reason)
+    child.add(1)
+
+
+def _probe_device() -> None:
+    """Canary: one tiny encode-shaped matmul, checked against the host
+    truth. Raises when the device route is still broken."""
+    global _probe_dev
+    if _probe_dev is None:
+        _probe_dev = DeviceCodec(field="gf256")
+    M = np.array([[1, 1], [1, 2]], dtype=np.uint8)
+    D = np.arange(2 * 64, dtype=np.uint8).reshape(2, 64)
+    out = np.asarray(_probe_dev.matmul_stripes(M, D))
+    from noise_ec_tpu.matrix.hostmath import host_matvec
+
+    want = host_matvec(_probe_dev.gf, M, D)
+    if out.shape != want.shape or not np.array_equal(out, want):
+        raise RuntimeError("codec probe produced wrong bytes")
+
+
+def ensure_codec_prober() -> None:
+    """Run the background half-open prober while the breaker is not
+    closed (idempotent; the thread exits once the breaker closes)."""
+    global _prober_thread
+    with _codec_breaker_lock:
+        if _prober_thread is not None and _prober_thread.is_alive():
+            return
+        _prober_thread = threading.Thread(
+            target=_probe_loop, name="noise-ec-codec-probe", daemon=True
+        )
+        _prober_thread.start()
+
+
+def _probe_loop() -> None:
+    br = codec_breaker()
+    while True:
+        if br.closed:
+            return
+        remaining = br.open_remaining()
+        if remaining > 0:
+            time.sleep(min(remaining, 0.05))
+            continue
+        if not br.allow():  # another caller holds the half-open probe
+            time.sleep(0.02)
+            continue
+        try:
+            _probe_device()
+        except Exception as exc:  # noqa: BLE001 — any failure keeps it open
+            br.record_failure()
+            log.warning("codec device probe failed: %s (breaker re-opened "
+                        "for %.1fs)", exc, br.open_remaining())
+        else:
+            br.record_success()
+            log.info("codec device probe succeeded; device route restored")
+            return
 
 
 def _resolve_kernel(kernel: str) -> str:

@@ -1,0 +1,32 @@
+"""Resilience: chaos injection and the self-healing machinery it proves.
+
+The subsystem closes the loop SURVEY.md §5 leaves open (no fault-injection
+story) against the ROADMAP's serve-heavy-traffic north star: inject faults
+deterministically on the *real* transport, then heal from them.
+
+- :mod:`noise_ec_tpu.resilience.chaos` — a seeded in-process TCP proxy
+  applying the :class:`~noise_ec_tpu.host.transport.FaultInjector` fault
+  model plus link-level faults (delay, bandwidth caps, resets,
+  directional partitions with scheduled heals, peer kill/restart).
+- :mod:`noise_ec_tpu.resilience.breakers` — the circuit breaker shared by
+  the per-peer transport lifecycle and the codec device route.
+- :mod:`noise_ec_tpu.resilience.peers` — the self-healing peer
+  supervisor: re-dial with exponential backoff + full jitter, gated per
+  peer by a breaker whose state exports as
+  ``noise_ec_peer_circuit_state``.
+
+See docs/resilience.md for the fault model, chaos profiles, breaker
+states and the NACK shard-repair flow.
+"""
+
+from noise_ec_tpu.resilience.breakers import CircuitBreaker
+from noise_ec_tpu.resilience.chaos import ChaosLink, ChaosProfile, ChaosProxy
+from noise_ec_tpu.resilience.peers import PeerSupervisor
+
+__all__ = [
+    "ChaosLink",
+    "ChaosProfile",
+    "ChaosProxy",
+    "CircuitBreaker",
+    "PeerSupervisor",
+]

@@ -318,10 +318,14 @@ def gf_decode1_fused(
     Ab = np.ascontiguousarray(A, dtype=np.uint8)
     r2, k = Ab.shape
     if r2 > 255:
-        # The C kernel's per-column counter is uint8: more check rows
-        # would wrap the count and silently mis-classify columns (same
-        # guard as gf_syndrome_rows). Reachable via custom generator
-        # matrices through syndrome_decode_rows_any; NumPy fallback.
+        # Conservative parity with gf_syndrome_rows: the fused kernel is
+        # count-free (it thresholds per column without materializing a
+        # counter), so r2 > 255 would not wrap anything here — but the
+        # syndrome kernel's uint8 per-column counter DOES cap at 255
+        # check rows, and the two paths must refuse the same inputs so a
+        # decode can't succeed fused yet fail when the probe routes it
+        # generically. Reachable via custom generator matrices through
+        # syndrome_decode_rows_any; NumPy fallback.
         return None
     out = np.empty(length, dtype=np.uint8)
     state = np.empty(length, dtype=np.uint8)

@@ -89,6 +89,9 @@ class _EngineMetrics:
         self.responses = reg.counter(
             "noise_ec_store_anti_entropy_responses_total"
         ).labels()
+        self.announces = reg.counter(
+            "noise_ec_store_announces_total"
+        ).labels()
         cls = _EngineMetrics
         if not cls._registered:
             cls._registered = True
@@ -110,6 +113,9 @@ class RepairEngine:
         linger_seconds: float = 0.05,
         fetch_interval_seconds: float = 30.0,
         respond_interval_seconds: float = 30.0,
+        announce_interval_seconds: float = 0.0,
+        announce_window_seconds: float = 60.0,
+        announce_max_stripes: int = 64,
     ):
         self.store = store
         self.network = network
@@ -118,6 +124,18 @@ class RepairEngine:
         self.linger_seconds = linger_seconds
         self.fetch_interval_seconds = fetch_interval_seconds
         self.respond_interval_seconds = respond_interval_seconds
+        # Anti-entropy ANNOUNCE (docs/resilience.md): every interval,
+        # broadcast ONE trusted shard of each stripe stored within the
+        # last ``announce_window_seconds`` (capped). Peers holding the
+        # stripe absorb it silently; peers that never received the
+        # object open a 1-of-k pool, whose NACK grace timer then pulls
+        # the full stripe — the recovery path for SILENT loss (a
+        # directional partition drops every shard, so the receiver has
+        # nothing to NACK from). 0 disables (the default: announce is a
+        # broadcast tax only resilience-minded deployments opt into).
+        self.announce_interval_seconds = announce_interval_seconds
+        self.announce_window_seconds = announce_window_seconds
+        self.announce_max_stripes = announce_max_stripes
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: OrderedDict[str, str] = {}  # key -> kind
@@ -193,12 +211,37 @@ class RepairEngine:
             self._thread = None
 
     def _run(self) -> None:
+        next_announce = (
+            time.monotonic() + self.announce_interval_seconds
+            if self.announce_interval_seconds > 0 else None
+        )
         while True:
             with self._cond:
                 while not self._queue and not self._closed:
-                    self._cond.wait()
+                    if next_announce is None:
+                        self._cond.wait()
+                    else:
+                        remaining = next_announce - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(timeout=remaining)
                 if self._closed:
                     return
+            if (
+                next_announce is not None
+                and time.monotonic() >= next_announce
+            ):
+                next_announce = (
+                    time.monotonic() + self.announce_interval_seconds
+                )
+                try:
+                    self.announce_once()
+                except Exception as exc:  # noqa: BLE001 — keep the worker up
+                    log.error("announce failed: %s", exc)
+            with self._lock:
+                has_work = bool(self._queue)
+            if not has_work:
+                continue
             # Linger so same-shape repairs arriving in a burst (a scrub
             # cycle, a dying device) coalesce into one batched dispatch.
             if self.linger_seconds > 0:
@@ -513,6 +556,36 @@ class RepairEngine:
             "anti-entropy request for stripe %s (%d/%d trusted shards "
             "survive)", key, len(trusted), meta.n,
         )
+
+    def announce_once(self) -> int:
+        """Broadcast ONE trusted shard per recently stored stripe (see
+        the ``announce_interval_seconds`` doc in ``__init__``). Returns
+        the number of stripes announced. Deterministic entry point for
+        tests; the background thread calls it on the interval."""
+        if self.network is None:
+            return 0
+        peers = getattr(self.network, "peers", None)
+        if peers is not None and not peers:
+            return 0  # nobody listening; the next interval retries
+        announced = 0
+        for key in self.store.recent_keys(
+            self.announce_window_seconds, self.announce_max_stripes
+        ):
+            try:
+                meta, shards, unverified = self.store.snapshot(key)
+            except UnknownStripeError:
+                continue
+            trusted = [
+                i for i, s in enumerate(shards)
+                if s is not None and i not in unverified
+            ]
+            if not trusted:
+                continue
+            self._broadcast_shards(meta, shards, trusted[:1])
+            announced += 1
+        if announced:
+            self.metrics.announces.add(announced)
+        return announced
 
     def _respond(self, key: str) -> None:
         if self.network is None:

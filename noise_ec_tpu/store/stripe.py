@@ -29,6 +29,7 @@ import json
 import logging
 import os
 import threading
+import time
 import weakref
 from dataclasses import dataclass, field
 from typing import Callable, Iterable, Optional
@@ -87,6 +88,11 @@ class _Stripe:
     meta: StripeMeta
     shards: list  # Optional[bytes] per slot, length n
     unverified: set = field(default_factory=set)  # slot numbers
+    # Local arrival time (monotonic): drives the repair engine's
+    # anti-entropy ANNOUNCE of recently stored stripes. Stripes loaded
+    # from disk stamp load time — after a restart they ARE news to peers
+    # that churned while we were down.
+    created_at: float = field(default_factory=time.monotonic)
 
     def present(self) -> list[int]:
         return [i for i, s in enumerate(self.shards) if s is not None]
@@ -320,6 +326,20 @@ class StripeStore:
     def __len__(self) -> int:
         with self._lock:
             return len(self._stripes)
+
+    def recent_keys(self, window_seconds: float,
+                    limit: int = 64) -> list[str]:
+        """Keys of stripes stored within the last ``window_seconds``,
+        newest first, capped at ``limit`` (the announce working set)."""
+        cutoff = time.monotonic() - window_seconds
+        with self._lock:
+            fresh = [
+                (s.created_at, key)
+                for key, s in self._stripes.items()
+                if s.created_at >= cutoff
+            ]
+        fresh.sort(reverse=True)
+        return [key for _, key in fresh[:limit]]
 
     def keys(self) -> list[str]:
         with self._lock:

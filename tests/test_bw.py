@@ -1205,3 +1205,42 @@ def test_gathered_two_row_supports_fall_through_to_rounds(rng):
     res = syndrome_decode_rows(gf, "cauchy", k, n, list(range(n)), rows)
     assert res is not None
     np.testing.assert_array_equal(np.stack(res[0]), data)
+
+
+def test_speculation_gate_thresholds_are_byte_budgets():
+    """_SPECULATE_MIN_S / _PROBE_S are BYTE budgets; the gate compares
+    symbol counts, so both must scale by the field's symbol width.
+    Before the fix, GF(2^16) armed at 2x the intended threshold (256Ki
+    symbols = 512 KiB) and probed a 2x-too-wide prefix (advisor r5)."""
+    from noise_ec_tpu.matrix import bw
+
+    assert bw._speculate_min_symbols(GF256()) == bw._SPECULATE_MIN_S
+    assert bw._speculate_min_symbols(GF65536()) == bw._SPECULATE_MIN_S // 2
+    assert bw._probe_symbols(GF256()) == bw._PROBE_S
+    assert bw._probe_symbols(GF65536()) == bw._PROBE_S // 2
+
+
+@pytest.mark.parametrize("field_cls", [GF256, GF65536])
+def test_speculation_gate_arms_at_byte_threshold(monkeypatch, field_cls):
+    """Behavioral pin: the fused-single-row speculation arms exactly at
+    _SPECULATE_MIN_S BYTES of stripe width for both shim fields."""
+    from noise_ec_tpu.matrix import bw
+
+    gf = field_cls()
+    sentinel = object()
+    monkeypatch.setattr(
+        bw, "_try_fused_single_row",
+        lambda *a, **k: sentinel,
+    )
+    width = bw._speculate_min_symbols(gf)
+
+    def run(S):
+        rows = [np.zeros(S, dtype=gf.dtype)]
+        return bw._maybe_fused_single_row(
+            gf, 4, [0, 1, 2, 3, 4, 5], rows, np.eye(4, dtype=gf.dtype),
+            np.zeros((2, 4), dtype=gf.dtype), 1, True,
+            recurse=None, device=None, speculate=True,
+        )
+
+    assert run(width) is sentinel
+    assert run(width - 1) is NotImplemented

@@ -27,6 +27,13 @@ and cross-checks every referenced name against the declarative registry:
 - **docs drift**: every declared registry family must appear in
   ``docs/observability.md`` — an undocumented series is invisible to
   the operator the docs' metric table exists for;
+- **resilience docs parity**: the resilience metric families
+  (``noise_ec_peer_*``, ``noise_ec_reconnect_*``, ``noise_ec_nack_*``,
+  ``noise_ec_codec_*``, the store announce counter) must ALSO appear in
+  ``docs/resilience.md`` — that doc owns the fault model those series
+  instrument, the same two-home rule the ``noise_ec_store_*`` family
+  follows with docs/store.md's metric table living in
+  observability.md;
 - **span schema drift**: every span dict field
   (``obs.trace.SPAN_FIELDS``) and every ``/spans`` dump-document key
   (``obs.server.SPANS_DOC_FIELDS``) must be documented (backticked) in
@@ -139,7 +146,41 @@ def check() -> list[str]:
                 "declared in obs.registry.PIPELINE_STAGES"
             )
     problems.extend(check_docs())
+    problems.extend(check_resilience_docs())
     return problems
+
+
+# The metric families owned by the resilience subsystem (plus the store's
+# announce counter, which the resilience doc's silent-loss recovery flow
+# depends on). Each must be documented in docs/resilience.md as well as
+# the generic observability table.
+RESILIENCE_PREFIXES = (
+    "noise_ec_peer_",
+    "noise_ec_reconnect_",
+    "noise_ec_nack_",
+    "noise_ec_codec_",
+)
+RESILIENCE_EXTRAS = ("noise_ec_store_announces_total",)
+
+
+def check_resilience_docs() -> list[str]:
+    """Resilience families vs docs/resilience.md (module docstring)."""
+    from noise_ec_tpu.obs.registry import METRICS
+
+    doc_path = REPO / "docs" / "resilience.md"
+    names = [
+        n for n in METRICS if n.startswith(RESILIENCE_PREFIXES)
+    ] + [n for n in RESILIENCE_EXTRAS if n in METRICS]
+    if not names:
+        return []
+    if not doc_path.exists():
+        return [f"docs file {doc_path} missing (resilience metrics exist)"]
+    text = doc_path.read_text(encoding="utf-8")
+    return [
+        f"resilience metric {n!r} is not documented in docs/resilience.md"
+        for n in names
+        if not re.search(rf"\b{re.escape(n)}\b", text)
+    ]
 
 
 def check_docs() -> list[str]:
