@@ -684,6 +684,24 @@ def main() -> None:
         t_enc = (time.perf_counter() - t0) / 3
         gbps = data_bytes / t_enc / 1e9
 
+    # Device telemetry summary (obs/device.py): per-kernel achieved GB/s
+    # and roofline utilization from the execute-route dispatch stats, the
+    # HBM snapshot, and the recompile count the run accumulated — the
+    # same series a live node serves on /metrics, folded into the bench
+    # artifact so the recorded trajectory carries them too (bench_gate
+    # skips them: they describe the run, not the perf contract).
+    try:
+        from noise_ec_tpu.obs.device import roofline_summary
+        from noise_ec_tpu.obs.registry import default_registry
+
+        stats.update(roofline_summary())
+        compiles = default_registry().counter("noise_ec_jit_compiles_total")
+        total_compiles = sum(c.value for _, c in compiles.children())
+        if total_compiles:
+            stats["device_jit_compiles"] = int(total_compiles)
+    except Exception as exc:  # noqa: BLE001 — telemetry must not fail bench
+        stats["device_obs_error"] = str(exc)[:80]
+
     stats["encode_s"] = t_enc
     print(
         json.dumps(

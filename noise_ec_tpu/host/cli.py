@@ -74,6 +74,24 @@ def build_parser() -> argparse.ArgumentParser:
         "(view with tensorboard's profile plugin)",
     )
     p.add_argument(
+        "-xprof-dir",
+        default="",
+        metavar="DIR",
+        help="enable on-demand xprof capture: GET /xprof?seconds=N on the "
+        "stats endpoint records a JAX/XLA profiler trace of the next N "
+        "seconds into DIR (a live decode burst, without -trace's "
+        "whole-session capture); requires -metrics-port",
+    )
+    p.add_argument(
+        "-profile",
+        action="store_true",
+        help="start the always-on sampling profiler (~50 Hz folded Python "
+        "stacks, obs/sampler.py) at startup; GET /profile?seconds=N on "
+        "the stats endpoint serves the last N seconds as flamegraph-ready "
+        "collapsed text (without this flag the sampler starts lazily on "
+        "the first /profile request)",
+    )
+    p.add_argument(
         "-recv-dir",
         default="",
         metavar="DIR",
@@ -266,14 +284,25 @@ def main(argv: list[str] | None = None) -> int:
         stats.update(kernel_counters.snapshot())
         return stats
 
+    sampler = None
+    if args.profile:
+        from noise_ec_tpu.obs.sampler import default_sampler
+
+        sampler = default_sampler()
+        log.info("sampling profiler running (~%.0f Hz)", sampler.hz)
+
     stats_server = reporter = None
     if args.metrics_port >= 0:
         stats_server = StatsServer(
             port=args.metrics_port,
+            # Kernel call/byte series are registry families now
+            # (noise_ec_kernel_{calls,bytes}_total{entry}); only the
+            # plugin's state-machine bag still rides the prefix path.
             extra_counters={
                 "noise_ec_plugin": plugin.counters,
-                "noise_ec_kernel": kernel_counters,
             },
+            sampler=sampler,
+            xprof_dir=args.xprof_dir or None,
             # /healthz answers 503 with the verdict JSON once the
             # receive path burns the rolling SLO window (obs/health.py)
             # — orchestrators can restart/deweight on it.
@@ -286,6 +315,9 @@ def main(argv: list[str] | None = None) -> int:
             ),
         )
         log.info("metrics endpoint on %s/metrics", stats_server.url)
+        if args.xprof_dir:
+            log.info("xprof capture armed: GET %s/xprof?seconds=N -> %s",
+                     stats_server.url, args.xprof_dir)
     if args.stats_interval > 0:
         reporter = PeriodicReporter(args.stats_interval, stats_snapshot, log)
 
@@ -379,6 +411,8 @@ def main(argv: list[str] | None = None) -> int:
                 log.error("trace export failed: %s", exc)
         if stats_server is not None:
             stats_server.close()
+        if sampler is not None:
+            sampler.close()
         net.close()
         for proxy in chaos_proxies:
             proxy.close()

@@ -23,13 +23,25 @@ has to come from structured telemetry, not log archaeology:
 - :mod:`obs.perfetto` — Chrome trace-event (Perfetto) export of merged
   traces;
 - :mod:`obs.health` — end-to-end outcome recording and the rolling SLO
-  evaluator whose verdict drives ``/healthz``.
+  evaluator whose verdict drives ``/healthz``;
+- :mod:`obs.device` — device telemetry: per-dispatch latency with a
+  compile/execute split, recompile counters, roofline (cost_analysis
+  FLOPs/bytes, achieved-vs-peak utilization) and HBM gauges;
+- :mod:`obs.sampler` — the always-on ~50 Hz folded-stack sampling
+  profiler behind ``GET /profile``.
 
 ``utils.metrics`` / ``utils.profiling`` remain as compatible re-export
 shims, so existing imports keep working.
 """
 
 from noise_ec_tpu.obs.collector import TraceCollector
+from noise_ec_tpu.obs.device import (
+    analyze_program,
+    device_op,
+    hbm_snapshot,
+    peak_hbm_gbps,
+    roofline_summary,
+)
 from noise_ec_tpu.obs.health import SLOEvaluator, default_slo, record_e2e
 from noise_ec_tpu.obs.metrics import Counters, Histogram, Timer
 from noise_ec_tpu.obs.perfetto import to_chrome_trace, write_chrome_trace
@@ -40,6 +52,7 @@ from noise_ec_tpu.obs.registry import (
     default_registry,
     set_build_info,
 )
+from noise_ec_tpu.obs.sampler import StackSampler, default_sampler
 from noise_ec_tpu.obs.trace import Tracer, default_tracer, node_attrs, span
 
 __all__ = [
@@ -49,14 +62,21 @@ __all__ = [
     "PIPELINE_STAGES",
     "Registry",
     "SLOEvaluator",
+    "StackSampler",
     "Timer",
     "TraceCollector",
     "Tracer",
+    "analyze_program",
     "default_registry",
+    "default_sampler",
     "default_slo",
     "default_tracer",
+    "device_op",
+    "hbm_snapshot",
     "node_attrs",
+    "peak_hbm_gbps",
     "record_e2e",
+    "roofline_summary",
     "set_build_info",
     "span",
     "to_chrome_trace",

@@ -1,0 +1,402 @@
+"""Device telemetry: dispatch latency, compile tracking, roofline, HBM.
+
+The one layer the obs package could not see before this module was the
+TPU hot path itself: ``record_kernel`` counted calls and bytes, but a
+recompile storm (geometry churn re-jitting per (matrix, shape) key) was
+indistinguishable from a slow device, and memory headroom was invisible
+until an OOM. Four surfaces close that gap:
+
+- **Dispatch latency with a compile/execute split** —
+  :func:`device_op` wraps every ``DeviceCodec`` dispatch. The first call
+  for a (entry, kernel, matrix, shape) cache key is the one that traces
+  and compiles; it records as ``route="compile"`` into
+  ``noise_ec_device_op_seconds{kernel,route}`` and feeds
+  ``noise_ec_jit_compiles_total{kernel}`` plus the compile-seconds
+  histogram. Warm calls record as ``route="execute"`` on the
+  device-scale (half-octave, us-range) bucket set.
+- **Roofline** — :func:`analyze_program` pulls
+  ``fn.lower(*args).compile().cost_analysis()`` FLOPs / bytes-accessed
+  for a freshly compiled program (cheap: the AOT path reuses the jit
+  compilation cache — measured ~17 ms after a 330 ms first call) and
+  exports per-kernel program-cost and operational-intensity gauges;
+  ``noise_ec_roofline_utilization{kernel}`` reads achieved payload
+  bandwidth (cumulative execute bytes / execute seconds) over
+  :func:`peak_hbm_gbps` at collect time.
+- **HBM accounting** — :func:`hbm_snapshot` sums ``jax.live_arrays()``
+  and folds in the allocator's ``memory_stats()`` where the backend
+  reports them (TPU does; CPU returns None and falls back to the
+  live-array high-water mark). Exported as callback gauges on
+  ``/metrics`` and folded into the ``/healthz`` details (obs/server.py).
+- **xprof capture** — the ``-xprof-dir`` CLI flag plus the stats
+  server's ``/xprof?seconds=N`` endpoint wrap
+  :func:`~noise_ec_tpu.obs.profiling.device_trace` so a live node can
+  capture a TensorBoard/xprof trace of a decode burst on demand.
+
+Hot-path budget: a warm dispatch pays one perf_counter pair, one set
+lookup and one cached-child histogram observe — the same cost class as
+the span layer, on a path whose cheapest op (a 14 us reconstruct) is
+~5x the overhead. Compile-route extras (cost analysis, gauge install)
+ride the first call only, which is seconds-scale anyway.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from typing import Optional
+
+from noise_ec_tpu.obs.registry import Registry, default_registry
+
+__all__ = [
+    "DeviceOpTimer",
+    "analyze_program",
+    "achieved_gbps",
+    "device_op",
+    "dispatch_key",
+    "hbm_snapshot",
+    "install_hbm_gauges",
+    "maybe_analyze_program",
+    "peak_hbm_gbps",
+    "reset_dispatch_tracking",
+    "roofline_summary",
+    "set_analysis_interval",
+    "set_peak_hbm_gbps",
+]
+
+log = logging.getLogger("noise_ec_tpu.obs")
+
+_lock = threading.Lock()
+# Dispatch cache keys already seen by this process: membership decides the
+# compile/execute route. Bounded like the dispatch-side caches — a clear
+# only means a few dispatches re-record as compiles.
+_seen_keys: set[bytes] = set()
+_SEEN_BOUND = 16384
+# (kernel, route) -> histogram child; kernel -> (counter, hist) children.
+# Default-registry only (the health.py pattern): a transient Registry must
+# not pin stale children.
+_op_children: dict[tuple[str, str], object] = {}
+_compile_children: dict[str, tuple] = {}
+# kernel -> [execute_bytes_total, execute_seconds_total] for the achieved-
+# bandwidth side of the roofline gauges.
+_op_stats: dict[str, list] = {}
+_gauges_installed = False
+_live_high_water = 0
+
+# Peak HBM bandwidth by jax backend, GB/s. v5e ships 819 GB/s HBM2; the
+# CPU figure is a commodity-DDR ballpark so utilization still reads as a
+# sane 0..1 on the test backend. Override with set_peak_hbm_gbps.
+_PEAK_GBPS = {"tpu": 819.0, "gpu": 900.0, "cpu": 25.0}
+_peak_override: Optional[float] = None
+
+
+def set_peak_hbm_gbps(gbps: Optional[float]) -> None:
+    """Pin the roofline's peak-bandwidth denominator (None restores the
+    per-backend table — e.g. a v4 deployment sets 1228)."""
+    global _peak_override
+    _peak_override = gbps
+
+
+def peak_hbm_gbps() -> float:
+    if _peak_override is not None:
+        return _peak_override
+    try:
+        import jax
+
+        return _PEAK_GBPS.get(jax.default_backend(), 100.0)
+    except Exception:  # noqa: BLE001 — telemetry must not require jax
+        return 100.0
+
+
+def dispatch_key(entry: str, kernel: str, M, shape: tuple) -> bytes:
+    """Stable cache key for one dispatch: the same (matrix bytes, stripe
+    shape, kernel entry) that decides whether jit re-traces. Matrix bytes
+    are digested — keys live in a process-wide set and generator matrices
+    reach (200, 256)."""
+    import numpy as np
+
+    h = hashlib.blake2b(digest_size=16)
+    h.update(entry.encode())
+    h.update(kernel.encode())
+    h.update(repr(shape).encode())
+    h.update(np.ascontiguousarray(M).tobytes())
+    return h.digest()
+
+
+def reset_dispatch_tracking() -> None:
+    """Forget seen dispatch keys and per-kernel stats (tests)."""
+    with _lock:
+        _seen_keys.clear()
+        _op_stats.clear()
+        _last_analysis.clear()
+
+
+class DeviceOpTimer:
+    """Times one dispatch and routes it compile/execute on exit.
+
+    Class-based context manager for the same reason Span is: the
+    generator machinery costs ~3x on a path measured in microseconds.
+    """
+
+    __slots__ = ("entry", "key", "nbytes", "registry", "route", "elapsed",
+                 "_t0")
+
+    def __init__(self, entry: str, key: bytes, nbytes: int,
+                 registry: Optional[Registry]):
+        self.entry = entry
+        self.key = key
+        self.nbytes = nbytes
+        self.registry = registry
+        self.route = ""
+        self.elapsed = 0.0
+
+    def __enter__(self) -> "DeviceOpTimer":
+        with _lock:
+            if self.key in _seen_keys:
+                self.route = "execute"
+            else:
+                if len(_seen_keys) >= _SEEN_BOUND:
+                    _seen_keys.clear()
+                _seen_keys.add(self.key)
+                self.route = "compile"
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.elapsed = time.perf_counter() - self._t0
+        if exc is not None:
+            # A failed dispatch must not poison the split: the next call
+            # for this key is the one that will actually compile.
+            if self.route == "compile":
+                with _lock:
+                    _seen_keys.discard(self.key)
+            return False
+        reg = self.registry
+        if reg is None:
+            op = _op_children.get((self.entry, self.route))
+            if op is None:
+                op = _op_children[(self.entry, self.route)] = (
+                    default_registry().histogram(
+                        "noise_ec_device_op_seconds"
+                    ).labels(kernel=self.entry, route=self.route)
+                )
+        else:
+            op = reg.histogram("noise_ec_device_op_seconds").labels(
+                kernel=self.entry, route=self.route
+            )
+        op.observe(self.elapsed)
+        if self.route == "compile":
+            self._record_compile(reg)
+        else:
+            with _lock:
+                st = _op_stats.get(self.entry)
+                if st is None:
+                    st = _op_stats[self.entry] = [0.0, 0.0]
+                    _install_utilization_gauge(self.entry, reg)
+                st[0] += self.nbytes
+                st[1] += self.elapsed
+        return False
+
+    def _record_compile(self, reg: Optional[Registry]) -> None:
+        if reg is None:
+            pair = _compile_children.get(self.entry)
+            if pair is None:
+                r = default_registry()
+                pair = _compile_children[self.entry] = (
+                    r.counter("noise_ec_jit_compiles_total").labels(
+                        kernel=self.entry
+                    ),
+                    r.histogram("noise_ec_jit_compile_seconds").labels(
+                        kernel=self.entry
+                    ),
+                )
+        else:
+            pair = (
+                reg.counter("noise_ec_jit_compiles_total").labels(
+                    kernel=self.entry
+                ),
+                reg.histogram("noise_ec_jit_compile_seconds").labels(
+                    kernel=self.entry
+                ),
+            )
+        pair[0].add(1)
+        pair[1].observe(self.elapsed)
+
+
+def device_op(entry: str, key: bytes, nbytes: int = 0,
+              registry: Optional[Registry] = None) -> DeviceOpTimer:
+    """``with device_op("matmul_words", key, nbytes):`` around one
+    DeviceCodec dispatch. Also installs the HBM gauges on first use so
+    any process that dispatches exports memory headroom."""
+    install_hbm_gauges(registry)
+    return DeviceOpTimer(entry, key, nbytes, registry)
+
+
+# ------------------------------------------------------------------ roofline
+
+
+def achieved_gbps(entry: str) -> float:
+    """Cumulative execute-route payload bandwidth for one kernel entry
+    (0.0 until a warm dispatch lands)."""
+    with _lock:
+        st = _op_stats.get(entry)
+    if not st or st[1] <= 0:
+        return 0.0
+    return st[0] / st[1] / 1e9
+
+
+def _install_utilization_gauge(entry: str,
+                               registry: Optional[Registry]) -> None:
+    reg = registry if registry is not None else default_registry()
+    try:
+        reg.gauge("noise_ec_roofline_utilization").set_callback(
+            lambda e=entry: achieved_gbps(e) / max(peak_hbm_gbps(), 1e-9),
+            kernel=entry,
+        )
+    except Exception:  # noqa: BLE001 — a gauge must not fail a dispatch
+        log.debug("roofline gauge install failed for %s", entry)
+
+
+# Dispatch-time analysis rate limit: the AOT lower walk is cheap for a
+# plain jit matmul (~17 ms measured) but NOT free for big fused programs,
+# and geometry churn — the exact scenario the recompile counter exists to
+# expose — would otherwise pay it on every fresh geometry (measured +50%
+# on the interpret-mode CPU test files). One analysis per kernel entry
+# per window keeps the gauges fresh without riding the churn.
+_ANALYSIS_INTERVAL_S = 60.0
+_last_analysis: dict[str, float] = {}
+
+
+def set_analysis_interval(seconds: float) -> None:
+    """Min seconds between dispatch-time cost analyses per kernel entry
+    (tests shrink it; 0 analyzes every compile)."""
+    global _ANALYSIS_INTERVAL_S
+    _ANALYSIS_INTERVAL_S = seconds
+
+
+def maybe_analyze_program(entry: str, fn, *args,
+                          registry: Optional[Registry] = None
+                          ) -> Optional[dict]:
+    """Rate-limited :func:`analyze_program` — the dispatch-path entry.
+    Returns None when skipped by the per-entry interval."""
+    now = time.monotonic()
+    with _lock:
+        last = _last_analysis.get(entry)
+        if last is not None and now - last < _ANALYSIS_INTERVAL_S:
+            return None
+        _last_analysis[entry] = now
+    return analyze_program(entry, fn, *args, registry=registry)
+
+
+def analyze_program(entry: str, fn, *args,
+                    registry: Optional[Registry] = None) -> Optional[dict]:
+    """Pull XLA ``cost_analysis()`` for a jitted callable's program and
+    export per-kernel program-cost gauges.
+
+    Call AFTER the first dispatch: ``fn.lower(*args).compile()`` then
+    reuses the jit compilation cache instead of compiling twice. Returns
+    ``{"flops", "bytes", "intensity"}`` or None when the backend offers
+    no analysis (never raises — this is telemetry).
+    """
+    try:
+        cost = fn.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+        nbytes = float(cost.get("bytes accessed", 0.0))
+    except Exception as exc:  # noqa: BLE001 — cost analysis is best-effort
+        log.debug("cost_analysis unavailable for %s: %s", entry, exc)
+        return None
+    reg = registry if registry is not None else default_registry()
+    try:
+        reg.gauge("noise_ec_device_program_flops").labels(
+            kernel=entry
+        ).set(flops)
+        reg.gauge("noise_ec_device_program_bytes").labels(
+            kernel=entry
+        ).set(nbytes)
+        intensity = flops / nbytes if nbytes > 0 else 0.0
+        reg.gauge("noise_ec_roofline_intensity").labels(
+            kernel=entry
+        ).set(intensity)
+    except Exception:  # noqa: BLE001
+        return None
+    return {"flops": flops, "bytes": nbytes, "intensity": intensity}
+
+
+# ------------------------------------------------------------------- HBM
+
+
+def hbm_snapshot() -> dict:
+    """Live/peak/limit device bytes. ``live_bytes`` sums
+    ``jax.live_arrays()``; ``bytes_in_use`` / ``peak_bytes_in_use`` /
+    ``bytes_limit`` come from the allocator when the backend reports
+    memory_stats (TPU), else peak falls back to the high-water mark of
+    live scans and limit reads 0. Empty dict when jax is absent."""
+    global _live_high_water
+    try:
+        import jax
+    except Exception:  # noqa: BLE001 — telemetry without jax
+        return {}
+    try:
+        live = int(sum(getattr(a, "nbytes", 0) for a in jax.live_arrays()))
+    except Exception:  # noqa: BLE001
+        live = 0
+    with _lock:
+        _live_high_water = max(_live_high_water, live)
+        high = _live_high_water
+    out = {"live_bytes": live, "peak_bytes": high, "limit_bytes": 0}
+    try:
+        stats = jax.devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001
+        stats = None
+    if stats:
+        out["bytes_in_use"] = int(stats.get("bytes_in_use", live))
+        out["peak_bytes"] = int(stats.get("peak_bytes_in_use", high))
+        out["limit_bytes"] = int(stats.get("bytes_limit", 0))
+    return out
+
+
+def install_hbm_gauges(registry: Optional[Registry] = None) -> None:
+    """Install the collect-time HBM callback gauges (idempotent for the
+    default registry; explicit registries always install)."""
+    global _gauges_installed
+    if registry is None:
+        with _lock:
+            if _gauges_installed:
+                return
+            _gauges_installed = True
+    reg = registry if registry is not None else default_registry()
+    try:
+        reg.gauge("noise_ec_hbm_live_bytes").set_callback(
+            lambda: hbm_snapshot().get("live_bytes", 0)
+        )
+        reg.gauge("noise_ec_hbm_peak_bytes").set_callback(
+            lambda: hbm_snapshot().get("peak_bytes", 0)
+        )
+        reg.gauge("noise_ec_hbm_limit_bytes").set_callback(
+            lambda: hbm_snapshot().get("limit_bytes", 0)
+        )
+    except Exception:  # noqa: BLE001 — gauge install must not fail callers
+        log.debug("hbm gauge install failed")
+
+
+def roofline_summary() -> dict:
+    """Flat dict for bench/report output: per-kernel achieved GB/s and
+    utilization plus the HBM snapshot (MiB)."""
+    out: dict = {}
+    with _lock:
+        entries = list(_op_stats)
+    for entry in entries:
+        a = achieved_gbps(entry)
+        if a > 0:
+            out[f"device_{entry}_achieved_gbps"] = round(a, 2)
+            out[f"device_{entry}_utilization"] = round(
+                a / max(peak_hbm_gbps(), 1e-9), 4
+            )
+    hbm = hbm_snapshot()
+    if hbm:
+        out["hbm_live_mib"] = round(hbm.get("live_bytes", 0) / 2**20, 1)
+        out["hbm_peak_mib"] = round(hbm.get("peak_bytes", 0) / 2**20, 1)
+    return out

@@ -23,6 +23,7 @@ __all__ = [
     "Counters",
     "Histogram",
     "Timer",
+    "DEVICE_LATENCY_BUCKETS",
     "LATENCY_BUCKETS",
     "SIZE_BUCKETS",
 ]
@@ -30,6 +31,16 @@ __all__ = [
 # Default latency buckets: 1 us .. ~16.8 s, geometric (x2). Wide enough to
 # hold both a sub-ms numpy decode and a multi-second first-geometry jit.
 LATENCY_BUCKETS: tuple[float, ...] = tuple(1e-6 * 2**i for i in range(25))
+
+# Device-scale latency buckets: 1 us .. ~1 s, geometric (x sqrt(2)) — twice
+# the resolution of LATENCY_BUCKETS where device dispatches actually land.
+# The x2 host buckets put a 14 us reconstruct and a 20 us one in the same
+# bin (16..32 us); the device hot path's regressions are exactly that
+# scale, so its histograms get half-octave steps. The top (~1 s) still
+# catches a first-call jit that slipped past the compile split.
+DEVICE_LATENCY_BUCKETS: tuple[float, ...] = tuple(
+    1e-6 * 2 ** (i / 2) for i in range(41)
+)
 
 # Default size buckets: 64 B .. 1 GiB, geometric (x4) — shard payloads at
 # the low end, whole stream objects at the top.

@@ -11,11 +11,18 @@ the judge's checklist names explicitly:
   feeds it on every matmul. :func:`kernel_gbps` folds a wall-clock window
   into data rates for the BASELINE metric.
 
-Counters are process-global on purpose: the hot path records two counter
+Counters are process-global on purpose: the hot path records four counter
 adds per device call (no sync, no device round-trip), and one snapshot at
 report time tells you which kernel moved how many bytes. The span/
 histogram layer (obs.trace / obs.metrics) deliberately does NOT ride this
-path — per-kernel granularity stays at the two-adds budget.
+path — per-kernel granularity stays at the counter-adds budget.
+
+The same event now lands on two surfaces: the plain :data:`kernel_counters`
+bag (``timed_window`` / ``kernel_gbps`` fold it into GB/s at report time)
+and the registry families ``noise_ec_kernel_{calls,bytes}_total{entry}``,
+so ``/metrics`` serves per-kernel series with proper HELP/TYPE lines and
+``tools/check_metrics.py`` lints them like every other family — instead of
+the old side-channel ``noise_ec_kernel_<entry>_bytes`` prefix rendering.
 """
 
 from __future__ import annotations
@@ -38,11 +45,26 @@ __all__ = [
 # matmul_words_calls / matmul_words_bytes.
 kernel_counters = Counters()
 
+# Cached registry children per entry (default registry only): the hot path
+# pays a dict get + two adds, not a labels() resolution.
+_registry_children: dict[str, tuple] = {}
+
 
 def record_kernel(entry: str, nbytes: int) -> None:
     """One device-kernel invocation moving ``nbytes`` of payload."""
     kernel_counters.add(f"{entry}_calls", 1)
     kernel_counters.add(f"{entry}_bytes", nbytes)
+    pair = _registry_children.get(entry)
+    if pair is None:
+        from noise_ec_tpu.obs.registry import default_registry
+
+        reg = default_registry()
+        pair = _registry_children[entry] = (
+            reg.counter("noise_ec_kernel_calls_total").labels(entry=entry),
+            reg.counter("noise_ec_kernel_bytes_total").labels(entry=entry),
+        )
+    pair[0].add(1)
+    pair[1].add(nbytes)
 
 
 @contextlib.contextmanager

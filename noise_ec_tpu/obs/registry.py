@@ -26,6 +26,7 @@ import threading
 from typing import Callable, Iterable, Optional, Sequence
 
 from noise_ec_tpu.obs.metrics import (
+    DEVICE_LATENCY_BUCKETS,
     LATENCY_BUCKETS,
     SIZE_BUCKETS,
     Histogram,
@@ -293,6 +294,90 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "(one shard each; silent-partition recovery)",
         (),
     ),
+    # --- device telemetry (obs/device.py, obs/sampler.py, ops/dispatch.py)
+    "noise_ec_device_op_seconds": (
+        "histogram",
+        "Per-dispatch device codec latency, labeled by kernel entry and "
+        "route (compile = first call for a (matrix, shape, kernel) cache "
+        "key, execute = warm calls). Words entries time the async submit; "
+        "stripes entries time through host materialization",
+        ("kernel", "route"),
+    ),
+    "noise_ec_jit_compiles_total": (
+        "counter",
+        "First-call dispatches per (matrix, shape, kernel) cache key — "
+        "geometry churn causing recompiles shows here as a rate instead "
+        "of a silent p99 cliff",
+        ("kernel",),
+    ),
+    "noise_ec_jit_compile_seconds": (
+        "histogram",
+        "Latency of first-call (trace + compile + run) dispatches, "
+        "labeled by kernel entry",
+        ("kernel",),
+    ),
+    "noise_ec_kernel_calls_total": (
+        "counter",
+        "Device-kernel invocations, labeled by entry point (the registry "
+        "form of the record_kernel counter bag)",
+        ("entry",),
+    ),
+    "noise_ec_kernel_bytes_total": (
+        "counter",
+        "Payload bytes moved per device-kernel entry point (the registry "
+        "form of the record_kernel counter bag)",
+        ("entry",),
+    ),
+    "noise_ec_hbm_live_bytes": (
+        "gauge",
+        "Device bytes held by live JAX arrays (jax.live_arrays), read at "
+        "collect time",
+        (),
+    ),
+    "noise_ec_hbm_peak_bytes": (
+        "gauge",
+        "Peak device bytes in use (allocator memory_stats when the "
+        "backend reports them, else the high-water mark of live-array "
+        "scans)",
+        (),
+    ),
+    "noise_ec_hbm_limit_bytes": (
+        "gauge",
+        "Device memory capacity reported by the allocator (0 when the "
+        "backend does not report one)",
+        (),
+    ),
+    "noise_ec_device_program_flops": (
+        "gauge",
+        "XLA cost_analysis FLOPs of the most recently compiled program, "
+        "labeled by kernel entry",
+        ("kernel",),
+    ),
+    "noise_ec_device_program_bytes": (
+        "gauge",
+        "XLA cost_analysis bytes accessed of the most recently compiled "
+        "program, labeled by kernel entry",
+        ("kernel",),
+    ),
+    "noise_ec_roofline_intensity": (
+        "gauge",
+        "Operational intensity (cost_analysis FLOPs / bytes accessed) of "
+        "the most recently compiled program, labeled by kernel entry",
+        ("kernel",),
+    ),
+    "noise_ec_roofline_utilization": (
+        "gauge",
+        "Achieved payload bandwidth over the device peak (0..1), from "
+        "cumulative execute-route dispatch bytes/seconds, labeled by "
+        "kernel entry",
+        ("kernel",),
+    ),
+    "noise_ec_profile_samples_total": (
+        "counter",
+        "Stack samples folded by the always-on sampling profiler "
+        "(obs/sampler.py; one per thread per tick)",
+        (),
+    ),
     # --- shard mempool (host/mempool.py)
     "noise_ec_mempool_pools": (
         "gauge",
@@ -314,6 +399,9 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
 # Bucket layout per histogram metric (export needs them fixed per family).
 _HISTOGRAM_BUCKETS: dict[str, tuple[float, ...]] = {
     "noise_ec_decode_bytes": SIZE_BUCKETS,
+    # Device dispatches live in the us range; the host-scale x2 buckets
+    # collapse sub-0.1 ms ops into one bin (obs/metrics.py).
+    "noise_ec_device_op_seconds": DEVICE_LATENCY_BUCKETS,
 }
 
 

@@ -11,7 +11,17 @@
 - ``GET /healthz`` — SLO-aware health: 200 ``ok`` while the wired
   :class:`~noise_ec_tpu.obs.health.SLOEvaluator` (if any) judges the
   rolling window healthy, 503 with the JSON verdict once the error
-  budget is burned. With no evaluator wired it is plain liveness.
+  budget is burned. With no evaluator wired it is plain liveness. The
+  verbose/503 JSON folds the device HBM snapshot (obs/device.py) into
+  ``details.hbm`` alongside any wired ``health_details``;
+- ``GET /profile?seconds=N`` — the always-on sampling profiler's last
+  N seconds as flamegraph-ready collapsed-stack text (obs/sampler.py;
+  the sampler starts on first request if the CLI ``-profile`` flag did
+  not start it eagerly);
+- ``GET /xprof?seconds=N`` — capture a JAX/XLA profiler trace of the
+  next N seconds into the configured ``xprof_dir`` (404 until the CLI
+  ``-xprof-dir`` flag or constructor wires a directory; 409 while a
+  capture is already running).
 
 ``PeriodicReporter`` logs a structured stats snapshot every N seconds so
 a node without a scraper still surfaces its counters during the run, not
@@ -24,10 +34,12 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 from urllib.parse import parse_qs, urlparse
 
+from noise_ec_tpu.obs.device import hbm_snapshot, install_hbm_gauges
 from noise_ec_tpu.obs.export import render_prometheus
 from noise_ec_tpu.obs.health import SLOEvaluator
 from noise_ec_tpu.obs.metrics import Counters
@@ -56,7 +68,12 @@ class StatsServer:
     ``health_details`` is an optional zero-arg callable whose dict is
     folded into the ``/healthz`` JSON body (e.g. the peer supervisor's
     circuit-breaker summary, resilience/peers.py) — served alongside the
-    verdict on 503, and on 200 via ``/healthz?verbose=1``.
+    verdict on 503, and on 200 via ``/healthz?verbose=1``; the device
+    HBM snapshot rides the same ``details`` dict under ``hbm``.
+    ``sampler`` attaches a started :class:`~noise_ec_tpu.obs.sampler.
+    StackSampler` for ``/profile`` (one starts lazily on first request
+    otherwise). ``xprof_dir`` enables ``/xprof`` captures into that
+    directory.
     """
 
     def __init__(
@@ -69,12 +86,19 @@ class StatsServer:
         extra_counters: Optional[dict[str, Counters]] = None,
         slo: Optional[SLOEvaluator] = None,
         health_details: Optional[Callable[[], dict]] = None,
+        sampler=None,
+        xprof_dir: Optional[str] = None,
     ):
         self.registry = registry
         self.tracer = tracer if tracer is not None else default_tracer()
         self.extra_counters = dict(extra_counters or {})
         self.slo = slo
         self.health_details = health_details
+        self.sampler = sampler
+        self.xprof_dir = xprof_dir
+        self._xprof_busy = threading.Lock()
+        self._xprof_thread: Optional[threading.Thread] = None
+        install_hbm_gauges(registry)
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -116,12 +140,21 @@ class StatsServer:
                         outer.slo.verdict() if outer.slo is not None
                         else {"healthy": True, "reason": None}
                     )
+                    details: dict = {}
                     if outer.health_details is not None:
                         try:
-                            verdict["details"] = outer.health_details()
+                            details.update(outer.health_details())
                         except Exception as exc:  # noqa: BLE001 — health
                             # detail must never break the probe itself
-                            verdict["details"] = {"error": str(exc)}
+                            details["error"] = str(exc)
+                    try:
+                        hbm = hbm_snapshot()
+                        if hbm:
+                            details["hbm"] = hbm
+                    except Exception:  # noqa: BLE001 — same contract
+                        pass
+                    if details:
+                        verdict["details"] = details
                     if verdict["healthy"]:
                         if verbose:
                             self._reply(
@@ -135,6 +168,35 @@ class StatsServer:
                             503, "application/json",
                             json.dumps(verdict, indent=1).encode(),
                         )
+                elif url.path == "/profile":
+                    q = parse_qs(url.query)
+                    try:
+                        seconds = float(q.get("seconds", ["5"])[0])
+                    except ValueError:
+                        self._reply(400, "text/plain", b"bad seconds\n")
+                        return
+                    seconds = max(0.1, min(seconds, 60.0))
+                    body = outer._profile(seconds).encode()
+                    self._reply(200, "text/plain; charset=utf-8", body)
+                elif url.path == "/xprof":
+                    if not outer.xprof_dir:
+                        self._reply(
+                            404, "text/plain",
+                            b"no xprof dir configured (-xprof-dir)\n",
+                        )
+                        return
+                    q = parse_qs(url.query)
+                    try:
+                        seconds = float(q.get("seconds", ["5"])[0])
+                    except ValueError:
+                        self._reply(400, "text/plain", b"bad seconds\n")
+                        return
+                    seconds = max(0.1, min(seconds, 300.0))
+                    ok, msg = outer._xprof(seconds)
+                    self._reply(
+                        200 if ok else 409, "application/json",
+                        json.dumps(msg, indent=1).encode(),
+                    )
                 else:
                     self._reply(404, "text/plain", b"not found\n")
 
@@ -162,10 +224,63 @@ class StatsServer:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
+    def _profile(self, seconds: float) -> str:
+        """Collapsed stacks for the last ``seconds``. Starts the shared
+        sampler on first request; a cold window blocks (bounded by
+        ``seconds``) until it holds at least one sample, so the first
+        scrape after startup still returns stacks instead of ''."""
+        if self.sampler is None:
+            from noise_ec_tpu.obs.sampler import default_sampler
+
+            self.sampler = default_sampler()
+        sampler = self.sampler
+        sampler.start()
+        deadline = time.time() + seconds
+        text = sampler.collapsed(seconds)
+        while not text and time.time() < deadline:
+            time.sleep(0.02)
+            text = sampler.collapsed(seconds)
+        return text
+
+    def _xprof(self, seconds: float) -> tuple[bool, dict]:
+        """One bounded jax.profiler capture into ``xprof_dir`` on a
+        background thread; refuses to overlap captures."""
+        if not self._xprof_busy.acquire(blocking=False):
+            return False, {"error": "capture already running"}
+
+        def run():
+            try:
+                from noise_ec_tpu.obs.profiling import device_trace
+
+                with device_trace(self.xprof_dir):
+                    time.sleep(seconds)
+                log.info("xprof capture (%.1fs) written to %s",
+                         seconds, self.xprof_dir)
+            except Exception as exc:  # noqa: BLE001 — telemetry capture
+                log.error("xprof capture failed: %s", exc)
+            finally:
+                self._xprof_busy.release()
+
+        self._xprof_thread = threading.Thread(
+            target=run, name="noise-ec-xprof", daemon=True
+        )
+        self._xprof_thread.start()
+        return True, {
+            "capturing": True, "seconds": seconds, "logdir": self.xprof_dir,
+        }
+
     def close(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)
+        # An in-flight xprof capture must finish before the process can
+        # exit: tearing the interpreter down mid-trace crashes XLA's
+        # profiler (observed as a shutdown segfault). Bounded wait — the
+        # capture window is capped at 300 s plus start/stop overhead.
+        t = self._xprof_thread
+        if t is not None and t.is_alive():
+            log.info("waiting for the in-flight xprof capture to finish")
+            t.join(timeout=330)
 
 
 class PeriodicReporter:

@@ -41,6 +41,11 @@ from noise_ec_tpu.ops.pallas_gf2mm import (
     planes_to_tiled,
     tiled_to_planes,
 )
+from noise_ec_tpu.obs.device import (
+    device_op,
+    dispatch_key,
+    maybe_analyze_program,
+)
 from noise_ec_tpu.obs.profiling import record_kernel
 
 _FIELDS = {"gf256": GF256, "gf65536": GF65536}
@@ -513,18 +518,41 @@ class DeviceCodec:
         return True
 
     def matmul_stripes(self, M: np.ndarray, D) -> np.ndarray:
-        """(r, k) GF matrix x (k, S) stripes -> (r, S), computed on device."""
+        """(r, k) GF matrix x (k, S) stripes -> (r, S), computed on device.
+
+        Device-telemetry wrapper: every dispatch lands in
+        ``noise_ec_device_op_seconds{kernel,route}`` — the first call per
+        (matrix, shape, kernel) cache key as ``route="compile"`` (feeding
+        the recompile counter), warm calls as ``route="execute"``. This
+        entry materializes the result on host, so the timing covers the
+        device round trip, not just the async submit (obs/device.py).
+        """
         M = np.asarray(M)
         D = np.asarray(D, dtype=self.gf.dtype)
         r, k = M.shape
         if D.shape[0] != k:
             raise ValueError(f"matrix cols {k} != stripe rows {D.shape[0]}")
+        entry = f"matmul_stripes_{self.kernel}"
+        record_kernel(entry, D.nbytes)
+        key = dispatch_key(entry, self.kernel, M, D.shape)
+        with device_op(entry, key, nbytes=D.nbytes) as dt:
+            return self._matmul_stripes_dispatch(M, D, dt)
+
+    def _matmul_stripes_dispatch(self, M: np.ndarray, D: np.ndarray,
+                                 dt) -> np.ndarray:
+        r, k = M.shape
         S = D.shape[1]
         m = self.gf.degree
-        record_kernel(f"matmul_stripes_{self.kernel}", D.nbytes)
         if self.kernel == "xla":
             fn = _fused_xla_fn(m, r, k, S)
-            out = fn(jnp.asarray(self.masks_for(M)), jnp.asarray(D))
+            masks_dev = jnp.asarray(self.masks_for(M))
+            D_dev = jnp.asarray(D)
+            out = fn(masks_dev, D_dev)
+            if dt.route == "compile":
+                # Roofline: cost_analysis of the freshly cached program
+                # (rate-limited per entry — the AOT walk is not free and
+                # must not ride a geometry-churn storm).
+                maybe_analyze_program(dt.entry, fn, masks_dev, D_dev)
             # np.array (copy) so callers get an ordinary writable ndarray,
             # not a read-only view of the device buffer.
             return np.array(out)
@@ -563,9 +591,12 @@ class DeviceCodec:
         fn = _fused_words_fn(
             r, self.bits_rows_for(M), self.kernel == "pallas_interpret"
         )
+        words_dev = jnp.asarray(words)
         # np.array: writable copy (np.asarray of a jax array is read-only
         # and callers are promised an ordinary ndarray).
-        out_w = np.array(fn(jnp.asarray(words)))
+        out_w = np.array(fn(words_dev))
+        if dt.route == "compile":
+            maybe_analyze_program(dt.entry, fn, words_dev)
         return np.ascontiguousarray(out_w.view(self.gf.dtype)[:, :S])
 
     def syndrome_stripes(
@@ -714,7 +745,18 @@ class DeviceCodec:
                 "matmul_words/matmul_words_batch require a pallas kernel; "
                 "use matmul_stripes (or BatchCodec.encode_batch) on the XLA path"
             )
-        record_kernel("matmul_words", 4 * int(np.prod(words.shape)))
+        M = np.asarray(M)
+        nbytes = 4 * int(np.prod(words.shape))
+        record_kernel("matmul_words", nbytes)
+        # Async-entry caveat: this path returns a device array without
+        # materializing, so the execute-route timing is the submit cost;
+        # the compile route still times the synchronous trace+compile.
+        key = dispatch_key("matmul_words", self.kernel, M, tuple(words.shape))
+        with device_op("matmul_words", key, nbytes=nbytes) as dt:
+            return self._matmul_words_batch_dispatch(M, words, dt)
+
+    def _matmul_words_batch_dispatch(self, M: np.ndarray, words: jnp.ndarray,
+                                     dt) -> jnp.ndarray:
         TW = words.shape[2]
         TWp = pad_words(TW) if self.gf.degree == 8 else pad_words16(TW)
         if self.gf.degree == 8 and self.route_for(M) == "mxu":
@@ -747,6 +789,10 @@ class DeviceCodec:
             out = fn(words[0])[None]
         else:
             out = jax.vmap(fn)(words)
+        if dt.route == "compile":
+            # Best-effort: the MXU partial has no .lower and a traced
+            # call passes tracers; the analysis degrades to None.
+            maybe_analyze_program("matmul_words", fn, words[0])
         return out[:, :, :TW] if TWp != TW else out
 
     def matmul_planes(self, M: np.ndarray, planes: jnp.ndarray) -> jnp.ndarray:

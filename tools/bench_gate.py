@@ -1,0 +1,379 @@
+#!/usr/bin/env python
+"""Bench regression gate: diff a fresh bench run against the recorded
+trajectory and fail on regressions past per-metric tolerance.
+
+klauspost/reedsolomon ships per-geometry throughput benchmarks as its
+regression oracle; this repo records the same trajectory as
+``BENCH_r*.json`` (per-round stats) next to ``BASELINE.json`` (the
+north-star bar) — but until this tool nothing *noticed* when
+``rs200_56_encode_gbps`` (the weakest geometry) slid. The gate:
+
+- knows each metric's **direction** from its name (``*_gbps`` /
+  ``*_per_s`` are higher-better; ``*_ms`` / ``*_s`` are lower-better;
+  identity/meta keys are skipped);
+- applies a **per-metric tolerance**: 10% for device-kernel throughput
+  (slope-timed, stable round over round), 35% for host-path stats (the
+  single-core box has documented 10-40% load tails — BASELINE.md), and
+  skips ``*device_tunnel*`` outright (the axon tunnel's floor, not the
+  code's — BENCH_r05 renamed it for exactly this reason);
+- checks the headline against the ``BASELINE.json`` north star
+  (``vs_baseline >= 1``) when a headline line is present.
+
+Modes:
+
+- default: run ``python bench.py`` fresh, parse its stats, diff against
+  the newest recorded ``BENCH_r*.json``; exit 1 on regression;
+- ``--current FILE`` / ``--against FILE``: diff recorded stats files
+  instead of running (FILE is either a raw stats dict or a BENCH_r
+  document with a ``parsed`` key);
+- ``--check``: self-test replaying the recorded ``BENCH_r0*.json``
+  series — verifies the real r04→r05 deltas pass, a synthetic 20%
+  throughput regression (and a 20% latency inflation) is flagged, and
+  direction parsing is sane. Runs under tier-1 with no device
+  (tests/test_device_obs.py wraps it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+# Keys that are identity/config, not performance.
+SKIP_KEYS = {
+    "backend", "kernel", "data_bytes", "tpu_smoke", "batch_mesh_devices",
+    "store_repair_stripes_per_batch", "encode_s",
+}
+# encode_s is the headline's raw timing — the headline gbps already
+# carries it with the proper direction and the north-star check.
+
+HIGHER_BETTER_SUFFIXES = ("_gbps", "_mb_per_s", "_msgs_per_s", "_per_s")
+LOWER_BETTER_SUFFIXES = ("_ms", "_s")
+
+DEFAULT_TOLERANCE = 0.10
+# Host-path stats ride a single shared core with measured 10-40% load
+# tails; a tight gate there would cry wolf every round.
+HOST_TOLERANCE = 0.35
+HOST_PREFIXES = (
+    "host_node_", "decode_corrupt_", "cpu_shim_", "partition_recovery_",
+    "store_repair_",
+)
+
+
+def metric_direction(name: str) -> str | None:
+    """'up' (higher better), 'down' (lower better), or None (skip)."""
+    if name in SKIP_KEYS or name.endswith("_error"):
+        return None
+    if "device_tunnel" in name:
+        return None  # the tunnel's floor, not the code's
+    if name.startswith(("device_", "hbm_")):
+        return None  # telemetry describing the run, not the perf contract
+    if name.endswith(HIGHER_BETTER_SUFFIXES):
+        return "up"
+    if name.endswith(LOWER_BETTER_SUFFIXES):
+        return "down"
+    return None
+
+
+def metric_tolerance(name: str) -> float:
+    if name.startswith(HOST_PREFIXES):
+        return HOST_TOLERANCE
+    return DEFAULT_TOLERANCE
+
+
+def compare(old: dict, new: dict) -> list[dict]:
+    """Per-metric findings for every comparable metric present in both
+    runs. ``regressed`` is True when the move exceeds tolerance in the
+    bad direction."""
+    findings = []
+    for name in sorted(set(old) & set(new)):
+        direction = metric_direction(name)
+        if direction is None:
+            continue
+        try:
+            a, b = float(old[name]), float(new[name])
+        except (TypeError, ValueError):
+            continue
+        if a <= 0:
+            continue
+        delta = (b - a) / a
+        bad = -delta if direction == "up" else delta
+        findings.append({
+            "metric": name,
+            "old": a,
+            "new": b,
+            "delta_pct": round(delta * 100, 2),
+            "direction": direction,
+            "tolerance_pct": round(metric_tolerance(name) * 100, 1),
+            "regressed": bad > metric_tolerance(name),
+        })
+    return findings
+
+
+def north_star_check(stats: dict) -> list[str]:
+    """The headline must clear the BASELINE.json bar when present."""
+    headline = stats.get("headline_rs10_4_encode_gbps")
+    if headline is None:
+        return []
+    try:
+        import bench
+
+        bar = float(bench.NORTH_STAR_GBPS)
+    except Exception:  # noqa: BLE001 — recorded-file mode without bench.py
+        bar = 40.0
+    if float(headline) < bar:
+        return [
+            f"headline rs10_4 encode {headline} GB/s below the "
+            f"BASELINE.json north star {bar} GB/s"
+        ]
+    return []
+
+
+def gate(old: dict, new: dict) -> tuple[list[str], list[dict]]:
+    """(problems, findings). Empty problems = the gate passes."""
+    findings = compare(old, new)
+    problems = [
+        f"{f['metric']}: {f['old']} -> {f['new']} "
+        f"({f['delta_pct']:+.1f}%, tolerance {f['tolerance_pct']}%, "
+        f"{'higher' if f['direction'] == 'up' else 'lower'} is better)"
+        for f in findings
+        if f["regressed"]
+    ]
+    problems.extend(north_star_check(new))
+    return problems, findings
+
+
+# --------------------------------------------------------------- load/record
+
+
+_HEADLINE = re.compile(
+    r'\{"metric": "rs10_4_encode_throughput".*?\}'
+)
+
+
+def _stats_from_bench_doc(doc: dict) -> dict | None:
+    """A recorded BENCH_r*.json -> flat stats dict (parsed + headline)."""
+    stats = doc.get("parsed")
+    if not isinstance(stats, dict):
+        return None
+    stats = dict(stats)
+    m = _HEADLINE.search(doc.get("tail", ""))
+    if m:
+        try:
+            stats["headline_rs10_4_encode_gbps"] = float(
+                json.loads(m.group(0))["value"]
+            )
+        except (ValueError, KeyError):
+            pass
+    return stats
+
+
+def load_stats(path: Path) -> dict:
+    """Either a raw stats dict or a BENCH_r document."""
+    doc = json.loads(path.read_text())
+    if "parsed" in doc or "tail" in doc:
+        stats = _stats_from_bench_doc(doc)
+        if stats is None:
+            raise ValueError(f"{path} has no parsed stats")
+        return stats
+    return doc
+
+
+def recorded_series(repo: Path = REPO) -> list[tuple[str, dict]]:
+    """(name, stats) for every recorded round with parsed stats."""
+    out = []
+    for path in sorted(repo.glob("BENCH_r*.json")):
+        doc = json.loads(path.read_text())
+        stats = _stats_from_bench_doc(doc)
+        if stats:
+            out.append((path.name, stats))
+    return out
+
+
+def run_bench() -> dict:
+    """One fresh ``python bench.py``; stats from the last stderr JSON
+    line, headline from stdout."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True, text=True, timeout=3600,
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"bench.py exited {proc.returncode}:\n{proc.stderr[-2000:]}"
+        )
+    stats = None
+    for line in reversed(proc.stderr.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            stats = json.loads(line)
+            break
+    if stats is None:
+        raise RuntimeError("bench.py printed no stats JSON on stderr")
+    m = _HEADLINE.search(proc.stdout)
+    if m:
+        stats["headline_rs10_4_encode_gbps"] = float(
+            json.loads(m.group(0))["value"]
+        )
+    return stats
+
+
+# ------------------------------------------------------------------ selfcheck
+
+
+def self_check(verbose: bool = True) -> list[str]:
+    """Replay the recorded series; empty list = the gate behaves.
+
+    Three properties, all device-free:
+
+    - the real r04→r05 deltas (worst: rs10_4_par1 −7.4%) pass;
+    - a synthetic 20% cut of every throughput metric — including the
+      known weakest geometry, rs200_56 — is flagged, as is a 20%
+      latency inflation;
+    - improvements are never flagged (direction parsing).
+    """
+    errors: list[str] = []
+    series = recorded_series()
+    if len(series) < 2:
+        return ["fewer than 2 recorded BENCH_r*.json rounds to replay"]
+    by_name = dict(series)
+
+    if "BENCH_r04.json" in by_name and "BENCH_r05.json" in by_name:
+        problems, _ = gate(by_name["BENCH_r04.json"], by_name["BENCH_r05.json"])
+        if problems:
+            errors.append(
+                "the real r04->r05 series must pass the gate; flagged: "
+                + "; ".join(problems)
+            )
+    else:
+        errors.append("r04/r05 rounds missing from the recorded series")
+
+    latest_name, latest = series[-1]
+    # Device-kernel throughput (tight 10% tolerance): a 20% cut must
+    # flag every one. Host-path metrics carry the 35% load-tail
+    # tolerance, so a 20% cut legitimately passes there.
+    gbps_metrics = [
+        n for n in latest
+        if metric_direction(n) == "up"
+        and metric_tolerance(n) < 0.2
+        and isinstance(latest[n], (int, float))
+    ]
+    if not gbps_metrics:
+        errors.append(f"{latest_name} has no device throughput metrics")
+    weakest = min(gbps_metrics, key=lambda n: float(latest[n]), default=None)
+    synthetic = dict(latest)
+    for n in gbps_metrics:
+        synthetic[n] = float(latest[n]) * 0.8
+    problems, findings = gate(latest, synthetic)
+    flagged = {p.split(":", 1)[0] for p in problems}
+    missing = set(gbps_metrics) - flagged
+    if missing:
+        errors.append(
+            f"synthetic 20% throughput regression not flagged for: "
+            f"{sorted(missing)}"
+        )
+    if weakest and weakest not in flagged:
+        errors.append(
+            f"the weakest metric {weakest!r} survived a 20% synthetic cut"
+        )
+
+    lat_metrics = [n for n in latest if metric_direction(n) == "down"]
+    if lat_metrics:
+        inflated = dict(latest)
+        for n in lat_metrics:
+            inflated[n] = float(latest[n]) * 2.0  # past even HOST_TOLERANCE
+        problems, _ = gate(latest, inflated)
+        flagged = {p.split(":", 1)[0] for p in problems}
+        if set(lat_metrics) - flagged:
+            errors.append(
+                "doubled latency metrics not flagged: "
+                f"{sorted(set(lat_metrics) - flagged)}"
+            )
+
+    improved = {
+        n: (float(v) * 1.5 if metric_direction(n) == "up"
+            else float(v) * 0.5 if metric_direction(n) == "down" else v)
+        for n, v in latest.items()
+        if isinstance(v, (int, float))
+    }
+    problems, _ = gate(latest, improved)
+    if problems:
+        errors.append(f"improvements were flagged as regressions: {problems}")
+
+    if verbose and not errors:
+        print(
+            f"bench_gate --check: OK ({len(series)} rounds replayed, "
+            f"weakest metric {weakest!r} = {latest.get(weakest)})"
+        )
+    return errors
+
+
+# ----------------------------------------------------------------------- cli
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="bench_gate",
+        description="fail the build when bench.py regresses vs the "
+        "recorded trajectory",
+    )
+    p.add_argument("--check", action="store_true",
+                   help="self-test on the recorded BENCH_r0*.json series "
+                   "(no device needed)")
+    p.add_argument("--current", metavar="FILE",
+                   help="stats to gate (skip running bench.py)")
+    p.add_argument("--against", metavar="FILE",
+                   help="reference stats (default: newest BENCH_r*.json)")
+    p.add_argument("--json", action="store_true",
+                   help="print the full findings table as JSON")
+    args = p.parse_args(argv)
+
+    if args.check:
+        errors = self_check()
+        for e in errors:
+            print(f"bench_gate --check: {e}", file=sys.stderr)
+        return 1 if errors else 0
+
+    try:
+        if args.against:
+            against = load_stats(Path(args.against))
+            against_name = args.against
+        else:
+            series = recorded_series()
+            if not series:
+                print("bench_gate: no recorded BENCH_r*.json to gate "
+                      "against", file=sys.stderr)
+                return 2
+            against_name, against = series[-1]
+        current = (
+            load_stats(Path(args.current)) if args.current else run_bench()
+        )
+    except (OSError, ValueError, RuntimeError) as exc:
+        print(f"bench_gate: {exc}", file=sys.stderr)
+        return 2
+
+    problems, findings = gate(against, current)
+    if args.json:
+        print(json.dumps(
+            {"against": against_name, "findings": findings,
+             "problems": problems},
+            indent=1,
+        ))
+    for f in findings:
+        if f["regressed"]:
+            print(f"bench_gate: REGRESSION {f['metric']}: {f['old']} -> "
+                  f"{f['new']} ({f['delta_pct']:+.1f}%)", file=sys.stderr)
+    if problems:
+        print(f"bench_gate: {len(problems)} regression(s) vs "
+              f"{against_name}", file=sys.stderr)
+        return 1
+    print(f"bench_gate: OK ({len(findings)} metrics vs {against_name})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

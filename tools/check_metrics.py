@@ -38,7 +38,13 @@ and cross-checks every referenced name against the declarative registry:
   (``obs.trace.SPAN_FIELDS``) and every ``/spans`` dump-document key
   (``obs.server.SPANS_DOC_FIELDS``) must be documented (backticked) in
   ``docs/observability.md`` — the distributed-trace collector and any
-  external tooling parse exactly that schema.
+  external tooling parse exactly that schema;
+- **device-telemetry docs parity**: the operator-facing device
+  surfaces (``/profile``, ``/xprof``, the ``-profile`` / ``-xprof-dir``
+  flags, ``tools/bench_gate.py``, the cost_analysis roofline, the
+  device bucket set) must appear in docs/observability.md's "Device
+  telemetry" section — they exist only as strings in the code, so the
+  METRICS-table check cannot see them drift.
 
 Run directly (``python tools/check_metrics.py``; exit 1 on problems) or
 through the tier-1 test that wraps it (tests/test_obs.py).
@@ -147,6 +153,7 @@ def check() -> list[str]:
             )
     problems.extend(check_docs())
     problems.extend(check_resilience_docs())
+    problems.extend(check_device_docs())
     return problems
 
 
@@ -180,6 +187,35 @@ def check_resilience_docs() -> list[str]:
         f"resilience metric {n!r} is not documented in docs/resilience.md"
         for n in names
         if not re.search(rf"\b{re.escape(n)}\b", text)
+    ]
+
+
+# Operator-facing device-telemetry surfaces that must stay documented in
+# docs/observability.md's "Device telemetry" section: the endpoints and
+# flags exist only as strings in the code, so the generic METRICS check
+# cannot see them drift.
+DEVICE_DOC_TOKENS = (
+    "/profile",
+    "/xprof",
+    "-xprof-dir",
+    "-profile",
+    "tools/bench_gate.py",
+    "cost_analysis",
+    "DEVICE_LATENCY_BUCKETS",
+)
+
+
+def check_device_docs() -> list[str]:
+    """Device-telemetry endpoints/flags vs docs/observability.md."""
+    doc_path = REPO / "docs" / "observability.md"
+    if not doc_path.exists():
+        return [f"docs file {doc_path} missing"]
+    text = doc_path.read_text(encoding="utf-8")
+    return [
+        f"device-telemetry surface {tok} is not documented in "
+        "docs/observability.md (Device telemetry section)"
+        for tok in DEVICE_DOC_TOKENS
+        if tok not in text
     ]
 
 
