@@ -370,6 +370,79 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — secondary stat only
         stats["store_repair_error"] = str(exc)[:80]
 
+    # --- object service: PUT and degraded range-GET throughput through
+    # the object layer (service/objects.py — chunk -> per-stripe sign +
+    # erasure encode -> store + broadcast -> manifest; read = ranged
+    # degraded decode from any k of n with n-k shards dropped). This is
+    # the user-facing surface (docs/object-service.md); both stats ride
+    # the tools/bench_gate.py regression gate under the host tolerance
+    # (the put path is dominated by per-stripe signing on this box).
+    try:
+        from noise_ec_tpu.host.plugin import ShardPlugin as _OSP
+        from noise_ec_tpu.host.transport import (
+            LoopbackHub as _OHub,
+            LoopbackNetwork as _ONet,
+            format_address as _ofmt,
+        )
+        from noise_ec_tpu.service import ObjectStore as _OS
+        from noise_ec_tpu.store import RepairEngine as _ORE
+        from noise_ec_tpu.store import StripeStore as _OSS
+
+        o_backend = "device" if on_tpu else "numpy"
+        o_hub = _OHub()  # single node: broadcast is a no-op fan-out
+        o_node = _ONet(o_hub, _ofmt("tcp", "localhost", 3800))
+        o_store = _OSS(backend=o_backend)
+        o_engine = _ORE(o_store, network=o_node, linger_seconds=0.0)
+        o_plugin = _OSP(backend=o_backend, store=o_store)
+        o_node.add_plugin(o_plugin)
+        ko, no = 10, 14
+        objects = _OS(
+            o_store, o_plugin, o_node, engine=o_engine,
+            stripe_bytes=1 << 20, k=ko, n=no,
+        )
+        obj_bytes = (32 if on_tpu else 16) << 20
+        base_obj = rng.integers(
+            0, 256, size=obj_bytes, dtype=np.uint8
+        ).tobytes()
+        objects.put("bench", "warm", base_obj)  # warm codecs/caches
+        t_put = float("inf")
+        last_name = None
+        for trial in range(3):
+            # Distinct content per trial: identical bytes share stripe
+            # signatures and the second put would time cache hits.
+            payload_t = base_obj[trial + 1:] + bytes([trial]) * (trial + 1)
+            last_name = f"obj{trial}"
+            t0 = time.perf_counter()
+            objects.put("bench", last_name, payload_t)
+            t_put = min(t_put, time.perf_counter() - t0)
+            check_smoke(
+                objects.read("bench", last_name) == payload_t,
+                "object put/get returned wrong bytes",
+            )
+        stats["object_put_mb_per_s"] = round(obj_bytes / t_put / 1e6, 1)
+        # Degrade every stripe of the last object below its data shards
+        # (n-k erasures including data slots) and time the ranged read
+        # that reconstructs through the codec backend.
+        m = objects.resolve("bench", last_name)
+        for skey in set(m["stripes"]):
+            for shard_no in range(no - ko):
+                o_store.drop_shard(skey, shard_no)
+        expect = base_obj[3:] + bytes([2]) * 3
+        t_get = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            got = objects.read("bench", last_name)
+            t_get = min(t_get, time.perf_counter() - t0)
+            check_smoke(got == expect,
+                        "object degraded read returned wrong bytes")
+        stats["object_get_degraded_mb_per_s"] = round(
+            obj_bytes / t_get / 1e6, 1
+        )
+    except SmokeMismatch:
+        raise  # deterministic correctness failure: fail the run
+    except Exception as exc:  # noqa: BLE001 — secondary stat only
+        stats["object_service_error"] = str(exc)[:80]
+
     # --- chaos recovery: partition-heal -> first successful delivery
     # latency through the REAL transport behind the chaos proxy
     # (docs/resilience.md). Three scheduled 1 s directional partitions

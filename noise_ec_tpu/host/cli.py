@@ -134,6 +134,26 @@ def build_parser() -> argparse.ArgumentParser:
         "(enabled automatically when set)",
     )
     p.add_argument(
+        "-object-port",
+        type=int,
+        default=-1,
+        metavar="PORT",
+        help="serve the erasure-coded object service API "
+        "(PUT/GET/range/DELETE/LIST under /objects, docs/object-service.md) "
+        "on 127.0.0.1:PORT, alongside /metrics and /healthz on the same "
+        "server. 0 binds an ephemeral port (logged); negative disables "
+        "(default). Enables the stripe store automatically",
+    )
+    p.add_argument(
+        "-tenants",
+        default="",
+        metavar="FILE",
+        help="tenant config JSON for the object service (namespaces, "
+        "byte/object quotas, per-tenant geometry, replication targets — "
+        "docs/object-service.md). Empty = open admission, unlimited "
+        "quotas",
+    )
+    p.add_argument(
         "-chaos-profile",
         default="",
         metavar="PROFILE",
@@ -240,7 +260,10 @@ def main(argv: list[str] | None = None) -> int:
                 log.error("could not save received object: %s", exc)
 
     store = scrubber = engine = None
-    if args.store_dir or args.scrub_interval > 0 or args.announce_interval > 0:
+    if (
+        args.store_dir or args.scrub_interval > 0
+        or args.announce_interval > 0 or args.object_port >= 0
+    ):
         from noise_ec_tpu.store import RepairEngine, Scrubber, StripeStore
 
         store = StripeStore(
@@ -320,6 +343,34 @@ def main(argv: list[str] | None = None) -> int:
                      stats_server.url, args.xprof_dir)
     if args.stats_interval > 0:
         reporter = PeriodicReporter(args.stats_interval, stats_snapshot, log)
+
+    object_server = None
+    if args.object_port >= 0:
+        from noise_ec_tpu.service import ObjectAPI, ObjectStore, TenantRegistry
+
+        tenants = (
+            TenantRegistry.from_file(args.tenants) if args.tenants
+            else TenantRegistry()
+        )
+        objects = ObjectStore(
+            store, plugin, net,
+            tenants=tenants, engine=engine, slo=default_slo(),
+        )
+        # The object API rides a StatsServer, so PORT serves /objects
+        # alongside /metrics and /healthz (the route table,
+        # obs/server.py) — one scrape-and-serve surface per node.
+        object_server = StatsServer(
+            port=args.object_port,
+            extra_counters={"noise_ec_plugin": plugin.counters},
+            slo=default_slo(),
+            health_details=(
+                net.supervisor.health_summary
+                if net.supervisor is not None else None
+            ),
+        )
+        ObjectAPI(objects).mount(object_server)
+        log.info("object service on %s/objects (%d tenants configured)",
+                 object_server.url, len(tenants.names()))
 
     collector = None
     trace_peers = [u for u in args.trace_peers.split(",") if u]
@@ -409,6 +460,8 @@ def main(argv: list[str] | None = None) -> int:
                     )
             except Exception as exc:  # noqa: BLE001 — telemetry teardown
                 log.error("trace export failed: %s", exc)
+        if object_server is not None:
+            object_server.close()
         if stats_server is not None:
             stats_server.close()
         if sampler is not None:

@@ -438,11 +438,18 @@ class ShardPlugin:
 
     # ----------------------------------------------------------- send path
 
-    def shard_and_broadcast(self, network, input_bytes: bytes) -> list[Shard]:
+    def shard_and_broadcast(
+        self, network, input_bytes: bytes,
+        *, geometry: Optional[tuple[int, int]] = None,
+    ) -> list[Shard]:
         """Encode ``input_bytes`` and broadcast one message per shard to all
         peers (main.go:201-210). Returns the shards for callers that want
-        them (the reference discards them)."""
-        shards = self.prepare_shards(network.id, network.keys, input_bytes)
+        them (the reference discards them). ``geometry`` pins an explicit
+        (k, n) instead of the plugin's mutable default — the object
+        service's per-namespace geometry rides this."""
+        shards = self.prepare_shards(
+            network.id, network.keys, input_bytes, geometry=geometry
+        )
         # The origin keeps its own object too: anti-entropy repair
         # (store/repair.py) can then serve any peer that rots, and the
         # sender's stripe is the fleet's ground-truth copy.
@@ -464,19 +471,36 @@ class ShardPlugin:
         return shards
 
     def prepare_shards(
-        self, node_id: PeerID, keys: KeyPair, input_bytes: bytes
+        self, node_id: PeerID, keys: KeyPair, input_bytes: bytes,
+        *, geometry: Optional[tuple[int, int]] = None,
     ) -> list[Shard]:
         """Sign the plaintext, split it into shares, wrap each in a wire
         ``Shard`` (main.go:211-241).
 
         The reference shadows and never checks the ``Sign`` error
         (main.go:219, noted in SURVEY.md C8); here a signing failure
-        propagates.
+        propagates. An explicit ``geometry`` bypasses the reference's
+        mutable adjusted-geometry dance entirely: the caller promises a
+        payload length divisible by k (the object service pads its
+        stripes) and the plugin state is never touched.
         """
         if not input_bytes:
             raise ValueError("cannot prepare shards for empty input")  # main.go:215-217
         with span("prepare", nbytes=len(input_bytes)) as psp:
-            k, n = self._adjusted_geometry(len(input_bytes))
+            if geometry is not None:
+                k, n = geometry
+                if not 1 <= k <= n <= self.max_total_shards:
+                    raise ValueError(
+                        f"invalid explicit geometry k={k} n={n}"
+                    )
+                if len(input_bytes) % k:
+                    raise ValueError(
+                        f"input length {len(input_bytes)} is not a "
+                        f"multiple of k={k} (explicit geometry does not "
+                        "adjust; pad the payload)"
+                    )
+            else:
+                k, n = self._adjusted_geometry(len(input_bytes))
             # The trace key IS the signature prefix, so the sign span
             # attaches it from inside (known only after signing) and the
             # enclosing prepare span adopts it before its own exit.

@@ -378,6 +378,69 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "(obs/sampler.py; one per thread per tick)",
         (),
     ),
+    # --- object service (noise_ec_tpu/service, docs/object-service.md)
+    "noise_ec_object_puts_total": (
+        "counter",
+        "Objects admitted and stored through the object service PUT "
+        "path, labeled by tenant",
+        ("tenant",),
+    ),
+    "noise_ec_object_put_bytes_total": (
+        "counter",
+        "Logical object bytes admitted through PUT, labeled by tenant",
+        ("tenant",),
+    ),
+    "noise_ec_object_gets_total": (
+        "counter",
+        "Object/range reads, labeled by result (ok, degraded = at least "
+        "one stripe reconstructed, unavailable = below k and anti-entropy "
+        "timed out, error)",
+        ("result",),
+    ),
+    "noise_ec_object_get_bytes_total": (
+        "counter",
+        "Object bytes served by GET/range reads",
+        (),
+    ),
+    "noise_ec_object_deletes_total": (
+        "counter",
+        "Objects deleted (manifest dropped, unreferenced stripes "
+        "evicted), labeled by tenant",
+        ("tenant",),
+    ),
+    "noise_ec_object_rejects_total": (
+        "counter",
+        "PUTs refused at admission, labeled by reason (quota_bytes, "
+        "quota_objects, unknown_tenant)",
+        ("reason",),
+    ),
+    "noise_ec_object_shed_total": (
+        "counter",
+        "PUTs shed by load control before any encode (503 + Retry-After), "
+        "labeled by reason (slo = health verdict degraded, hbm = device "
+        "memory watermark breached)",
+        ("reason",),
+    ),
+    "noise_ec_object_manifests": (
+        "gauge",
+        "Object manifests indexed across live stores",
+        (),
+    ),
+    "noise_ec_object_tenant_bytes": (
+        "gauge",
+        "Logical bytes stored per tenant (quota accounting view)",
+        ("tenant",),
+    ),
+    "noise_ec_object_put_seconds": (
+        "histogram",
+        "End-to-end PUT latency (admission through manifest broadcast)",
+        (),
+    ),
+    "noise_ec_object_get_seconds": (
+        "histogram",
+        "End-to-end GET/range latency through stripe reads and decode",
+        (),
+    ),
     # --- shard mempool (host/mempool.py)
     "noise_ec_mempool_pools": (
         "gauge",

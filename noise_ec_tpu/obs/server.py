@@ -23,6 +23,15 @@
   ``-xprof-dir`` flag or constructor wires a directory; 409 while a
   capture is already running).
 
+Dispatch is a **registration table**, not an if/elif chain: every
+endpoint above is a route registered through :meth:`StatsServer.mount`,
+and other subsystems mount theirs the same way (the erasure-coded object
+service, service/http.py, adds its ``/objects`` tree onto this server —
+docs/object-service.md). A handler receives one request dict and returns
+``(status, content_type, body[, extra_headers])``; ``body`` may be an
+iterator of byte chunks for streamed responses (the handler then sets
+``Content-Length`` itself via ``extra_headers``).
+
 ``PeriodicReporter`` logs a structured stats snapshot every N seconds so
 a node without a scraper still surfaces its counters during the run, not
 only at shutdown. Both are wired to CLI flags (``-metrics-port`` /
@@ -98,114 +107,89 @@ class StatsServer:
         self.xprof_dir = xprof_dir
         self._xprof_busy = threading.Lock()
         self._xprof_thread: Optional[threading.Thread] = None
+        # The route registration table (see module docstring): exact
+        # paths first, then the longest matching prefix route. Built-in
+        # endpoints register through the same mount() other subsystems
+        # use, so adding a route never grows a dispatch chain here.
+        self._routes: list[tuple[str, str, bool, dict]] = []
+        self._mount_builtins()
         install_hbm_gauges(registry)
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 — http.server API
-                url = urlparse(self.path)
-                if url.path == "/metrics":
-                    body = render_prometheus(
-                        outer.registry, outer.extra_counters
-                    ).encode()
-                    self._reply(200, _PROM_CONTENT_TYPE, body)
-                elif url.path == "/spans":
-                    q = parse_qs(url.query)
-                    limit = since = None
-                    try:
-                        if "limit" in q:
-                            limit = int(q["limit"][0])
-                        if "since" in q:
-                            since = int(q["since"][0])
-                    except ValueError:
-                        self._reply(400, "text/plain", b"bad cursor\n")
-                        return
-                    trace = q.get("trace", [None])[0]
-                    # next_since is read BEFORE the dump: a span landing
-                    # between the two reads is then re-sent next poll
-                    # rather than skipped forever.
-                    doc = {
-                        "node": outer.tracer.node or {},
-                        "clock": clock_anchor(),
-                        "next_since": outer.tracer.last_seq(),
-                        "spans": outer.tracer.dump(
-                            trace_id=trace, limit=limit, since=since
-                        ),
-                    }
-                    body = json.dumps(doc, indent=1).encode()
-                    self._reply(200, "application/json", body)
-                elif url.path == "/healthz":
-                    verbose = "verbose" in parse_qs(url.query)
-                    verdict = (
-                        outer.slo.verdict() if outer.slo is not None
-                        else {"healthy": True, "reason": None}
-                    )
-                    details: dict = {}
-                    if outer.health_details is not None:
-                        try:
-                            details.update(outer.health_details())
-                        except Exception as exc:  # noqa: BLE001 — health
-                            # detail must never break the probe itself
-                            details["error"] = str(exc)
-                    try:
-                        hbm = hbm_snapshot()
-                        if hbm:
-                            details["hbm"] = hbm
-                    except Exception:  # noqa: BLE001 — same contract
-                        pass
-                    if details:
-                        verdict["details"] = details
-                    if verdict["healthy"]:
-                        if verbose:
-                            self._reply(
-                                200, "application/json",
-                                json.dumps(verdict, indent=1).encode(),
-                            )
-                        else:
-                            self._reply(200, "text/plain", b"ok\n")
-                    else:
-                        self._reply(
-                            503, "application/json",
-                            json.dumps(verdict, indent=1).encode(),
-                        )
-                elif url.path == "/profile":
-                    q = parse_qs(url.query)
-                    try:
-                        seconds = float(q.get("seconds", ["5"])[0])
-                    except ValueError:
-                        self._reply(400, "text/plain", b"bad seconds\n")
-                        return
-                    seconds = max(0.1, min(seconds, 60.0))
-                    body = outer._profile(seconds).encode()
-                    self._reply(200, "text/plain; charset=utf-8", body)
-                elif url.path == "/xprof":
-                    if not outer.xprof_dir:
-                        self._reply(
-                            404, "text/plain",
-                            b"no xprof dir configured (-xprof-dir)\n",
-                        )
-                        return
-                    q = parse_qs(url.query)
-                    try:
-                        seconds = float(q.get("seconds", ["5"])[0])
-                    except ValueError:
-                        self._reply(400, "text/plain", b"bad seconds\n")
-                        return
-                    seconds = max(0.1, min(seconds, 300.0))
-                    ok, msg = outer._xprof(seconds)
-                    self._reply(
-                        200 if ok else 409, "application/json",
-                        json.dumps(msg, indent=1).encode(),
-                    )
-                else:
-                    self._reply(404, "text/plain", b"not found\n")
+                self._dispatch("GET")
 
-            def _reply(self, code: int, ctype: str, body: bytes) -> None:
+            def do_PUT(self):  # noqa: N802
+                self._dispatch("PUT")
+
+            def do_POST(self):  # noqa: N802
+                self._dispatch("POST")
+
+            def do_DELETE(self):  # noqa: N802
+                self._dispatch("DELETE")
+
+            def _dispatch(self, method: str) -> None:
+                url = urlparse(self.path)
+                spec = outer._match(method, url.path)
+                if spec is None:
+                    self._reply(404, "text/plain", b"not found\n")
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                except ValueError:
+                    self._reply(400, "text/plain", b"bad content length\n")
+                    return
+                body = b""
+                if spec["stream"]:
+                    # Streaming route: the handler consumes rfile itself
+                    # (bounded by "length") — PUTs of large objects must
+                    # not buffer whole bodies here.
+                    pass
+                elif length:
+                    if length > spec["max_body"]:
+                        self._reply(413, "text/plain", b"body too large\n")
+                        return
+                    body = self.rfile.read(length)
+                req = {
+                    "method": method,
+                    "path": url.path,
+                    "query": parse_qs(url.query),
+                    "headers": self.headers,
+                    "body": body,
+                    "length": length,
+                    "rfile": self.rfile if spec["stream"] else None,
+                }
+                try:
+                    result = spec["handler"](req)
+                except Exception as exc:  # noqa: BLE001 — one bad handler
+                    # must not kill the serving thread's connection loop
+                    log.error("handler for %s %s failed: %s",
+                              method, url.path, exc)
+                    self._reply(500, "text/plain", b"internal error\n")
+                    return
+                extra = result[3] if len(result) > 3 else None
+                self._reply(result[0], result[1], result[2], extra)
+
+            def _reply(self, code: int, ctype: str, body,
+                       extra_headers: Optional[dict] = None) -> None:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
+                if isinstance(body, (bytes, bytearray)):
+                    self.send_header("Content-Length", str(len(body)))
+                for key, value in (extra_headers or {}).items():
+                    self.send_header(key, str(value))
                 self.end_headers()
-                self.wfile.write(body)
+                if isinstance(body, (bytes, bytearray)):
+                    self.wfile.write(body)
+                else:
+                    # Streamed body: an iterator of byte chunks; the
+                    # handler supplied Content-Length via extra_headers.
+                    # A mid-stream failure can only abort the connection
+                    # (the status line is gone) — the client sees a
+                    # short read against the declared length.
+                    for chunk in body:
+                        self.wfile.write(chunk)
 
             def log_message(self, fmt, *args):  # scrapes are not log news
                 log.debug("stats endpoint: " + fmt, *args)
@@ -223,6 +207,131 @@ class StatsServer:
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
+
+    # ------------------------------------------------------------- routing
+
+    def mount(
+        self,
+        method: str,
+        path: str,
+        handler: Callable[[dict], tuple],
+        *,
+        prefix: bool = False,
+        max_body: int = 1 << 20,
+        stream: bool = False,
+    ) -> None:
+        """Register one route. ``handler(request) -> (status, ctype,
+        body[, headers])`` where ``request`` carries ``method`` /
+        ``path`` / ``query`` (parse_qs dict) / ``headers`` / ``body``
+        (bytes, capped at ``max_body``) / ``length``. ``prefix=True``
+        matches every path under ``path`` (longest prefix wins);
+        ``stream=True`` skips body buffering and hands the handler
+        ``request["rfile"]`` + ``request["length"]`` instead (uploads of
+        arbitrary size stay O(chunk) in memory). ``body`` in the reply
+        may be bytes or an iterator of byte chunks (then the handler
+        must set ``Content-Length`` in its headers dict)."""
+        self._routes.append((
+            method.upper(), path, prefix,
+            {"handler": handler, "max_body": max_body, "stream": stream},
+        ))
+
+    def _match(self, method: str, path: str) -> Optional[dict]:
+        best: Optional[tuple[int, dict]] = None
+        for m, route_path, prefix, spec in list(self._routes):
+            if m != method:
+                continue
+            if not prefix:
+                if path == route_path:
+                    return spec  # exact match always wins
+            elif path.startswith(route_path):
+                if best is None or len(route_path) > best[0]:
+                    best = (len(route_path), spec)
+        return best[1] if best is not None else None
+
+    def _mount_builtins(self) -> None:
+        self.mount("GET", "/metrics", self._route_metrics)
+        self.mount("GET", "/spans", self._route_spans)
+        self.mount("GET", "/healthz", self._route_healthz)
+        self.mount("GET", "/profile", self._route_profile)
+        self.mount("GET", "/xprof", self._route_xprof)
+
+    def _route_metrics(self, req: dict) -> tuple:
+        body = render_prometheus(self.registry, self.extra_counters).encode()
+        return 200, _PROM_CONTENT_TYPE, body
+
+    def _route_spans(self, req: dict) -> tuple:
+        q = req["query"]
+        limit = since = None
+        try:
+            if "limit" in q:
+                limit = int(q["limit"][0])
+            if "since" in q:
+                since = int(q["since"][0])
+        except ValueError:
+            return 400, "text/plain", b"bad cursor\n"
+        trace = q.get("trace", [None])[0]
+        # next_since is read BEFORE the dump: a span landing between the
+        # two reads is then re-sent next poll rather than skipped forever.
+        doc = {
+            "node": self.tracer.node or {},
+            "clock": clock_anchor(),
+            "next_since": self.tracer.last_seq(),
+            "spans": self.tracer.dump(
+                trace_id=trace, limit=limit, since=since
+            ),
+        }
+        return 200, "application/json", json.dumps(doc, indent=1).encode()
+
+    def _route_healthz(self, req: dict) -> tuple:
+        verbose = "verbose" in req["query"]
+        verdict = (
+            self.slo.verdict() if self.slo is not None
+            else {"healthy": True, "reason": None}
+        )
+        details: dict = {}
+        if self.health_details is not None:
+            try:
+                details.update(self.health_details())
+            except Exception as exc:  # noqa: BLE001 — health detail must
+                # never break the probe itself
+                details["error"] = str(exc)
+        try:
+            hbm = hbm_snapshot()
+            if hbm:
+                details["hbm"] = hbm
+        except Exception:  # noqa: BLE001 — same contract
+            pass
+        if details:
+            verdict["details"] = details
+        if verdict["healthy"]:
+            if verbose:
+                return (200, "application/json",
+                        json.dumps(verdict, indent=1).encode())
+            return 200, "text/plain", b"ok\n"
+        return (503, "application/json",
+                json.dumps(verdict, indent=1).encode())
+
+    def _route_profile(self, req: dict) -> tuple:
+        try:
+            seconds = float(req["query"].get("seconds", ["5"])[0])
+        except ValueError:
+            return 400, "text/plain", b"bad seconds\n"
+        seconds = max(0.1, min(seconds, 60.0))
+        return (200, "text/plain; charset=utf-8",
+                self._profile(seconds).encode())
+
+    def _route_xprof(self, req: dict) -> tuple:
+        if not self.xprof_dir:
+            return (404, "text/plain",
+                    b"no xprof dir configured (-xprof-dir)\n")
+        try:
+            seconds = float(req["query"].get("seconds", ["5"])[0])
+        except ValueError:
+            return 400, "text/plain", b"bad seconds\n"
+        seconds = max(0.1, min(seconds, 300.0))
+        ok, msg = self._xprof(seconds)
+        return (200 if ok else 409, "application/json",
+                json.dumps(msg, indent=1).encode())
 
     def _profile(self, seconds: float) -> str:
         """Collapsed stacks for the last ``seconds``. Starts the shared

@@ -1,0 +1,640 @@
+"""The object layer: chunked erasure-coded puts, manifests, and ranged
+degraded reads over the stripe store.
+
+This is the promotion of the PR-2 stripe store into a user-facing
+storage surface (docs/object-service.md): an *object* of arbitrary size
+is chunked into fixed-capacity stripes, each stripe signed and
+erasure-encoded **through the existing plugin send path**
+(``ShardPlugin.shard_and_broadcast`` with an explicit per-namespace
+geometry) — so every stripe is simultaneously
+
+- stored locally as a trusted stripe (the origin copy, ground truth for
+  anti-entropy), and
+- broadcast to peers as ordinary signed SHARD traffic, which each peer
+  verifies end-to-end and lands in its own store (replication rides the
+  transport path that already exists, chaos hardening included).
+
+A *manifest* (content address -> ordered stripe keys + geometry + size
++ tenant/name) is itself broadcast as one more signed object with a
+magic prefix; every node's store put-listener recognizes the prefix and
+indexes it, so any surviving peer can resolve and serve the object —
+the origin node is not special. Reads map a byte range onto the minimal
+stripe set and stream decoded bytes, reading degraded (any k of n
+trusted shards, ``StripeStore.read``); a stripe below k locally is
+enqueued for the repair engine's anti-entropy fetch and the read waits
+a bounded time for peers to heal it.
+
+Admission control (the ROADMAP backpressure gap): PUTs are refused
+*before any stripe is encoded* when
+
+- the tenant's byte/object quota would be breached
+  (:class:`~noise_ec_tpu.service.tenants.QuotaExceededError`), or
+- the node is degrading — the wired ``SLOEvaluator`` verdict is
+  unhealthy, or device HBM in use crosses the watermark fraction of its
+  limit (:class:`ShedError`, surfaced as 503 + ``Retry-After`` by
+  service/http.py) — shedding at the door instead of queueing work onto
+  a device that is already behind.
+
+Trust and consistency model: manifests arrive only through
+signature-verified objects, so indexing one is as trusted as any
+delivery; the ``address`` field is the uploader's content hash of the
+object (recomputed only on full reads by callers that want it —
+stripe-level integrity is already anchored per-stripe by the Ed25519
+signature each stripe carries). Re-putting a name replaces it
+(last-write-wins per node); DELETE is local — replicas converge by
+operator policy, not tombstones (v1 scope, documented).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import re
+import threading
+import time
+import weakref
+from typing import Iterable, Iterator, Optional
+
+from noise_ec_tpu.obs.device import hbm_snapshot
+from noise_ec_tpu.obs.registry import default_registry
+from noise_ec_tpu.obs.trace import trace_key
+from noise_ec_tpu.service.tenants import (
+    QuotaExceededError,
+    TenantRegistry,
+    UnknownTenantError,
+)
+from noise_ec_tpu.store.stripe import (
+    DegradedReadError,
+    StripeStore,
+    UnknownStripeError,
+)
+
+__all__ = [
+    "MANIFEST_MAGIC",
+    "ObjectStore",
+    "ObjectUnavailableError",
+    "ShedError",
+    "UnknownObjectError",
+]
+
+log = logging.getLogger("noise_ec_tpu.service")
+
+# Wire/stored prefix of a manifest object; the version rides in the
+# magic so a future manifest schema can coexist on the same fleet.
+MANIFEST_MAGIC = b"noise-ec-manifest/1\n"
+
+OBJECT_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,127}$")
+
+DEFAULT_STRIPE_BYTES = 1 << 20
+
+
+class UnknownObjectError(KeyError):
+    """No manifest for this tenant/name (or address)."""
+
+
+class ObjectUnavailableError(RuntimeError):
+    """A stripe is below k trusted shards locally and the anti-entropy
+    fetch did not heal it within the read's wait budget (or the stripe
+    is entirely absent from this node)."""
+
+
+class ShedError(RuntimeError):
+    """PUT admission refused by load-shedding (SLO degraded / HBM
+    watermark); ``reason`` is the bounded shed-counter label and
+    ``retry_after`` the seconds a client should back off."""
+
+    def __init__(self, reason: str, retry_after: float):
+        super().__init__(f"put shed: {reason}")
+        self.reason = reason
+        self.retry_after = retry_after
+
+
+class _ObjectMetrics:
+    """Cached registry children for the noise_ec_object_* family."""
+
+    _registered = False
+    _instances: "weakref.WeakSet[ObjectStore]" = weakref.WeakSet()
+
+    def __init__(self):
+        reg = default_registry()
+        self._puts = reg.counter("noise_ec_object_puts_total")
+        self._put_bytes = reg.counter("noise_ec_object_put_bytes_total")
+        self._deletes = reg.counter("noise_ec_object_deletes_total")
+        self._gets = reg.counter("noise_ec_object_gets_total")
+        self._rejects = reg.counter("noise_ec_object_rejects_total")
+        self._sheds = reg.counter("noise_ec_object_shed_total")
+        self._tenant_bytes = reg.gauge("noise_ec_object_tenant_bytes")
+        self.get_bytes = reg.counter(
+            "noise_ec_object_get_bytes_total"
+        ).labels()
+        self.put_seconds = reg.histogram(
+            "noise_ec_object_put_seconds"
+        ).labels()
+        self.get_seconds = reg.histogram(
+            "noise_ec_object_get_seconds"
+        ).labels()
+        cls = _ObjectMetrics
+        if not cls._registered:
+            cls._registered = True
+            reg.gauge("noise_ec_object_manifests").set_callback(
+                lambda: sum(
+                    store.manifest_count()
+                    for store in {
+                        id(o.store): o.store for o in list(cls._instances)
+                    }.values()
+                )
+            )
+
+    def put(self, tenant: str, nbytes: int) -> None:
+        self._puts.labels(tenant=tenant).add(1)
+        self._put_bytes.labels(tenant=tenant).add(nbytes)
+
+    def delete(self, tenant: str) -> None:
+        self._deletes.labels(tenant=tenant).add(1)
+
+    def get(self, result: str) -> None:
+        self._gets.labels(result=result).add(1)
+
+    def reject(self, reason: str) -> None:
+        self._rejects.labels(reason=reason).add(1)
+
+    def shed(self, reason: str) -> None:
+        self._sheds.labels(reason=reason).add(1)
+
+    def tenant_bytes(self, tenant: str, value: int) -> None:
+        self._tenant_bytes.labels(tenant=tenant).set(value)
+
+
+class ObjectStore:
+    """Tenant-scoped object API over one :class:`StripeStore` (module
+    docstring). The plugin must be wired to the SAME store — verified
+    receives (replicated stripes and manifests) land there, and the
+    put-listener absorb hook is how this layer learns about them."""
+
+    def __init__(
+        self,
+        store: StripeStore,
+        plugin,
+        network,
+        *,
+        tenants: Optional[TenantRegistry] = None,
+        engine=None,
+        slo=None,
+        stripe_bytes: int = DEFAULT_STRIPE_BYTES,
+        k: int = 4,
+        n: int = 6,
+        hbm_watermark: float = 0.90,
+        fetch_timeout_seconds: float = 8.0,
+        retry_after_seconds: float = 2.0,
+        max_object_bytes: int = 1 << 30,
+    ):
+        if plugin.store is not store:
+            raise ValueError(
+                "plugin.store must be the same StripeStore (verified "
+                "receives and manifests land there)"
+            )
+        if not 1 <= k <= n:
+            raise ValueError(f"invalid default geometry k={k} n={n}")
+        if stripe_bytes < k:
+            raise ValueError(f"stripe_bytes {stripe_bytes} below k={k}")
+        self.store = store
+        self.plugin = plugin
+        self.network = network
+        self.tenants = tenants if tenants is not None else TenantRegistry()
+        self.engine = engine
+        self.slo = slo
+        self.stripe_bytes = stripe_bytes
+        self.default_k = k
+        self.default_n = n
+        self.hbm_watermark = hbm_watermark
+        self.fetch_timeout_seconds = fetch_timeout_seconds
+        self.retry_after_seconds = retry_after_seconds
+        self.max_object_bytes = max_object_bytes
+        self._lock = threading.Lock()
+        self._index: dict[tuple[str, str], str] = {}  # (tenant, name) -> addr
+        self._usage: dict[str, list] = {}  # tenant -> [bytes, objects]
+        self._known: set[str] = set()  # addresses counted into usage
+        self._metrics = _ObjectMetrics()
+        _ObjectMetrics._instances.add(self)
+        store.add_put_listener(self._on_store_put)
+        self._reindex()
+
+    # --------------------------------------------------------- admission
+
+    def shed_reason(self) -> Optional[str]:
+        """The load-shed signal for PUT admission: ``"slo"`` while the
+        wired evaluator's verdict is degraded, ``"hbm"`` when device
+        memory in use crosses the watermark fraction of the reported
+        limit, ``None`` to admit. Cheap enough per request (one verdict
+        sort over a bounded window + one allocator stat read)."""
+        if self.slo is not None and not self.slo.verdict()["healthy"]:
+            return "slo"
+        try:
+            hbm = hbm_snapshot()
+        except Exception:  # noqa: BLE001 — telemetry must not refuse PUTs
+            return None
+        limit = hbm.get("limit_bytes") or 0
+        used = hbm.get("bytes_in_use", hbm.get("live_bytes", 0))
+        if limit and used >= self.hbm_watermark * limit:
+            return "hbm"
+        return None
+
+    def usage(self, tenant: str) -> dict:
+        with self._lock:
+            used = self._usage.get(tenant, [0, 0])
+            return {"bytes": used[0], "objects": used[1]}
+
+    # -------------------------------------------------------------- puts
+
+    def put(self, tenant: str, name: str, data: bytes) -> dict:
+        """Store one in-memory object; see :meth:`put_stream`."""
+        return self.put_stream(tenant, name, iter((data,)), len(data))
+
+    def put_stream(
+        self, tenant_name: str, name: str,
+        chunks: Iterable[bytes], size: int,
+    ) -> dict:
+        """Admit, chunk, encode, broadcast and manifest one object of
+        ``size`` bytes arriving as a chunk iterator (memory stays
+        O(stripe)); returns the manifest document. Admission (quota,
+        then shed) runs BEFORE the first chunk is consumed, so a refused
+        PUT costs no encode and queues nothing toward the device."""
+        t0 = time.monotonic()
+        try:
+            tenant = self.tenants.get(tenant_name)
+        except UnknownTenantError:
+            self._metrics.reject("unknown_tenant")
+            raise
+        if not OBJECT_NAME_RE.match(name):
+            raise ValueError(f"bad object name {name!r}")
+        if size <= 0:
+            raise ValueError("cannot store an empty object")
+        if size > self.max_object_bytes:
+            raise ValueError(
+                f"object of {size} bytes exceeds the "
+                f"{self.max_object_bytes}-byte cap"
+            )
+        with self._lock:
+            used_bytes, used_objects = self._usage.get(tenant.name, [0, 0])
+        try:
+            self.tenants.admit(tenant, used_bytes, used_objects, size)
+        except QuotaExceededError as exc:
+            self._metrics.reject(exc.reason)
+            raise
+        reason = self.shed_reason()
+        if reason is not None:
+            self._metrics.shed(reason)
+            raise ShedError(reason, self.retry_after_seconds)
+
+        k = tenant.k or self.default_k
+        n = tenant.n or self.default_n
+        capacity = max(k, self.stripe_bytes - self.stripe_bytes % k)
+        # The address hashes (tenant, name, content) — not content alone:
+        # identical bytes under two names must be two objects (their
+        # manifests live and die independently) even though their
+        # STRIPES still dedup to the same keys (the stripe key is the
+        # signature prefix of identical payloads).
+        digest = hashlib.blake2b(digest_size=16)
+        digest.update(
+            tenant.name.encode() + b"\0" + name.encode() + b"\0"
+        )
+        stripe_keys: list[str] = []
+        buf = bytearray()
+        total = 0
+
+        def flush(payload: bytes) -> None:
+            pad = (-len(payload)) % k
+            shards = self.plugin.shard_and_broadcast(
+                self.network, payload + bytes(pad), geometry=(k, n)
+            )
+            stripe_keys.append(trace_key(shards[0].file_signature))
+
+        for chunk in chunks:
+            if not chunk:
+                continue
+            digest.update(chunk)
+            total += len(chunk)
+            if total > size:
+                raise ValueError(
+                    f"body exceeds the declared size of {size} bytes"
+                )
+            buf += chunk
+            while len(buf) >= capacity:
+                flush(bytes(buf[:capacity]))
+                del buf[:capacity]
+        if total != size:
+            raise ValueError(
+                f"body ended at {total} of the declared {size} bytes"
+            )
+        if buf:
+            flush(bytes(buf))
+
+        doc = {
+            "version": 1,
+            "address": digest.hexdigest(),
+            "tenant": tenant.name,
+            "name": name,
+            "size": size,
+            "stripe_bytes": capacity,
+            "k": k,
+            "n": n,
+            "field": "gf256",
+            "stripes": stripe_keys,
+            "created": time.time(),
+        }
+        blob = MANIFEST_MAGIC + json.dumps(doc).encode()
+        blob += b"\n" * ((-len(blob)) % k)
+        # The broadcast lands the manifest in the local store too, where
+        # the put listener (_on_store_put) indexes it — the exact code
+        # path every replica runs, so origin and peers converge through
+        # one absorb implementation.
+        self.plugin.shard_and_broadcast(self.network, blob, geometry=(k, n))
+        if tenant.replicas > 1 and self.engine is not None:
+            with self._lock:
+                manifest_stripe = self._manifest_stripe_locked(doc["address"])
+            pinned = list(stripe_keys)
+            if manifest_stripe:
+                pinned.append(manifest_stripe)
+            self.engine.pin_announce(pinned)
+        self._metrics.put(tenant.name, size)
+        self._metrics.put_seconds.observe(time.monotonic() - t0)
+        return self.store.get_manifest(doc["address"]) or doc
+
+    def _manifest_stripe_locked(self, address: str) -> Optional[str]:
+        doc = self.store.get_manifest(address)
+        return doc.get("manifest_stripe") if doc else None
+
+    # ----------------------------------------------------------- absorb
+
+    def _on_store_put(self, key: str, data: bytes, meta) -> None:
+        """Store put listener: recognize manifest objects (local puts
+        AND signature-verified replicas arriving through the plugin) and
+        index them. Never raises (the store logs and continues)."""
+        if not data.startswith(MANIFEST_MAGIC):
+            return
+        try:
+            doc = json.loads(data[len(MANIFEST_MAGIC):].decode())
+            self._validate_manifest(doc)
+        except (ValueError, KeyError, UnicodeDecodeError) as exc:
+            log.warning("ignoring malformed manifest in stripe %s: %s",
+                        key, exc)
+            return
+        doc["manifest_stripe"] = key
+        self.store.put_manifest(doc["address"], doc)
+        self._register(doc)
+
+    @staticmethod
+    def _validate_manifest(doc: dict) -> None:
+        if not isinstance(doc, dict) or doc.get("version") != 1:
+            raise ValueError("unsupported manifest version")
+        if not re.match(r"^[0-9a-f]{8,128}$", str(doc.get("address", ""))):
+            raise ValueError("bad manifest address")
+        stripes = doc.get("stripes")
+        if (
+            not isinstance(stripes, list) or not stripes
+            or not all(isinstance(s, str) for s in stripes)
+        ):
+            raise ValueError("bad manifest stripe list")
+        size = doc.get("size")
+        capacity = doc.get("stripe_bytes")
+        k, n = doc.get("k"), doc.get("n")
+        if not (isinstance(size, int) and size > 0):
+            raise ValueError("bad manifest size")
+        if not (isinstance(capacity, int) and capacity > 0):
+            raise ValueError("bad manifest stripe_bytes")
+        if len(stripes) != -(-size // capacity):
+            raise ValueError("manifest stripe count disagrees with size")
+        if not (isinstance(k, int) and isinstance(n, int) and 1 <= k <= n):
+            raise ValueError("bad manifest geometry")
+        if not isinstance(doc.get("tenant"), str) or not isinstance(
+            doc.get("name"), str
+        ):
+            raise ValueError("bad manifest tenant/name")
+
+    def _register(self, doc: dict) -> None:
+        """Index one (validated) manifest; idempotent. A name re-pointed
+        at a new address releases the old object locally (last-write-
+        wins per node)."""
+        addr = doc["address"]
+        tenant, name = doc["tenant"], doc["name"]
+        replaced: Optional[str] = None
+        with self._lock:
+            prev = self._index.get((tenant, name))
+            if prev == addr and addr in self._known:
+                return
+            self._index[(tenant, name)] = addr
+            if addr not in self._known:
+                self._known.add(addr)
+                used = self._usage.setdefault(tenant, [0, 0])
+                used[0] += int(doc["size"])
+                used[1] += 1
+                tenant_bytes = used[0]
+            else:
+                tenant_bytes = self._usage.get(tenant, [0, 0])[0]
+            if prev is not None and prev != addr:
+                replaced = prev
+        self._metrics.tenant_bytes(tenant, tenant_bytes)
+        if replaced is not None:
+            self._drop_address(replaced)
+
+    def _reindex(self) -> None:
+        cursor = None
+        while True:
+            page, cursor = self.store.list_manifests(cursor=cursor, limit=256)
+            for _, doc in page:
+                try:
+                    self._validate_manifest(doc)
+                except (ValueError, KeyError):
+                    continue
+                self._register(doc)
+            if cursor is None:
+                break
+
+    # -------------------------------------------------------------- reads
+
+    def resolve(self, tenant: str, name: str) -> dict:
+        with self._lock:
+            addr = self._index.get((tenant, name))
+        if addr is None:
+            raise UnknownObjectError(f"{tenant}/{name}")
+        doc = self.store.get_manifest(addr)
+        if doc is None:
+            raise UnknownObjectError(f"{tenant}/{name}")
+        return doc
+
+    def get_range(
+        self, tenant: str, name: str,
+        start: int = 0, length: Optional[int] = None,
+    ) -> tuple[dict, int, Iterator[bytes]]:
+        """Resolve and stream one byte range: ``(manifest, range_length,
+        chunk iterator)``. The range maps onto the minimal stripe set;
+        each stripe is served degraded from any k trusted shards, and a
+        stripe below k waits (bounded) on the anti-entropy fetch. The
+        metrics for the read land when the iterator is exhausted."""
+        doc = self.resolve(tenant, name)
+        size = int(doc["size"])
+        capacity = int(doc["stripe_bytes"])
+        if start < 0 or start > size:
+            raise ValueError(f"range start {start} outside [0, {size}]")
+        end = size if length is None else min(size, start + max(0, length))
+        total = max(0, end - start)
+
+        def chunks() -> Iterator[bytes]:
+            t0 = time.monotonic()
+            sent = 0
+            result = "ok"
+            try:
+                for i in range(start // capacity, -(-end // capacity)):
+                    key = doc["stripes"][i]
+                    blob, degraded = self._read_stripe(key)
+                    if degraded:
+                        result = "degraded"
+                    logical = min(capacity, size - i * capacity)
+                    lo = max(0, start - i * capacity)
+                    hi = min(logical, end - i * capacity)
+                    piece = bytes(memoryview(blob)[:logical][lo:hi])
+                    sent += len(piece)
+                    yield piece
+            except ObjectUnavailableError:
+                result = "unavailable"
+                raise
+            except Exception:
+                result = "error"
+                raise
+            finally:
+                self._metrics.get(result)
+                self._metrics.get_bytes.add(sent)
+                self._metrics.get_seconds.observe(time.monotonic() - t0)
+
+        return doc, total, chunks()
+
+    def read(self, tenant: str, name: str) -> bytes:
+        """Whole-object convenience read (tests, small objects)."""
+        _, _, chunks = self.get_range(tenant, name)
+        return b"".join(chunks)
+
+    def _read_stripe(self, key: str) -> tuple[bytes, bool]:
+        """One stripe's (padded) bytes + whether the read was degraded
+        (any of the k data slots untrusted, forcing a reconstruct)."""
+        try:
+            status = self.store.status(key)
+        except UnknownStripeError:
+            raise ObjectUnavailableError(
+                f"stripe {key} is not held by this node (no metadata to "
+                "anchor an anti-entropy fetch)"
+            )
+        degraded = not all(
+            i in status["trusted"] for i in range(status["k"])
+        )
+        try:
+            return self.store.read(key), degraded
+        except DegradedReadError:
+            pass
+        if self.engine is None:
+            raise ObjectUnavailableError(
+                f"stripe {key} has fewer than k trusted shards and no "
+                "repair engine is wired"
+            )
+        # Below k locally: ask the fleet (PR-2 anti-entropy) and wait a
+        # bounded time for absorbs to lift the stripe back over k.
+        self.engine.enqueue(key, "fetch")
+        deadline = time.monotonic() + self.fetch_timeout_seconds
+        while time.monotonic() < deadline:
+            if getattr(self.engine, "_thread", None) is None:
+                # No background worker: drive the queue ourselves so a
+                # test/deterministic deployment still fetches.
+                self.engine.drain_once()
+            time.sleep(0.05)
+            try:
+                return self.store.read(key), True
+            except DegradedReadError:
+                continue
+        raise ObjectUnavailableError(
+            f"stripe {key}: below k trusted shards and anti-entropy did "
+            f"not heal within {self.fetch_timeout_seconds:g}s"
+        )
+
+    # -------------------------------------------------------------- list
+
+    def list_objects(
+        self, tenant: str, *, cursor: Optional[str] = None, limit: int = 64
+    ) -> tuple[list[dict], Optional[str]]:
+        """One page of the tenant's objects in address order:
+        ``(entries, next_cursor)`` — built on the store's cursored
+        manifest walk, so a large namespace never snapshots whole."""
+        out: list[dict] = []
+        while len(out) < limit:
+            page, cursor = self.store.list_manifests(
+                cursor=cursor, limit=max(limit, 64)
+            )
+            for addr, doc in page:
+                if doc.get("tenant") != tenant:
+                    continue
+                out.append({
+                    "name": doc.get("name"),
+                    "address": addr,
+                    "size": doc.get("size"),
+                    "created": doc.get("created"),
+                })
+                if len(out) >= limit:
+                    return out, addr
+            if cursor is None:
+                return out, None
+        return out, cursor
+
+    # ------------------------------------------------------------ delete
+
+    def delete(self, tenant: str, name: str) -> None:
+        """Drop the manifest, release the quota, and evict stripes no
+        other manifest references. Local-only: replicas keep their
+        copies (v1 — see module docstring)."""
+        doc = self.resolve(tenant, name)
+        addr = doc["address"]
+        with self._lock:
+            self._index.pop((tenant, name), None)
+        self._drop_address(addr)
+        self._metrics.delete(tenant)
+
+    def _drop_address(self, addr: str) -> None:
+        doc = self.store.get_manifest(addr)
+        if doc is None:
+            return
+        tenant = doc.get("tenant", "")
+        self.store.delete_manifest(addr)
+        with self._lock:
+            if addr in self._known:
+                self._known.discard(addr)
+                used = self._usage.setdefault(tenant, [0, 0])
+                used[0] = max(0, used[0] - int(doc.get("size", 0)))
+                used[1] = max(0, used[1] - 1)
+                tenant_bytes = used[0]
+            else:
+                tenant_bytes = self._usage.get(tenant, [0, 0])[0]
+        self._metrics.tenant_bytes(tenant, tenant_bytes)
+        # Reference-count stripes across the surviving manifests before
+        # evicting (identical content shares stripes by construction —
+        # the key is the signature prefix of identical bytes).
+        refs: set[str] = set()
+        cursor = None
+        while True:
+            page, cursor = self.store.list_manifests(cursor=cursor, limit=256)
+            for _, other in page:
+                refs.update(other.get("stripes") or ())
+                ms = other.get("manifest_stripe")
+                if ms:
+                    refs.add(ms)
+            if cursor is None:
+                break
+        doomed = [
+            key for key in dict.fromkeys(
+                list(doc.get("stripes") or ())
+                + ([doc["manifest_stripe"]] if doc.get("manifest_stripe")
+                   else [])
+            )
+            if key not in refs
+        ]
+        for key in doomed:
+            self.store.evict(key)
+        if doomed and self.engine is not None:
+            self.engine.unpin_announce(doomed)

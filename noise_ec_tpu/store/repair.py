@@ -38,7 +38,7 @@ import threading
 import time
 import weakref
 from collections import OrderedDict
-from typing import Optional
+from typing import Iterable, Optional
 
 import numpy as np
 
@@ -139,6 +139,12 @@ class RepairEngine:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queue: OrderedDict[str, str] = {}  # key -> kind
+        # Stripe keys announced EVERY interval regardless of recency:
+        # the object service pins the stripes of namespaces with a
+        # replication target (tenant.replicas > 1), so peers that missed
+        # (or lost) them keep getting re-offered one shard per interval
+        # and NACK-pull the rest (docs/object-service.md).
+        self._pinned: set[str] = set()
         self._last_fetch: OrderedDict[str, float] = OrderedDict()
         self._last_respond: OrderedDict[str, float] = OrderedDict()
         self._batch_codecs: dict[tuple[int, int, str], object] = {}
@@ -173,6 +179,21 @@ class RepairEngine:
             return
         if kind is not None:
             self.enqueue(key, kind)
+
+    def pin_announce(self, keys: "Iterable[str]") -> None:
+        """Mark stripe keys as standing announce targets (per-namespace
+        replication): :meth:`announce_once` includes them beyond the
+        recency window until they are unpinned or evicted."""
+        with self._lock:
+            self._pinned.update(keys)
+
+    def unpin_announce(self, keys: "Iterable[str]") -> None:
+        with self._lock:
+            self._pinned.difference_update(keys)
+
+    def pinned_keys(self) -> list[str]:
+        with self._lock:
+            return sorted(self._pinned)
 
     def on_remote_interest(self, key: str) -> None:
         """A peer is moving shards of a stripe we hold (called from the
@@ -568,9 +589,13 @@ class RepairEngine:
         if peers is not None and not peers:
             return 0  # nobody listening; the next interval retries
         announced = 0
-        for key in self.store.recent_keys(
+        recent, _ = self.store.recent_keys(
             self.announce_window_seconds, self.announce_max_stripes
-        ):
+        )
+        # Pinned keys (namespace replication targets) ride every
+        # announce beyond the recency window; dict.fromkeys dedups while
+        # keeping the newest-first recents ahead of the standing set.
+        for key in dict.fromkeys(list(recent) + self.pinned_keys()):
             try:
                 meta, shards, unverified = self.store.snapshot(key)
             except UnknownStripeError:

@@ -28,6 +28,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import re
 import threading
 import time
 import weakref
@@ -50,6 +51,11 @@ __all__ = [
 log = logging.getLogger("noise_ec_tpu.store")
 
 _FIELD_SYM = {"gf256": 1, "gf65536": 2}
+
+# Manifest addresses are content hashes (hex); validated before they
+# become file names under <store_dir>/_manifests/.
+_MANIFEST_DIR = "_manifests"
+_ADDRESS_RE = re.compile(r"^[0-9a-f]{8,128}$")
 
 
 class UnknownStripeError(KeyError):
@@ -153,6 +159,17 @@ class StripeStore:
         self.max_stripes = max_stripes
         self._lock = threading.Lock()
         self._stripes: dict[str, _Stripe] = {}
+        # Object manifests (service/objects.py): content address ->
+        # manifest document. The stripe table holds codewords; this
+        # table holds the object layer's map from an object to its
+        # ordered stripe keys + geometry + size, persisted alongside
+        # the stripes so a restart restores the whole object space.
+        self._manifests: dict[str, dict] = {}
+        # Put listeners: called (key, data, meta) after every successful
+        # put_object — the object service absorbs replicated manifests
+        # through this hook (a verified receive lands here via the
+        # plugin before any listener sees it).
+        self._put_listeners: list[Callable] = []
         self._codecs: dict[tuple[int, int, str], ReedSolomon] = {}
         self._codec_lock = threading.Lock()
         self.shard_bytes = 0
@@ -180,6 +197,12 @@ class StripeStore:
 
     def bind_engine(self, engine) -> None:
         self._engine = weakref.ref(engine)
+
+    def add_put_listener(self, fn: Callable) -> None:
+        """Register ``fn(key, data, meta)`` to run after every successful
+        :meth:`put_object` (outside the store lock; exceptions are logged,
+        never raised — a listener must not break the put path)."""
+        self._put_listeners.append(fn)
 
     # ------------------------------------------------------------ writes
 
@@ -228,6 +251,12 @@ class StripeStore:
                 )
             self._replace_locked(meta.key, stripe)
         self._persist_stripe(stripe)
+        for fn in list(self._put_listeners):
+            try:
+                fn(meta.key, data, meta)
+            except Exception as exc:  # noqa: BLE001 — advisory hook only
+                log.warning("store put listener failed for %s: %s",
+                            meta.key, exc)
         return meta.key
 
     def write_repaired(
@@ -327,10 +356,23 @@ class StripeStore:
         with self._lock:
             return len(self._stripes)
 
-    def recent_keys(self, window_seconds: float,
-                    limit: int = 64) -> list[str]:
-        """Keys of stripes stored within the last ``window_seconds``,
-        newest first, capped at ``limit`` (the announce working set)."""
+    def recent_keys(
+        self,
+        window_seconds: float,
+        limit: int = 64,
+        cursor: Optional[str] = None,
+    ) -> tuple[list[str], Optional[str]]:
+        """One page of keys of stripes stored within the last
+        ``window_seconds``, newest first: ``(keys, next_cursor)``.
+
+        Pass the returned opaque ``next_cursor`` back to continue the
+        walk; ``None`` means the window is exhausted. A single-shot
+        caller (the announce loop's capped working set) just takes the
+        first page — but a LIST-style consumer can now iterate a large
+        store page by page instead of forcing one unbounded snapshot.
+        A stripe stored *while* paging appears at the front of a fresh
+        walk, never in the middle of an in-flight one (the cursor orders
+        strictly backward in arrival time)."""
         cutoff = time.monotonic() - window_seconds
         with self._lock:
             fresh = [
@@ -339,11 +381,79 @@ class StripeStore:
                 if s.created_at >= cutoff
             ]
         fresh.sort(reverse=True)
-        return [key for _, key in fresh[:limit]]
+        if cursor is not None:
+            try:
+                ts_text, _, ckey = cursor.partition(":")
+                pos = (float(ts_text), ckey)
+            except ValueError:
+                raise ValueError(f"bad recent_keys cursor {cursor!r}")
+            fresh = [entry for entry in fresh if entry < pos]
+        page = fresh[:limit]
+        next_cursor = (
+            f"{page[-1][0]!r}:{page[-1][1]}" if len(fresh) > limit else None
+        )
+        return [key for _, key in page], next_cursor
 
     def keys(self) -> list[str]:
         with self._lock:
             return list(self._stripes)
+
+    # ---------------------------------------------------------- manifests
+
+    def put_manifest(self, address: str, doc: dict) -> None:
+        """Store an object manifest under its content ``address`` (the
+        object service's map from one object to its ordered stripe keys
+        + geometry + size — docs/object-service.md). Re-putting replaces;
+        persisted under ``<store_dir>/_manifests/<address>.json``."""
+        if not _ADDRESS_RE.match(address):
+            raise ValueError(f"bad manifest address {address!r}")
+        with self._lock:
+            self._manifests[address] = dict(doc)
+        if self.store_dir:
+            d = os.path.join(self.store_dir, _MANIFEST_DIR)
+            os.makedirs(d, exist_ok=True)
+            self._atomic_write(
+                os.path.join(d, f"{address}.json"),
+                json.dumps(doc).encode(),
+            )
+
+    def get_manifest(self, address: str) -> Optional[dict]:
+        with self._lock:
+            doc = self._manifests.get(address)
+            return dict(doc) if doc is not None else None
+
+    def delete_manifest(self, address: str) -> bool:
+        with self._lock:
+            found = self._manifests.pop(address, None) is not None
+        if found and self.store_dir and _ADDRESS_RE.match(address):
+            try:
+                os.unlink(
+                    os.path.join(self.store_dir, _MANIFEST_DIR,
+                                 f"{address}.json")
+                )
+            except OSError:
+                pass
+        return found
+
+    def manifest_count(self) -> int:
+        with self._lock:
+            return len(self._manifests)
+
+    def list_manifests(
+        self, *, cursor: Optional[str] = None, limit: int = 64
+    ) -> tuple[list[tuple[str, dict]], Optional[str]]:
+        """One page of ``(address, manifest)`` pairs in address order:
+        ``(page, next_cursor)`` — the same cursor contract as
+        :meth:`recent_keys` (``None`` = exhausted; the cursor is the last
+        address served, iteration resumes strictly after it)."""
+        with self._lock:
+            addresses = sorted(self._manifests)
+            if cursor is not None:
+                addresses = [a for a in addresses if a > cursor]
+            page = addresses[:limit]
+            out = [(a, dict(self._manifests[a])) for a in page]
+        next_cursor = page[-1] if len(addresses) > limit else None
+        return out, next_cursor
 
     def meta(self, key: str) -> StripeMeta:
         with self._lock:
@@ -656,6 +766,24 @@ class StripeStore:
             with self._lock:
                 self._replace_locked(key, stripe)
             loaded += 1
+        manifest_dir = os.path.join(self.store_dir, _MANIFEST_DIR)
+        if os.path.isdir(manifest_dir):
+            for name in sorted(os.listdir(manifest_dir)):
+                if not name.endswith(".json"):
+                    continue
+                address = name[: -len(".json")]
+                if not _ADDRESS_RE.match(address):
+                    continue
+                try:
+                    with open(os.path.join(manifest_dir, name), "rb") as f:
+                        doc = json.load(f)
+                except (OSError, json.JSONDecodeError) as exc:
+                    log.warning("skipping unreadable manifest %s: %s",
+                                address, exc)
+                    continue
+                if isinstance(doc, dict):
+                    with self._lock:
+                        self._manifests[address] = doc
         return loaded
 
     def close(self) -> None:

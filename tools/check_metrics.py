@@ -44,7 +44,13 @@ and cross-checks every referenced name against the declarative registry:
   flags, ``tools/bench_gate.py``, the cost_analysis roofline, the
   device bucket set) must appear in docs/observability.md's "Device
   telemetry" section — they exist only as strings in the code, so the
-  METRICS-table check cannot see them drift.
+  METRICS-table check cannot see them drift;
+- **object-service docs parity**: the ``noise_ec_object_*`` families
+  and the service's operator surfaces (the ``/objects`` tree, the
+  ``-object-port`` / ``-tenants`` flags, the 503 ``Retry-After`` shed
+  contract, the manifest magic) must appear in docs/object-service.md
+  — that doc owns the API and tenancy semantics those series
+  instrument, the same two-home rule the resilience families follow.
 
 Run directly (``python tools/check_metrics.py``; exit 1 on problems) or
 through the tier-1 test that wraps it (tests/test_obs.py).
@@ -154,6 +160,7 @@ def check() -> list[str]:
     problems.extend(check_docs())
     problems.extend(check_resilience_docs())
     problems.extend(check_device_docs())
+    problems.extend(check_object_docs())
     return problems
 
 
@@ -217,6 +224,43 @@ def check_device_docs() -> list[str]:
         for tok in DEVICE_DOC_TOKENS
         if tok not in text
     ]
+
+
+# The object service's operator surfaces (docs/object-service.md owns
+# the API those series instrument): endpoints, CLI flags, the shed
+# contract and the manifest wire magic live only as strings in the code.
+OBJECT_DOC_TOKENS = (
+    "/objects",
+    "-object-port",
+    "-tenants",
+    "Retry-After",
+    "noise-ec-manifest/1",
+)
+
+
+def check_object_docs() -> list[str]:
+    """Object-service families + surfaces vs docs/object-service.md."""
+    from noise_ec_tpu.obs.registry import METRICS
+
+    doc_path = REPO / "docs" / "object-service.md"
+    names = [n for n in METRICS if n.startswith("noise_ec_object_")]
+    if not names:
+        return []
+    if not doc_path.exists():
+        return [f"docs file {doc_path} missing (object metrics exist)"]
+    text = doc_path.read_text(encoding="utf-8")
+    problems = [
+        f"object metric {n!r} is not documented in docs/object-service.md"
+        for n in names
+        if not re.search(rf"\b{re.escape(n)}\b", text)
+    ]
+    problems.extend(
+        f"object-service surface {tok} is not documented in "
+        "docs/object-service.md"
+        for tok in OBJECT_DOC_TOKENS
+        if tok not in text
+    )
+    return problems
 
 
 def check_docs() -> list[str]:
