@@ -523,6 +523,33 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — secondary stat only
         stats["chaos_recovery_error"] = str(exc)[:80]
 
+    # --- fleet lab: tier-1-sized in-process fleet throughput
+    # (docs/fleet.md). A 24-peer bounded-degree overlay drives a
+    # chat-only mix through the full per-peer plugin stack (sign ->
+    # shard -> per-link dispatch -> pool -> decode -> Ed25519 verify)
+    # on the shared fair dispatcher; the stat is traffic submissions
+    # per second with a 99.9% delivery smoke gate — the host-runtime
+    # cost of fleet-scale fan-out, not any one kernel.
+    try:
+        from noise_ec_tpu.fleet import FleetLab, FleetProfile
+
+        f_prof = FleetProfile.parse(
+            "peers=24,fanout=4,msgs=160,chat=1,chat_bytes=64,chaos=clean"
+        )
+        f_lab = FleetLab(f_prof, seed=7)
+        f_lab.start()
+        f_report = f_lab.run()
+        f_lab.close()
+        check_smoke(
+            f_report["delivery"]["rate"] >= 0.999,
+            f"fleet bench delivery {f_report['delivery']}",
+        )
+        stats["fleet_msgs_per_s"] = f_report["msgs_per_s"]
+    except SmokeMismatch:
+        raise  # deterministic correctness failure: fail the run
+    except Exception as exc:  # noqa: BLE001 — secondary stat only
+        stats["fleet_error"] = str(exc)[:80]
+
     if dev.kernel == "pallas":
         # Correctness smoke BEFORE any timing: the bench must not be the
         # first time a shape runs on real hardware — one small fused encode

@@ -1,0 +1,668 @@
+"""The fleet lab: hundreds–thousands of lightweight in-process peers.
+
+A :class:`FleetLab` is the load/chaos harness the ROADMAP's
+"fleet-scale scenario harness" item calls for: every peer is a REAL
+:class:`~noise_ec_tpu.host.plugin.ShardPlugin` (the full receive state
+machine: pool, decode, Berlekamp–Welch repair, Ed25519 verify, stripe
+store, SLO evaluator) behind a *network-shaped* shim — no subprocess,
+no socket, no event loop per node. What scale costs is concentrated in
+three shared structures:
+
+- **cheap identity** — per-peer Ed25519 keys derived from the lab seed
+  (``KeyPair.from_seed``), so a thousand identities cost a thousand
+  hashes + keygens and the same seed reproduces every signature;
+- **bounded-degree overlay** — each peer broadcasts to a fixed, seeded
+  ``fanout``-sized neighbor set (real fleets are not full meshes; a
+  1000-peer full mesh would be O(P²) deliveries per message);
+- **one shared dispatcher** — deliveries ride a
+  :class:`~noise_ec_tpu.host.transport._SerialDispatcher` keyed by
+  (sender, receiver) link, exactly the TCP transport's per-sender
+  ordered dispatch shape, via the BLOCKING ``submit_wait`` entry: a
+  full link window makes the producer yield (backpressure), never drop.
+
+Chaos composes per link: every directed edge gets its own seeded
+:class:`~noise_ec_tpu.resilience.chaos.ChaosLink` (the proxy's pure
+frame pipeline), so drop/corrupt/reorder/partition faults hit the
+marshaled wire bytes with the same reproducibility contract as the TCP
+chaos proxy. Churn reuses the ``ChaosProfile`` ``churn@`` primitive:
+each churned peer expands its own seeded kill/restart schedule
+(``churn_windows(stream=peer_index)``).
+
+Admission (fleet-wide load shedding): a sender whose local SLO verdict
+is degraded sheds new chat submissions with a Retry-After hint instead
+of broadcasting (``noise_ec_fleet_shed_total{reason="slo"}``); object
+traffic sheds through the object service's own PR-6 admission path
+(:class:`~noise_ec_tpu.service.objects.ShedError`). The scorer counts
+shed separately from lost (fleet/score.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import struct
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from noise_ec_tpu.fleet.profile import FleetProfile
+from noise_ec_tpu.fleet.score import FleetScorer
+from noise_ec_tpu.host.crypto import KeyPair, PeerID
+from noise_ec_tpu.host.plugin import ShardPlugin
+from noise_ec_tpu.host.transport import Ctx, _SerialDispatcher
+from noise_ec_tpu.host.wire import Shard, WireError
+from noise_ec_tpu.obs.health import SLOEvaluator
+from noise_ec_tpu.obs.registry import default_registry
+from noise_ec_tpu.obs.trace import default_tracer
+from noise_ec_tpu.resilience.chaos import ChaosLink
+
+__all__ = ["FleetLab", "FleetPeer"]
+
+log = logging.getLogger("noise_ec_tpu.fleet")
+
+# Chat payload header: magic + u32 msg_id, then seeded filler. The
+# receiver callback matches deliveries back to submissions by it; any
+# verified object without the magic (object stripes, manifests) is
+# simply not a scored chat message.
+_HDR = b"FLT1"
+_HDR_LEN = len(_HDR) + 4
+
+# How far ahead churn schedules expand (run horizon; soaks are minutes).
+CHURN_HORIZON = 3600.0
+
+
+class FleetPeer:
+    """One lightweight in-process node (module docstring).
+
+    Network-shaped: exposes ``id`` / ``keys`` / ``broadcast`` — the
+    slice of the transport surface ``ShardPlugin`` and the object
+    service drive — so the production plugin runs unmodified."""
+
+    def __init__(self, lab: "FleetLab", idx: int, keys: KeyPair,
+                 profile: FleetProfile):
+        self._lab = weakref.ref(lab)
+        self.idx = idx
+        self.keys = keys
+        self.id = PeerID.create(f"fleet://{idx}", keys.public_key)
+        self.up = True
+        self.kill_times: list[float] = []
+        self.neighbors: tuple[int, ...] = ()
+        # Tolerant targets: a corrupted-then-BW-repaired message records
+        # verify_failed AND ok, so a strict 0.99 success target would
+        # shed on every transient corruption; shedding should gate on
+        # SUSTAINED degradation (the scorer owns final-delivery truth).
+        self.slo = SLOEvaluator(
+            window_seconds=15.0, min_events=8,
+            success_rate_target=lab.slo_success_target,
+            p99_target_seconds=lab.p99_target_seconds,
+        )
+        self.store = None
+        self.objects = None
+        if profile.needs_stores():
+            from noise_ec_tpu.store import StripeStore
+
+            self.store = StripeStore(backend="numpy")
+        self.plugin = ShardPlugin(
+            backend="numpy",
+            minimum_needed_shards=profile.k,
+            total_shards=profile.n,
+            on_message=self._on_message,
+            store=self.store,
+            slo=self.slo,
+        )
+        # NACK repair needs a directed transport (send_to) and its
+        # broadcast rounds would multiply fleet traffic; parity plus the
+        # scorer's explicit loss accounting own the loss story here.
+        self.plugin.nack_grace_seconds = 0.0
+        if self.store is not None:
+            from noise_ec_tpu.service import ObjectStore
+
+            self.objects = ObjectStore(
+                self.store, self.plugin, self,
+                stripe_bytes=profile.stripe_bytes,
+                k=profile.k, n=profile.n,
+                slo=self.slo,
+                # A below-k stripe with no repair engine cannot heal;
+                # fail reads fast instead of stalling the scorer.
+                fetch_timeout_seconds=0.2,
+            )
+
+    # ---- the network surface the plugin drives
+
+    def broadcast(self, msg: Shard) -> None:
+        lab = self._lab()
+        if lab is not None:
+            lab.hub.fan_out(self, msg.marshal())
+
+    def _on_message(self, message: bytes, sender: PeerID) -> None:
+        if len(message) < _HDR_LEN or message[:4] != _HDR:
+            return  # an object stripe / manifest, not a scored chat
+        (msg_id,) = struct.unpack_from("<I", message, 4)
+        lab = self._lab()
+        if lab is not None:
+            lab.scorer.deliver(msg_id, self.idx)
+
+
+class FleetHub:
+    """Link fabric + shared delivery dispatcher (module docstring)."""
+
+    def __init__(self, lab: "FleetLab", workers: int, link_window: int):
+        self._lab = weakref.ref(lab)
+        self.dispatch = _SerialDispatcher(
+            max_workers=workers, max_queue=link_window,
+            on_error=lab._record_error,
+        )
+        self.links: dict[tuple[int, int], ChaosLink] = {}
+        self.frame_errors = 0
+        self.dropped = 0  # submit_wait timeouts (counted as overflow too)
+        self._t0 = time.monotonic()
+
+    def now(self) -> float:
+        return time.monotonic() - self._t0
+
+    def fan_out(self, sender: FleetPeer, wire: bytes) -> None:
+        """Deliver one marshaled shard to the sender's up-neighbors
+        through each link's chaos pipeline. Runs on the driver thread —
+        a full link window BLOCKS here (submit_wait), which is the
+        transport tier of the backpressure chain."""
+        lab = self._lab()
+        if lab is None:
+            return
+        now = self.now()
+        for ridx in sender.neighbors:
+            receiver = lab.peers[ridx]
+            if not receiver.up:
+                continue
+            link = self.links[(sender.idx, ridx)]
+            for buf, delay in link.admit(wire, now):
+                if not self.dispatch.submit_wait(
+                    struct.pack("<II", sender.idx, ridx),
+                    self._deliver, receiver, buf, sender.id, delay,
+                ):
+                    self.dropped += 1
+
+    def _deliver(self, receiver: FleetPeer, buf: bytes, sender_pid: PeerID,
+                 delay: float) -> None:
+        if delay > 0:
+            # Link delay/bandwidth shaping; capped so a mis-profiled
+            # delay cannot wedge a dispatch worker.
+            time.sleep(min(delay, 0.25))
+        if not receiver.up:
+            return  # killed mid-flight; the scorer classifies it churned
+        try:
+            msg = Shard.unmarshal(buf)
+        except WireError:
+            self.frame_errors += 1  # corrupt-faulted frame
+            return
+        lab = self._lab()
+        try:
+            receiver.plugin.receive(Ctx(msg, sender_pid))
+        except Exception as exc:  # noqa: BLE001 — isolate the fabric
+            if lab is not None:
+                lab._record_error(exc)
+
+    def chaos_stats(self) -> dict:
+        agg: dict[str, int] = {}
+        for link in self.links.values():
+            for key, val in link.stats().items():
+                agg[key] = agg.get(key, 0) + val
+        agg["frame_errors"] = self.frame_errors
+        agg["window_timeouts"] = self.dropped
+        return agg
+
+
+class FleetLab:
+    """Build, drive, score and report one fleet run (module docstring).
+
+    Lifecycle: ``start()`` (peers, topology, links, churn schedule) →
+    ``run()`` (drive the traffic mix, wait for drain, return the scored
+    report) → optionally ``write_report`` / ``write_trace`` →
+    ``close()``. ``attach(stats_server)`` mounts ``GET /fleet`` and
+    folds the live fleet block into ``/healthz`` details.
+    """
+
+    def __init__(
+        self,
+        profile: FleetProfile,
+        *,
+        size: Optional[int] = None,
+        seed: int = 0,
+        p99_target_seconds: float = 2.0,
+        slo_success_target: float = 0.85,
+        dispatch_workers: int = 4,
+        link_window: int = 512,
+        shed_retry_after: float = 2.0,
+    ):
+        if size is not None:
+            profile = dataclasses.replace(profile, peers=size)
+            profile.validate()
+        self.profile = profile
+        self.seed = seed
+        self.p99_target_seconds = p99_target_seconds
+        self.slo_success_target = slo_success_target
+        self.dispatch_workers = dispatch_workers
+        self.link_window = link_window
+        self.shed_retry_after = shed_retry_after
+        self.peers: list[FleetPeer] = []
+        self.hub: Optional[FleetHub] = None
+        self.scorer = FleetScorer()
+        self.errors: deque = deque(maxlen=256)
+        self.error_count = 0
+        self.last_report: Optional[dict] = None
+        self._churn_events: list[tuple[float, str, int]] = []
+        self._churn_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._started = False
+        reg = default_registry()
+        self._churn_kill = reg.counter(
+            "noise_ec_fleet_churn_events_total"
+        ).labels(event="kill")
+        self._churn_restart = reg.counter(
+            "noise_ec_fleet_churn_events_total"
+        ).labels(event="restart")
+        ref = weakref.ref(self)
+        reg.gauge("noise_ec_fleet_peers").set_callback(
+            lambda: _count_peers(ref, up=True), state="up"
+        )
+        reg.gauge("noise_ec_fleet_peers").set_callback(
+            lambda: _count_peers(ref, up=False), state="down"
+        )
+
+    def _record_error(self, exc: Exception) -> None:
+        self.errors.append(exc)
+        self.error_count += 1
+
+    # -------------------------------------------------------------- build
+
+    def start(self) -> "FleetLab":
+        if self._started:
+            return self
+        self._started = True
+        prof = self.profile
+        # Cheap, reproducible identities: one blake2b per peer seeds its
+        # Ed25519 keypair.
+        for idx in range(prof.peers):
+            seed32 = hashlib.blake2b(
+                b"noise-ec-fleet\0" + struct.pack("<QI", self.seed & (2**64 - 1), idx),
+                digest_size=32,
+            ).digest()
+            self.peers.append(
+                FleetPeer(self, idx, KeyPair.from_seed(seed32), prof)
+            )
+        # Bounded-degree overlay: each peer draws `fanout` distinct
+        # neighbors from one seeded stream.
+        topo_rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed & 0xFFFFFFFF, 0x70B0])
+        )
+        for peer in self.peers:
+            others = [i for i in range(prof.peers) if i != peer.idx]
+            picks = topo_rng.choice(
+                len(others), size=prof.fanout, replace=False
+            )
+            peer.neighbors = tuple(others[int(i)] for i in picks)
+        self.hub = FleetHub(
+            self, workers=self.dispatch_workers,
+            link_window=self.link_window,
+        )
+        for peer in self.peers:
+            for ridx in peer.neighbors:
+                conn_id = peer.idx * prof.peers + ridx
+                self.hub.links[(peer.idx, ridx)] = ChaosLink(
+                    prof.chaos, self.seed, conn_id, "a2b"
+                )
+        if prof.chaos.churns:
+            self._schedule_churn()
+        log.info(
+            "fleet lab: %d peers, fanout %d, %d links, chaos=%s%s",
+            prof.peers, prof.fanout, len(self.hub.links), prof.chaos_name,
+            f", churn over {len(set(i for _, _, i in self._churn_events))} "
+            "peer(s)" if self._churn_events else "",
+        )
+        return self
+
+    def _schedule_churn(self) -> None:
+        prof = self.profile
+        count = prof.churn_peers or max(1, prof.peers // 20)
+        count = min(count, prof.peers)
+        churn_rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed & 0xFFFFFFFF, 0xC0C0])
+        )
+        churned = churn_rng.choice(prof.peers, size=count, replace=False)
+        events: list[tuple[float, str, int]] = []
+        for idx in sorted(int(i) for i in churned):
+            for start, down in prof.chaos.churn_windows(
+                self.seed, horizon=CHURN_HORIZON, stream=idx
+            ):
+                events.append((start, "kill", idx))
+                events.append((start + down, "restart", idx))
+        self._churn_events = sorted(events)
+
+    def _churn_run(self) -> None:
+        hub = self.hub
+        for t, event, idx in self._churn_events:
+            delay = t - hub.now()
+            if delay > 0 and self._stop.wait(delay):
+                return
+            peer = self.peers[idx]
+            if event == "kill":
+                peer.up = False
+                peer.kill_times.append(time.monotonic())
+                self._churn_kill.add(1)
+            else:
+                peer.up = True
+                self._churn_restart.add(1)
+
+    # --------------------------------------------------------------- drive
+
+    def run(self, drain_timeout: float = 60.0) -> dict:
+        """Drive the profile's traffic mix to completion, wait for the
+        delivery fabric to drain, verify object GETs, and return the
+        scored report."""
+        if not self._started:
+            self.start()
+        prof = self.profile
+        if self._churn_events and self._churn_thread is None:
+            self._churn_thread = threading.Thread(
+                target=self._churn_run, name="noise-ec-fleet-churn",
+                daemon=True,
+            )
+            self._churn_thread.start()
+        t0 = time.monotonic()
+        sender_idxs = list(range(prof.peers))
+        if prof.senders:
+            sender_idxs = sender_idxs[: prof.senders]
+        n_drivers = prof.drivers or min(4, len(sender_idxs))
+        # Disjoint sender partitions keep per-link frame order owned by
+        # exactly one thread — the chaos reproducibility contract.
+        partitions = [sender_idxs[d::n_drivers] for d in range(n_drivers)]
+        quotas = [
+            prof.msgs // n_drivers + (1 if d < prof.msgs % n_drivers else 0)
+            for d in range(n_drivers)
+        ]
+        threads = [
+            threading.Thread(
+                target=self._drive, name=f"noise-ec-fleet-drive-{d}",
+                args=(partitions[d], quotas[d], d), daemon=True,
+            )
+            for d in range(n_drivers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self._wait_drained(drain_timeout)
+        self._verify_objects()
+        duration = time.monotonic() - t0
+        report = self.scorer.report(
+            {p.idx: list(p.kill_times) for p in self.peers}, duration
+        )
+        report["peers"] = prof.peers
+        report["fanout"] = prof.fanout
+        report["chaos_profile"] = prof.chaos_name
+        report["chaos"] = self.hub.chaos_stats()
+        report["churn"] = {
+            "scheduled": len(self._churn_events),
+            "kills_applied": sum(len(p.kill_times) for p in self.peers),
+        }
+        report["errors"] = self.error_count
+        report["backpressure_waits"] = _backpressure_waits()
+        self.last_report = report
+        return report
+
+    def _drive(self, senders: list[int], quota: int, stream: int) -> None:
+        prof = self.profile
+        rng = np.random.default_rng(
+            np.random.SeedSequence(
+                [self.seed & 0xFFFFFFFF, 0xD21F, stream]
+            )
+        )
+        weights = prof.weights()
+        cuts = (
+            weights["chat"],
+            weights["chat"] + weights["object"],
+        )
+        si = 0
+        for _ in range(quota):
+            peer = None
+            for _ in range(len(senders)):
+                cand = self.peers[senders[si % len(senders)]]
+                si += 1
+                if cand.up:
+                    peer = cand
+                    break
+            if peer is None:
+                continue  # every sender in this partition is down
+            roll = float(rng.random())
+            try:
+                if roll < cuts[0] or (peer.objects is None and prof.chat > 0):
+                    self.submit_chat(peer, rng)
+                elif roll < cuts[1]:
+                    self.submit_object(peer, rng)
+                else:
+                    self.submit_repair(peer, rng)
+            except Exception as exc:  # noqa: BLE001 — one bad submission
+                # must not end the driver
+                self._record_error(exc)
+            if prof.rate > 0:
+                time.sleep(1.0 / prof.rate)
+
+    # ---- submission kinds (public: tests drive custom patterns)
+
+    def _expected(self, sender: FleetPeer, stores_only: bool = False) -> tuple:
+        return tuple(
+            r for r in sender.neighbors
+            if self.peers[r].up
+            and (not stores_only or self.peers[r].objects is not None)
+        )
+
+    def submit_chat(self, sender: FleetPeer, rng) -> Optional[int]:
+        """One chat-sized broadcast; returns the msg_id or None when
+        shed/skipped. Admission: a degraded local SLO verdict sheds the
+        submission with a Retry-After hint (scored separately)."""
+        if not sender.up:
+            return None
+        if not sender.slo.verdict()["healthy"]:
+            self.scorer.shed(
+                "chat", sender.idx, "slo", self.shed_retry_after
+            )
+            return None
+        expected = self._expected(sender)
+        msg_id = self.scorer.begin("chat", sender.idx, expected)
+        prof = self.profile
+        body = _HDR + struct.pack("<I", msg_id)
+        fill = max(0, prof.chat_bytes - len(body))
+        payload = body + rng.bytes(fill)
+        pad = (-len(payload)) % prof.k
+        payload += bytes(pad)
+        sender.plugin.shard_and_broadcast(
+            sender, payload, geometry=(prof.k, prof.n)
+        )
+        return msg_id
+
+    def submit_object(self, sender: FleetPeer, rng) -> Optional[int]:
+        """One object PUT through the service layer; the matching GETs
+        are verified from every expected receiver's service after the
+        run (fleet/score.py)."""
+        if not sender.up or sender.objects is None:
+            return None
+        from noise_ec_tpu.service.objects import ShedError
+
+        prof = self.profile
+        payload = rng.bytes(prof.object_bytes)
+        expected = self._expected(sender, stores_only=True)
+        name = f"o{sender.idx}-{int(rng.integers(0, 2**31))}"
+        try:
+            sender.objects.put("fleet", name, payload)
+        except ShedError as exc:
+            self.scorer.shed("object", sender.idx, exc.reason,
+                             exc.retry_after)
+            return None
+        msg_id = self.scorer.begin("object", sender.idx, expected)
+        self.scorer.add_object(
+            msg_id, "fleet", name,
+            hashlib.blake2b(payload, digest_size=16).digest(),
+        )
+        return msg_id
+
+    def submit_repair(self, sender: FleetPeer, rng) -> None:
+        """One repair-storm op: drop a shard from a random stored stripe
+        and degraded-read it back through the codec (success/failure is
+        scored; falls back to chat while the store is still empty)."""
+        if sender.store is None:
+            self.submit_chat(sender, rng)
+            return
+        keys = sender.store.keys()
+        if not keys:
+            self.submit_chat(sender, rng)
+            return
+        key = keys[int(rng.integers(0, len(keys)))]
+        try:
+            sender.store.drop_shard(
+                key, int(rng.integers(0, self.profile.k))
+            )
+            sender.store.read(key)  # degraded read reconstructs
+        except Exception as exc:  # noqa: BLE001 — scored, not raised
+            self.scorer.repair_result(False)
+            self._record_error(exc)
+        else:
+            self.scorer.repair_result(True)
+
+    def _wait_drained(self, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        idle_since = None
+        while time.monotonic() < deadline:
+            if self.hub.dispatch.queue_depth() == 0:
+                if idle_since is None:
+                    idle_since = time.monotonic()
+                elif time.monotonic() - idle_since > 0.25:
+                    return
+            else:
+                idle_since = None
+            time.sleep(0.02)
+
+    def _verify_objects(self) -> None:
+        """Post-run GET verification: every expected receiver must serve
+        each put object byte-identical through its own service layer."""
+        with self.scorer._lock:
+            objects = dict(self.scorer.objects)
+            sent = {m: dict(r) for m, r in self.scorer.sent.items()}
+        for msg_id, obj in objects.items():
+            rec = sent.get(msg_id)
+            if rec is None:
+                continue
+            for ridx in rec["expected"]:
+                receiver = self.peers[ridx]
+                if receiver.objects is None:
+                    continue
+                try:
+                    data = receiver.objects.read(obj["tenant"], obj["name"])
+                except Exception:  # noqa: BLE001 — not delivered
+                    continue
+                digest = hashlib.blake2b(data, digest_size=16).digest()
+                if digest == obj["digest"]:
+                    # Latency is not meaningful for a post-run read;
+                    # stamp the send time so it lands as 0 and the
+                    # report's latency stats skip it.
+                    self.scorer.deliver(msg_id, ridx, now=rec["t"])
+
+    # ------------------------------------------------------------ surfaces
+
+    def health_block(self) -> dict:
+        """The ``fleet`` block folded into ``/healthz`` details while a
+        lab is attached (docs/fleet.md)."""
+        snap = self.scorer.snapshot()
+        up = sum(1 for p in self.peers if p.up)
+        expected = snap["expected_deliveries"]
+        return {
+            "peers": len(self.peers),
+            "up": up,
+            "down": len(self.peers) - up,
+            "sent": snap["sent"],
+            "delivered": snap["delivered"],
+            "shed": snap["shed"],
+            "delivery_rate": round(
+                snap["delivered"] / max(1, expected), 6
+            ),
+        }
+
+    def attach(self, server) -> None:
+        """Mount ``GET /fleet`` on a StatsServer and fold the live fleet
+        block into its ``/healthz`` details."""
+        server.mount("GET", "/fleet", self._route_fleet)
+        prev = server.health_details
+        ref = weakref.ref(self)
+
+        def details() -> dict:
+            out: dict = {}
+            if prev is not None:
+                try:
+                    out.update(prev())
+                except Exception as exc:  # noqa: BLE001 — same contract
+                    # as StatsServer: details must never break the probe
+                    out["error"] = str(exc)
+            lab = ref()
+            if lab is not None:
+                out["fleet"] = lab.health_block()
+            return out
+
+        server.health_details = details
+
+    def _route_fleet(self, req: dict) -> tuple:
+        doc = {
+            "profile": {
+                "peers": self.profile.peers,
+                "fanout": self.profile.fanout,
+                "chaos": self.profile.chaos_name,
+                "mix": self.profile.weights(),
+            },
+            "live": self.health_block(),
+            "report": self.last_report,
+        }
+        return 200, "application/json", json.dumps(doc, indent=1).encode()
+
+    def write_report(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.last_report or {}, f, indent=1)
+
+    def write_trace(self, path: str) -> dict:
+        """Write the fleet-wide merged Perfetto trace: every peer shares
+        the process tracer, so one dump IS the merged fleet view (spans
+        carry the message trace ids; the single ``node`` track is the
+        lab itself)."""
+        from noise_ec_tpu.obs.perfetto import write_chrome_trace
+
+        spans = default_tracer().dump()
+        label = f"fleet[{len(self.peers)} peers]"
+        for s in spans:
+            s.setdefault("node", label)
+        return write_chrome_trace(path, spans)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._churn_thread is not None:
+            self._churn_thread.join(timeout=5)
+            self._churn_thread = None
+        if self.hub is not None:
+            self.hub.dispatch.shutdown(wait=True)
+
+
+def _count_peers(ref, up: bool) -> int:
+    lab = ref()
+    if lab is None:
+        return 0
+    return sum(1 for p in lab.peers if p.up == up)
+
+
+def _backpressure_waits() -> float:
+    """Total producer waits across layers (report convenience)."""
+    total = 0.0
+    fam = default_registry().counter("noise_ec_backpressure_waits_total")
+    for _, child in fam.children():
+        total += child.value
+    return total

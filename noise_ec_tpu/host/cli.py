@@ -172,6 +172,33 @@ def build_parser() -> argparse.ArgumentParser:
         "profile + frame order reproduces the run)",
     )
     p.add_argument(
+        "-fleet-profile",
+        default="",
+        metavar="PROFILE",
+        help="run the in-process fleet lab instead of the REPL: spin up "
+        "PROFILE's peers (e.g. 'peers=200,fanout=6,msgs=500,chat=0.9,"
+        "object=0.1,chaos=lossy,churn@2:4:0.5' — docs/fleet.md for the "
+        "grammar), drive the traffic mix, score delivery/shed/lost, and "
+        "exit. -chaos-seed seeds the run; with -metrics-port the live "
+        "status serves on GET /fleet and inside /healthz details",
+    )
+    p.add_argument(
+        "-fleet-size",
+        type=int,
+        default=0,
+        metavar="N",
+        help="override the peers= count of -fleet-profile (0 keeps the "
+        "profile's value)",
+    )
+    p.add_argument(
+        "-fleet-report",
+        default="",
+        metavar="PATH",
+        help="write the scored fleet report JSON to PATH and the "
+        "fleet-wide merged Perfetto trace to PATH.trace.json "
+        "(requires -fleet-profile)",
+    )
+    p.add_argument(
         "-metrics-port",
         type=int,
         default=-1,
@@ -406,7 +433,45 @@ def main(argv: list[str] | None = None) -> int:
     if peers:
         net.bootstrap(peers)
 
+    fleet_lab = None
     try:
+        if args.fleet_profile:
+            # Fleet-lab mode (docs/fleet.md): drive the declarative
+            # traffic mix across an in-process fleet, score it, and
+            # exit — no REPL. The TCP node above keeps serving its
+            # endpoints while the lab runs, so /fleet and /healthz show
+            # live status.
+            from noise_ec_tpu.fleet import FleetLab, FleetProfile
+
+            fleet_profile = FleetProfile.parse(args.fleet_profile)
+            fleet_lab = FleetLab(
+                fleet_profile,
+                size=args.fleet_size or None,
+                seed=args.chaos_seed,
+            )
+            fleet_lab.start()
+            if stats_server is not None:
+                fleet_lab.attach(stats_server)
+                log.info("fleet status on %s/fleet", stats_server.url)
+            with device_trace(args.trace):
+                report = fleet_lab.run()
+            log.info(
+                "fleet run: %d peers, %d sent, delivery %.4f "
+                "(%d delivered / %d lost / %d churned), %d shed",
+                report["peers"], report["sent"],
+                report["delivery"]["rate"], report["delivery"]["delivered"],
+                report["delivery"]["lost"], report["delivery"]["churned"],
+                report["shed"]["total"],
+            )
+            if args.fleet_report:
+                fleet_lab.write_report(args.fleet_report)
+                doc = fleet_lab.write_trace(args.fleet_report + ".trace.json")
+                log.info(
+                    "fleet report written to %s (+%d-span Perfetto trace)",
+                    args.fleet_report,
+                    len(doc.get("traceEvents", [])),
+                )
+            return 0
         with device_trace(args.trace):
             for line in sys.stdin:  # blocking REPL, main.go:175-198
                 stripped = line.rstrip("\n")
@@ -437,6 +502,8 @@ def main(argv: list[str] | None = None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if fleet_lab is not None:
+            fleet_lab.close()
         if scrubber is not None:
             scrubber.close()
         if engine is not None:

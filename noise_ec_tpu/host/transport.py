@@ -393,22 +393,36 @@ class _SerialDispatcher:
         self._pool = ThreadPoolExecutor(
             max_workers=max_workers, thread_name_prefix="noise-ec-dispatch"
         )
-        self._lock = threading.Lock()
+        # A Condition, not a bare Lock: submit_wait blocks on it until a
+        # drain frees window space (``with self._lock`` still takes the
+        # underlying lock everywhere else).
+        self._lock = threading.Condition(threading.Lock())
         self._queues: dict[bytes, deque] = {}
         self._active: set[bytes] = set()
         self.max_queue = max_queue
         self.overflows = 0
+        self._waiters = 0  # submit_wait callers currently blocked
         reg = default_registry()
         self._overflow_counter = reg.counter(
             "noise_ec_dispatch_overflows_total"
         ).labels()
         self._latency_hist = reg.histogram("noise_ec_dispatch_seconds").labels()
+        self._bp_waits = reg.counter(
+            "noise_ec_backpressure_waits_total"
+        ).labels(layer="dispatch")
+        self._bp_hist = reg.histogram(
+            "noise_ec_backpressure_wait_seconds"
+        ).labels(layer="dispatch")
         cls = type(self)
         cls._instances.add(self)
         if not cls._gauge_registered:
             cls._gauge_registered = True
             reg.gauge("noise_ec_dispatch_queue_depth").set_callback(
                 lambda: sum(d.queue_depth() for d in list(cls._instances))
+            )
+            reg.gauge("noise_ec_backpressure_queue_depth").set_callback(
+                lambda: sum(d._waiters for d in list(cls._instances)),
+                layer="dispatch",
             )
         # Error contract: a handler that raises is reported to ``on_error``
         # (an ``(exc) -> None`` recorder) and counted — never silently
@@ -433,13 +447,65 @@ class _SerialDispatcher:
                 self._pool.submit(self._drain, key)
         return True
 
-    # Items drained per pool turn: a continuously-busy sender yields the
-    # worker back to the pool every batch, so max_workers concurrent hot
-    # senders cannot starve everyone else's delivery.
+    def submit_wait(self, key: bytes, fn, *args,
+                    timeout: float = 30.0) -> bool:
+        """Blocking submit: when ``key``'s window is full, BLOCK the
+        producer until a drain frees space instead of dropping — the
+        backpressure shape for in-process producers (the fleet hub),
+        who would rather slow than lose deliveries. Never call from the
+        drain pool or an event-loop thread (the drain this waits for may
+        be behind the caller). Returns False only when ``timeout``
+        expires with the window still full (counted as an overflow)."""
+        t0 = None
+        deadline = 0.0
+        try:
+            with self._lock:
+                while True:
+                    q = self._queues.setdefault(key, deque())
+                    if len(q) < self.max_queue:
+                        q.append((fn, args))
+                        if key not in self._active:
+                            self._active.add(key)
+                            self._pool.submit(self._drain, key)
+                        return True
+                    if t0 is None:
+                        t0 = time.monotonic()
+                        deadline = t0 + timeout
+                        self._bp_waits.add(1)
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        self.overflows += 1
+                        self._overflow_counter.add(1)
+                        return False
+                    self._waiters += 1
+                    try:
+                        self._lock.wait(min(remaining, 0.5))
+                    finally:
+                        self._waiters -= 1
+        finally:
+            if t0 is not None:
+                self._bp_hist.observe(time.monotonic() - t0)
+
+    # Items drained per pool turn when ONE sender is active: a
+    # continuously-busy sender yields the worker back to the pool every
+    # batch, so max_workers concurrent hot senders cannot starve
+    # everyone else's delivery. With several senders active the quantum
+    # shrinks (deficit round-robin, _drain) so a spammy peer's deep
+    # queue cannot hold a worker for a full batch while a quiet peer's
+    # single delivery waits.
     DRAIN_BATCH = 16
 
     def _drain(self, key: bytes) -> None:
-        for _ in range(self.DRAIN_BATCH):
+        # Per-peer fairness: the per-turn quantum divides the batch
+        # budget across the senders currently active, floored at 1 —
+        # one 10x talker gets the same per-rotation slice as everyone
+        # else, so quiet peers' deliveries interleave within ~one
+        # rotation instead of waiting out full DRAIN_BATCH turns
+        # (pinned by tests/test_fleet.py).
+        with self._lock:
+            active = len(self._active) or 1
+        quantum = max(1, self.DRAIN_BATCH // active)
+        for _ in range(quantum):
             with self._lock:
                 q = self._queues.get(key)
                 if not q:
@@ -447,6 +513,8 @@ class _SerialDispatcher:
                     self._queues.pop(key, None)
                     return
                 fn, args = q.popleft()
+                if self._waiters:
+                    self._lock.notify_all()
             try:
                 with Timer(histogram=self._latency_hist):
                     fn(*args)

@@ -441,6 +441,63 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "End-to-end GET/range latency through stripe reads and decode",
         (),
     ),
+    # --- backpressure (ops/dispatch.py device gate, host/transport.py
+    # dispatcher; docs/fleet.md owns the propagation story)
+    "noise_ec_backpressure_waits_total": (
+        "counter",
+        "Times a producer blocked on a bounded queue instead of growing "
+        "it, labeled by layer (device = the device dispatch gate, "
+        "dispatch = a sender's delivery window)",
+        ("layer",),
+    ),
+    "noise_ec_backpressure_wait_seconds": (
+        "histogram",
+        "Time producers spent blocked on a bounded queue, labeled by "
+        "layer (device, dispatch)",
+        ("layer",),
+    ),
+    "noise_ec_backpressure_queue_depth": (
+        "gauge",
+        "Occupied slots plus blocked producers per bounded queue, "
+        "labeled by layer (device, dispatch), read at collect time",
+        ("layer",),
+    ),
+    # --- fleet lab (noise_ec_tpu/fleet, docs/fleet.md)
+    "noise_ec_fleet_peers": (
+        "gauge",
+        "In-process fleet peers by state (up, down), read at collect "
+        "time while a lab is live",
+        ("state",),
+    ),
+    "noise_ec_fleet_messages_total": (
+        "counter",
+        "Fleet traffic submissions admitted for broadcast, labeled by "
+        "kind (chat, object, repair)",
+        ("kind",),
+    ),
+    "noise_ec_fleet_deliveries_total": (
+        "counter",
+        "Verified fleet deliveries observed by receiver peers",
+        (),
+    ),
+    "noise_ec_fleet_shed_total": (
+        "counter",
+        "Fleet submissions shed at admission with a Retry-After hint "
+        "(scored separately from lost), labeled by reason (slo)",
+        ("reason",),
+    ),
+    "noise_ec_fleet_lost_total": (
+        "counter",
+        "Expected fleet deliveries scored as lost (not delivered, not "
+        "shed, receiver not churned mid-flight)",
+        (),
+    ),
+    "noise_ec_fleet_churn_events_total": (
+        "counter",
+        "Churn schedule transitions applied to fleet peers, labeled by "
+        "event (kill, restart)",
+        ("event",),
+    ),
     # --- shard mempool (host/mempool.py)
     "noise_ec_mempool_pools": (
         "gauge",

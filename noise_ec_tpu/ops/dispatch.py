@@ -184,6 +184,110 @@ def _resolve_kernel(kernel: str) -> str:
     return kernel
 
 
+# --------------------------------------------------- device dispatch gate
+#
+# The device is one shared resource fed from many producer threads (the
+# transport dispatcher's workers, the streaming encoder, repair drains,
+# the object service). Unbounded, a burst of concurrent dispatches
+# queues arbitrarily deep work onto the device while every producer
+# keeps allocating host+device buffers for payloads that cannot run yet
+# — the OOM shape the fleet lab exposes at scale. The gate is the
+# bounded DEVICE QUEUE: at most ``capacity`` dispatches are in flight;
+# further callers BLOCK (yield their thread) until a slot frees, which
+# propagates backpressure up through the plugin encode/decode paths to
+# whatever transport or service admitted the work. Waits are visible as
+# the noise_ec_backpressure_* family (layer="device"); a wait past
+# ``wait_timeout`` proceeds anyway — the gate is a governor, not a
+# deadlock (same escape contract as TCPNetwork.wait_writable).
+
+
+class DeviceGate:
+    """Bounded admission to the device dispatch path (module comment).
+
+    ``with gate:`` around a dispatch; reentrant nesting is NOT supported
+    (DeviceCodec acquires only at its public entry points, which never
+    nest). Tests shrink ``capacity`` to pin the blocking behavior.
+    """
+
+    def __init__(self, capacity: int = 8, wait_timeout: float = 120.0):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.wait_timeout = wait_timeout
+        self._cv = threading.Condition()
+        self.in_flight = 0
+        self.waiters = 0
+        self.waits = 0  # local mirror of the counter (tests, reports)
+        from noise_ec_tpu.obs.registry import default_registry
+
+        reg = default_registry()
+        self._waits_total = reg.counter(
+            "noise_ec_backpressure_waits_total"
+        ).labels(layer="device")
+        self._wait_hist = reg.histogram(
+            "noise_ec_backpressure_wait_seconds"
+        ).labels(layer="device")
+        reg.gauge("noise_ec_backpressure_queue_depth").set_callback(
+            lambda: self.in_flight + self.waiters, layer="device"
+        )
+
+    def acquire(self) -> None:
+        with self._cv:
+            if self.in_flight < self.capacity:
+                self.in_flight += 1
+                return
+            self.waits += 1
+            self._waits_total.add(1)
+            t0 = time.monotonic()
+            deadline = t0 + self.wait_timeout
+            self.waiters += 1
+            try:
+                while self.in_flight >= self.capacity:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break  # governor, not a deadlock: proceed
+                    self._cv.wait(min(remaining, 0.5))
+            finally:
+                self.waiters -= 1
+            self._wait_hist.observe(time.monotonic() - t0)
+            self.in_flight += 1
+
+    def release(self) -> None:
+        with self._cv:
+            self.in_flight -= 1
+            self._cv.notify()
+
+    def __enter__(self) -> "DeviceGate":
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.release()
+        return False
+
+
+_device_gate: Optional[DeviceGate] = None
+_device_gate_lock = threading.Lock()
+
+
+def device_gate() -> DeviceGate:
+    """The process-wide device dispatch gate (lazy singleton)."""
+    global _device_gate
+    with _device_gate_lock:
+        if _device_gate is None:
+            _device_gate = DeviceGate()
+        return _device_gate
+
+
+def configure_device_gate(**kwargs) -> DeviceGate:
+    """Replace the process gate (tests shrink capacity; a fresh instance
+    also resets occupancy). Returns the new gate."""
+    global _device_gate
+    with _device_gate_lock:
+        _device_gate = DeviceGate(**kwargs)
+        return _device_gate
+
+
 @functools.lru_cache(maxsize=256)
 def _fused_xla_fn(degree: int, r: int, k: int, S: int):
     """Compiled (masks, shards) -> product stripes, shape-generic kernel."""
@@ -535,7 +639,9 @@ class DeviceCodec:
         entry = f"matmul_stripes_{self.kernel}"
         record_kernel(entry, D.nbytes)
         key = dispatch_key(entry, self.kernel, M, D.shape)
-        with device_op(entry, key, nbytes=D.nbytes) as dt:
+        # Bounded device queue: admission BEFORE the telemetry window so
+        # a gated wait reads as backpressure, not kernel latency.
+        with device_gate(), device_op(entry, key, nbytes=D.nbytes) as dt:
             return self._matmul_stripes_dispatch(M, D, dt)
 
     def _matmul_stripes_dispatch(self, M: np.ndarray, D: np.ndarray,
@@ -752,7 +858,8 @@ class DeviceCodec:
         # materializing, so the execute-route timing is the submit cost;
         # the compile route still times the synchronous trace+compile.
         key = dispatch_key("matmul_words", self.kernel, M, tuple(words.shape))
-        with device_op("matmul_words", key, nbytes=nbytes) as dt:
+        # Same bounded-queue admission as matmul_stripes (device gate).
+        with device_gate(), device_op("matmul_words", key, nbytes=nbytes) as dt:
             return self._matmul_words_batch_dispatch(M, words, dt)
 
     def _matmul_words_batch_dispatch(self, M: np.ndarray, words: jnp.ndarray,

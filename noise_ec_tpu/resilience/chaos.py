@@ -81,6 +81,12 @@ class ChaosProfile:
     partitions: tuple = ()
     resets: tuple = ()
     kills: tuple = ()
+    # Recurring kill/restart cycles: (start, interval, down, jitter)
+    # tuples. Unlike ``kills`` (explicit one-shot windows), a churn
+    # primitive DESCRIBES a schedule; the concrete seeded windows come
+    # from :meth:`churn_windows` so the fleet lab and the proxy share
+    # one expansion (and one reproducibility contract).
+    churns: tuple = ()
 
     @classmethod
     def parse(cls, text: str) -> "ChaosProfile":
@@ -91,11 +97,15 @@ class ChaosProfile:
         ``bandwidth=1048576`` (bytes/s)
         ``partition@START:DURATION[:DIRECTION]`` (direction defaults both)
         ``reset@TIME``  ``kill@START:DURATION``
+        ``churn@START:INTERVAL:DOWN[:JITTER]`` (recurring kill/restart:
+        from START, roughly every INTERVAL seconds the peer dies for
+        DOWN seconds, each cycle's onset jittered by up to ±JITTER —
+        the concrete windows are seeded, see :meth:`churn_windows`)
 
         Example: ``drop=0.05,corrupt=0.01,partition@2:2:a2b,reset@5``.
         """
         kwargs: dict = {}
-        partitions, resets, kills = [], [], []
+        partitions, resets, kills, churns = [], [], [], []
         for raw in text.split(","):
             tok = raw.strip()
             if not tok:
@@ -118,6 +128,18 @@ class ChaosProfile:
                 if len(parts) != 2:
                     raise ValueError(f"bad kill token {tok!r}")
                 kills.append((float(parts[0]), float(parts[1])))
+            elif tok.startswith("churn@"):
+                parts = tok[len("churn@"):].split(":")
+                if len(parts) not in (3, 4):
+                    raise ValueError(f"bad churn token {tok!r}")
+                start, interval, down = (float(p) for p in parts[:3])
+                jit = float(parts[3]) if len(parts) == 4 else 0.0
+                if interval <= 0 or down <= 0 or jit < 0:
+                    raise ValueError(
+                        f"churn needs interval > 0, down > 0, jitter >= 0 "
+                        f"({tok!r})"
+                    )
+                churns.append((start, interval, down, jit))
             elif "=" in tok:
                 key, _, val = tok.partition("=")
                 key = key.strip()
@@ -131,12 +153,15 @@ class ChaosProfile:
                 raise ValueError(f"unparseable chaos token {tok!r}")
         return cls(
             partitions=tuple(partitions), resets=tuple(resets),
-            kills=tuple(kills), **kwargs,
+            kills=tuple(kills), churns=tuple(churns), **kwargs,
         )
 
     def partitioned(self, direction: str, now: float) -> bool:
         """Is ``direction`` severed at relative time ``now``? ``kills``
-        sever both directions for their window."""
+        sever both directions for their window. (``churns`` are NOT
+        consulted here — they expand to seeded windows via
+        :meth:`churn_windows`, which the proxy and the fleet lab fold
+        in at their own level.)"""
         for start, duration, pdir in self.partitions:
             if pdir in (direction, "both") and start <= now < start + duration:
                 return True
@@ -144,6 +169,32 @@ class ChaosProfile:
 
     def killed(self, now: float) -> bool:
         return any(s <= now < s + d for s, d in self.kills)
+
+    def churn_windows(
+        self, seed: int, horizon: float, stream: int = 0
+    ) -> tuple[tuple[float, float], ...]:
+        """Expand the ``churns`` schedule into concrete, sorted
+        ``(start, duration)`` kill windows up to ``horizon`` seconds.
+
+        Deterministic in (seed, stream, profile): the fleet lab passes
+        one stream per peer so a thousand peers churn on STAGGERED,
+        individually-jittered schedules from one seed — and the same
+        seed reproduces every window exactly (the reproducibility test
+        covers this alongside the frame-level faults)."""
+        out: list[tuple[float, float]] = []
+        for ci, (start, interval, down, jit) in enumerate(self.churns):
+            rng = np.random.default_rng(
+                np.random.SeedSequence([seed & 0xFFFFFFFF, stream, ci, 0xC4])
+            )
+            t = start
+            while t < horizon:
+                onset = t
+                if jit > 0:
+                    onset = max(0.0, t + float(rng.uniform(-jit, jit)))
+                if onset < horizon:
+                    out.append((onset, down))
+                t += max(interval, 1e-3)
+        return tuple(sorted(out))
 
 
 class ChaosLink:
@@ -243,6 +294,12 @@ class ChaosProxy:
         self.target_port = target_port
         self.profile = profile
         self.seed = seed
+        # Churn primitives expand once, at construction, into concrete
+        # seeded kill windows (same semantics as kill@: refuse new
+        # connections + abort live ones for the window's duration).
+        self._churn_kills: tuple = profile.churn_windows(
+            seed, horizon=self.CHURN_HORIZON
+        )
         self.host = listen_host
         self.port = listen_port
         self._loop = asyncio.new_event_loop()
@@ -302,9 +359,19 @@ class ChaosProxy:
         self._loop.call_soon_threadsafe(self._loop.stop)
         self._thread.join(timeout=5)
 
+    # Churn schedules are unbounded; expand this far ahead (a proxy
+    # living longer than this simply stops churning — soaks are minutes).
+    CHURN_HORIZON = 3600.0
+
     def now(self) -> float:
         """Relative (schedule) time."""
         return self._loop.time() - self._epoch
+
+    def _killed(self, now: float) -> bool:
+        """One-shot kill windows plus expanded churn windows."""
+        return self.profile.killed(now) or any(
+            s <= now < s + d for s, d in self._churn_kills
+        )
 
     # ------------------------------------------------------------ schedule
 
@@ -321,7 +388,9 @@ class ChaosProxy:
                     self.reset_count += 1
                     self._abort_all()
                     log.info("chaos: reset all connections at t=%.3fs", now)
-            for start, _duration in self.profile.kills:
+            for start, _duration in (
+                tuple(self.profile.kills) + self._churn_kills
+            ):
                 if start <= now and start not in killed_fired:
                     killed_fired.add(start)
                     self._abort_all()
@@ -341,7 +410,7 @@ class ChaosProxy:
     async def _handle_conn(
         self, c_reader: asyncio.StreamReader, c_writer: asyncio.StreamWriter
     ) -> None:
-        if self.profile.killed(self.now()) or self._closed:
+        if self._killed(self.now()) or self._closed:
             # The "peer" is dead for this window: refuse service.
             self.refused_conns += 1
             c_writer.close()

@@ -1,0 +1,393 @@
+"""Fleet-lab tests: profile grammar, device-gate backpressure in
+isolation, dispatcher fairness, shed-vs-lost accounting, the tier-1
+small-fleet acceptance run, and the slow 1k-peer soak (docs/fleet.md).
+"""
+
+import json
+import threading
+import time
+from urllib.request import urlopen
+
+import numpy as np
+import pytest
+
+from noise_ec_tpu.fleet import NAMED_CHAOS, FleetLab, FleetProfile
+from noise_ec_tpu.host.transport import _SerialDispatcher
+from noise_ec_tpu.obs.registry import default_registry
+
+
+def counter_total(name: str) -> float:
+    """Sum over every child of a counter family (0 when unused)."""
+    return sum(
+        child.value
+        for _, child in default_registry().counter(name).children()
+    )
+
+
+# ------------------------------------------------------------- grammar
+
+
+def test_fleet_profile_parse_grammar():
+    p = FleetProfile.parse(
+        "peers=120, fanout=5,msgs=300,chat=0.7,object=0.2,repair=0.1,"
+        "chat_bytes=128,object_bytes=4096,chaos=lossy,"
+        "churn@2:4:0.5:0.25,partition@1:2,churn_peers=10"
+    )
+    assert p.peers == 120 and p.fanout == 5 and p.msgs == 300
+    assert (p.chat, p.object, p.repair) == (0.7, 0.2, 0.1)
+    assert p.chaos_name == "lossy"
+    # The named profile's fault knobs landed on the composed chaos…
+    assert p.chaos.drop == 0.01 and p.chaos.corrupt == 0.005
+    # …and the chaos-grammar tokens passed through verbatim (churn
+    # reuses the existing grammar, not a parallel scheduler).
+    assert p.chaos.churns == ((2.0, 4.0, 0.5, 0.25),)
+    assert p.chaos.partitions == ((1.0, 2.0, "both"),)
+    assert p.churn_peers == 10
+    w = p.weights()
+    assert abs(sum(w.values()) - 1.0) < 1e-9
+    assert abs(w["chat"] - 0.7) < 1e-9
+    assert p.needs_stores()
+    assert not FleetProfile.parse("peers=8,chat=1").needs_stores()
+    for bad in (
+        "peers=1",              # fleet needs >= 2
+        "fanout=0",             # no neighbors
+        "peers=4,fanout=9",     # fanout past peers-1
+        "chat=0,object=0,repair=0",
+        "chaos=imaginary",      # unknown named profile
+        "frobnicate=1",
+        "msgs",                 # not key=value
+        "k=6,n=4",              # inverted geometry
+    ):
+        with pytest.raises(ValueError):
+            FleetProfile.parse(bad)
+    assert set(NAMED_CHAOS) >= {"clean", "lossy", "flaky", "storm"}
+
+
+# -------------------------------------------- backpressure in isolation
+
+
+def test_device_gate_blocks_senders_without_pool_evictions():
+    """The bounded device queue in isolation (ISSUE satellite): with
+    the gate full, a sender's dispatch BLOCKS (yields) instead of
+    queueing unbounded work — noise_ec_backpressure_waits_total{
+    layer=device} increments, the wait is visible in the histogram,
+    and no shard-pool evictions happen anywhere (the sender slowed;
+    nothing OOMed)."""
+    from noise_ec_tpu.ops.dispatch import DeviceCodec, configure_device_gate
+
+    gate = configure_device_gate(capacity=1, wait_timeout=30.0)
+    try:
+        dev = DeviceCodec(field="gf256", kernel="xla")
+        M = np.array([[1, 1], [1, 2]], dtype=np.uint8)
+        D = np.arange(2 * 64, dtype=np.uint8).reshape(2, 64)
+        want = dev.matmul_stripes(M, D)  # warm the jit outside the test
+
+        waits0 = counter_total("noise_ec_backpressure_waits_total")
+        evict0 = counter_total("noise_ec_mempool_evictions_total")
+        hist = default_registry().histogram(
+            "noise_ec_backpressure_wait_seconds"
+        ).labels(layer="device")
+        hist_count0 = hist.count
+
+        gate.acquire()  # the device queue is now full
+        done = threading.Event()
+        out: list = []
+
+        def sender():
+            out.append(dev.matmul_stripes(M, D))
+            done.set()
+
+        t = threading.Thread(target=sender, daemon=True)
+        t.start()
+        # The sender must be BLOCKED at the gate, not failing/dropping.
+        assert not done.wait(0.4)
+        assert gate.waiters == 1
+        gate.release()
+        assert done.wait(10), "sender never unblocked after release"
+        t.join(timeout=5)
+        assert np.array_equal(out[0], want)
+        assert counter_total("noise_ec_backpressure_waits_total") == waits0 + 1
+        assert hist.count == hist_count0 + 1
+        # Zero pool evictions: backpressure, not memory pressure.
+        assert counter_total("noise_ec_mempool_evictions_total") == evict0
+        # The depth gauge callback reads the gate state live.
+        depth = default_registry().gauge(
+            "noise_ec_backpressure_queue_depth"
+        ).labels(layer="device").read()
+        assert depth == 0
+    finally:
+        configure_device_gate()  # restore the default-capacity gate
+
+
+def test_dispatcher_submit_wait_blocks_then_succeeds():
+    """The dispatch tier of the backpressure chain: a full per-sender
+    window makes submit_wait BLOCK the producer until the drain frees
+    space (drop-free), and only a timeout turns into an overflow."""
+    release = threading.Event()
+    ran: list[str] = []
+
+    d = _SerialDispatcher(max_workers=1, max_queue=2)
+    try:
+        d.submit(b"blk", lambda: release.wait(10))  # occupy the worker
+        assert d.submit(b"k", ran.append, "a")
+        assert d.submit(b"k", ran.append, "b")  # window now full
+        waits0 = counter_total("noise_ec_backpressure_waits_total")
+
+        blocked_result: list = []
+
+        def producer():
+            blocked_result.append(
+                d.submit_wait(b"k", ran.append, "c", timeout=30.0)
+            )
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        assert t.is_alive(), "producer should be blocked, not dropped"
+        assert counter_total(
+            "noise_ec_backpressure_waits_total"
+        ) == waits0 + 1
+        overflows0 = d.overflows
+        release.set()  # drain proceeds, frees the window
+        t.join(timeout=10)
+        assert blocked_result == [True]
+        deadline = time.monotonic() + 5
+        while ran != ["a", "b", "c"] and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert ran == ["a", "b", "c"]
+        assert d.overflows == overflows0  # blocked, never dropped
+        # Exhausting the timeout IS an overflow (the bounded escape).
+        blocker2 = threading.Event()
+        d.submit(b"blk2", blocker2.wait, 10)
+        d.submit(b"j", ran.append, "x")
+        d.submit(b"j", ran.append, "y")
+        assert not d.submit_wait(b"j", ran.append, "z", timeout=0.1)
+        assert d.overflows == overflows0 + 1
+        blocker2.set()
+    finally:
+        d.shutdown(wait=False)
+
+
+def test_dispatcher_fair_quantum_interleaves_quiet_senders():
+    """Deficit round-robin (per-peer fairness): with many senders
+    active, the drain quantum shrinks so a spammy sender's deep queue
+    cannot hold the worker for a full 16-item batch while quiet
+    senders' single deliveries wait. Pinned by execution order: every
+    quiet item must run before the talker's first 15 items complete
+    (the old fixed batch ran 16 talker items first)."""
+    order: list = []
+    lock = threading.Lock()
+    release = threading.Event()
+
+    def record(tag):
+        with lock:
+            order.append(tag)
+
+    d = _SerialDispatcher(max_workers=1, max_queue=4096)
+    try:
+        d.submit(b"blk", lambda: release.wait(10))  # hold the worker
+        for i in range(64):
+            d.submit(b"spam", record, ("spam", i))
+        for q in range(8):
+            d.submit(b"q%d" % q, record, ("quiet", q))
+        release.set()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with lock:
+                if len(order) >= 72:
+                    break
+            time.sleep(0.01)
+        with lock:
+            snapshot = list(order)
+        assert len(snapshot) == 72, len(snapshot)
+        positions = {
+            tag[1]: i for i, tag in enumerate(snapshot)
+            if tag[0] == "quiet"
+        }
+        assert len(positions) == 8
+        # All 8 quiet deliveries interleave within the first rotation:
+        # with ~9 active senders the talker's quantum is 1-2 items, so
+        # every quiet item lands well before 15 total executions. The
+        # old fixed DRAIN_BATCH=16 put them at positions 16-23.
+        assert max(positions.values()) < 15, snapshot[:24]
+    finally:
+        d.shutdown(wait=False)
+
+
+# -------------------------------------------------- scoring + admission
+
+
+def test_fleet_shed_accounting_is_distinct_from_lost():
+    """Fleet-wide admission: a sender whose local SLO verdict degrades
+    sheds new submissions with a Retry-After hint; the scorer counts
+    shed separately from lost and the delivery rate never pays for it."""
+    prof = FleetProfile.parse("peers=4,fanout=2,msgs=4,chat=1,chaos=clean")
+    lab = FleetLab(prof, seed=3)
+    lab.start()
+    try:
+        rng = np.random.default_rng(0)
+        sender = lab.peers[0]
+        # Degrade the sender's local SLO: a burst of failed outcomes.
+        for _ in range(20):
+            sender.slo.record("verify_failed", 0.0)
+        assert lab.submit_chat(sender, rng) is None  # shed, not sent
+        shed_total = counter_total("noise_ec_fleet_shed_total")
+        assert shed_total >= 1
+        # A healthy sender still broadcasts.
+        healthy = lab.peers[1]
+        msg_id = lab.submit_chat(healthy, rng)
+        assert msg_id is not None
+        lab._wait_drained(10.0)
+        report = lab.scorer.report({}, duration=1.0)
+        assert report["shed"]["total"] == 1
+        assert report["shed"]["by_reason"] == {"slo": 1}
+        assert report["shed"]["retry_after_s"] == lab.shed_retry_after
+        # The shed submission is NOT in the expected set: rate is the
+        # healthy sender's deliveries alone, and nothing scored lost.
+        assert report["delivery"]["expected"] == len(healthy.neighbors)
+        assert report["delivery"]["lost"] == 0
+        assert report["delivery"]["rate"] == 1.0
+    finally:
+        lab.close()
+
+
+def test_fleet_fairness_10x_talker_keeps_quiet_p99_in_slo():
+    """The fairness acceptance bar: one peer talking 10x as fast as
+    everyone else must not push the QUIET peers' delivery p99 past the
+    lab SLO (deficit round-robin in the dispatcher + per-link windows
+    own this)."""
+    prof = FleetProfile.parse(
+        "peers=10,fanout=3,msgs=1,chat=1,chat_bytes=64,chaos=clean"
+    )
+    lab = FleetLab(prof, seed=5, p99_target_seconds=2.0)
+    lab.start()
+    try:
+        talker = lab.peers[0]
+        quiet = lab.peers[1:]
+        rng_t = np.random.default_rng(1)
+        rng_q = np.random.default_rng(2)
+        n_quiet_each = 12
+
+        def talk():
+            for _ in range(10 * n_quiet_each):  # 10x every quiet peer
+                lab.submit_chat(talker, rng_t)
+
+        t = threading.Thread(target=talk, daemon=True)
+        t.start()
+        for _ in range(n_quiet_each):
+            for peer in quiet:
+                lab.submit_chat(peer, rng_q)
+            time.sleep(0.02)
+        t.join(timeout=60)
+        lab._wait_drained(30.0)
+        report = lab.scorer.report({}, duration=1.0)
+        assert report["delivery"]["lost"] == 0
+        per_sender = report["per_sender_p99_ms"]
+        # The talker really was ~10x louder…
+        by_kind = report["by_kind"]["chat"]
+        assert by_kind["sent"] == 10 * n_quiet_each + 9 * n_quiet_each
+        # …and no quiet sender's p99 left the SLO.
+        for peer in quiet:
+            p99_ms = per_sender.get(peer.idx)
+            assert p99_ms is not None
+            assert p99_ms <= lab.p99_target_seconds * 1e3, (
+                peer.idx, p99_ms, per_sender,
+            )
+    finally:
+        lab.close()
+
+
+# ------------------------------------------------- tier-1 acceptance
+
+
+def test_small_fleet_acceptance_mixed_traffic_under_named_chaos():
+    """The tier-1 acceptance bar (ISSUE 7): >= 50 in-process peers,
+    mixed chat + object traffic, a NAMED chaos profile, delivery >=
+    99.9% with shed-with-Retry-After counted separately from lost —
+    plus the live /fleet route and the /healthz fleet block."""
+    from noise_ec_tpu.obs.server import StatsServer
+
+    prof = FleetProfile.parse(
+        "peers=50,fanout=6,msgs=150,chat=0.9,object=0.1,"
+        "object_bytes=6144,chaos=lossy"
+    )
+    lab = FleetLab(prof, seed=11)
+    lab.start()
+    server = StatsServer()
+    lab.attach(server)
+    try:
+        report = lab.run()
+        delivery = report["delivery"]
+        assert delivery["expected"] >= 800, report
+        assert delivery["rate"] >= 0.999, report
+        # Shed is its own bucket, never folded into lost.
+        assert report["shed"]["total"] == len(
+            lab.scorer.shed_events
+        )
+        assert delivery["expected"] + report["shed"]["total"] * 0 >= 800
+        # Mixed traffic really ran: both kinds scored deliveries.
+        assert report["by_kind"]["chat"]["delivered"] > 0
+        assert report["by_kind"]["object"]["delivered"] > 0
+        assert report["chaos_profile"] == "lossy"
+        # The named profile actually injected faults.
+        assert report["chaos"]["dropped"] + report["chaos"]["corrupted"] > 0
+
+        # GET /fleet serves live harness status via the PR-6 route table.
+        with urlopen(f"{server.url}/fleet", timeout=5) as resp:
+            assert resp.status == 200
+            doc = json.loads(resp.read())
+        assert doc["profile"]["peers"] == 50
+        assert doc["live"]["sent"] == report["sent"]
+        assert doc["report"]["delivery"]["rate"] == delivery["rate"]
+        # /healthz details gain the fleet block while the lab is live.
+        with urlopen(f"{server.url}/healthz?verbose=1", timeout=5) as resp:
+            health = json.loads(resp.read())
+        fleet_block = health["details"]["fleet"]
+        assert fleet_block["peers"] == 50
+        assert fleet_block["up"] == 50
+        assert fleet_block["delivered"] > 0
+    finally:
+        server.close()
+        lab.close()
+
+
+@pytest.mark.slow
+def test_fleet_1k_peer_soak_with_churn():
+    """The 1000-peer soak (ISSUE 7, slow tier): a named chaos profile
+    WITH churn across a 1k-peer fleet, delivery >= 99.9% (churned
+    receivers are the schedule's doing and score separately), a merged
+    Perfetto trace, and a scored report."""
+    import os
+    import tempfile
+
+    prof = FleetProfile.parse(
+        "peers=1000,fanout=4,msgs=400,chat=0.95,object=0.05,"
+        "object_bytes=4096,chaos=lossy,churn@1:2:0.3:0.5"
+    )
+    lab = FleetLab(prof, seed=23)
+    lab.start()
+    try:
+        assert len(lab.peers) == 1000
+        assert len(lab.hub.links) == 4000
+        report = lab.run(drain_timeout=120.0)
+        delivery = report["delivery"]
+        assert delivery["expected"] >= 1000, report
+        assert delivery["rate"] >= 0.999, report
+        # Churn genuinely ran: the schedule fired kills and restarts.
+        assert report["churn"]["kills_applied"] > 0
+        assert counter_total("noise_ec_fleet_churn_events_total") > 0
+        # Objects flowed through the service layer at scale too.
+        assert report["by_kind"]["object"]["delivered"] > 0
+        with tempfile.TemporaryDirectory() as tmp:
+            report_path = os.path.join(tmp, "fleet.json")
+            trace_path = report_path + ".trace.json"
+            lab.last_report = report
+            lab.write_report(report_path)
+            doc = lab.write_trace(trace_path)
+            assert doc["traceEvents"], "merged Perfetto trace is empty"
+            with open(report_path, encoding="utf-8") as f:
+                saved = json.load(f)
+            assert saved["delivery"]["rate"] == delivery["rate"]
+            assert os.path.getsize(trace_path) > 0
+    finally:
+        lab.close()

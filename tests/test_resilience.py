@@ -53,9 +53,36 @@ def test_chaos_profile_parse_grammar():
     assert p.partitioned("a2b", 9.5) and p.partitioned("b2a", 9.5)
     # Kills sever both directions too.
     assert p.partitioned("a2b", 7.5) and p.killed(7.5)
-    for bad in ("drop", "partition@1", "kill@3", "frobnicate=1", "x@1"):
+    for bad in ("drop", "partition@1", "kill@3", "frobnicate=1", "x@1",
+                "churn@1", "churn@1:0:1", "churn@1:2:0", "churn@1:2:1:-1"):
         with pytest.raises(ValueError):
             ChaosProfile.parse(bad)
+    # churn@ parses into the recurring-cycle primitive (jitter optional).
+    c = ChaosProfile.parse("churn@2:4:0.5,churn@0:1:0.2:0.3")
+    assert c.churns == ((2.0, 4.0, 0.5, 0.0), (0.0, 1.0, 0.2, 0.3))
+
+
+def test_chaos_churn_windows_seeded_reproducibility():
+    """The churn primitive's expansion is part of the seeded-
+    reproducibility contract: same (seed, stream, profile) ⇒ identical
+    kill/restart windows; a different seed or stream diverges. The
+    fleet lab leans on the stream axis for per-peer staggering."""
+    p = ChaosProfile.parse("churn@1:3:0.5:0.8")
+    w1 = p.churn_windows(7, horizon=60.0, stream=3)
+    w2 = p.churn_windows(7, horizon=60.0, stream=3)
+    assert w1 == w2 and len(w1) == 20  # one cycle per interval
+    # Windows are sorted, jittered around the nominal schedule, and
+    # each carries the configured downtime.
+    assert list(w1) == sorted(w1)
+    for i, (start, down) in enumerate(w1):
+        assert down == 0.5
+        assert abs(start - (1.0 + 3.0 * i)) <= 0.8 + 1e-9
+    assert p.churn_windows(8, horizon=60.0, stream=3) != w1
+    assert p.churn_windows(7, horizon=60.0, stream=4) != w1
+    # Zero jitter is exact; no-churn profiles expand to nothing.
+    exact = ChaosProfile.parse("churn@0:10:1").churn_windows(1, 25.0)
+    assert exact == ((0.0, 1.0), (10.0, 1.0), (20.0, 1.0))
+    assert ChaosProfile().churn_windows(1, 100.0) == ()
 
 
 def test_chaos_link_seeded_reproducibility():

@@ -61,7 +61,7 @@ DEFAULT_TOLERANCE = 0.10
 HOST_TOLERANCE = 0.35
 HOST_PREFIXES = (
     "host_node_", "decode_corrupt_", "cpu_shim_", "partition_recovery_",
-    "store_repair_", "object_",
+    "store_repair_", "object_", "fleet_",
 )
 
 

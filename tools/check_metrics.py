@@ -161,6 +161,7 @@ def check() -> list[str]:
     problems.extend(check_resilience_docs())
     problems.extend(check_device_docs())
     problems.extend(check_object_docs())
+    problems.extend(check_fleet_docs())
     return problems
 
 
@@ -258,6 +259,49 @@ def check_object_docs() -> list[str]:
         f"object-service surface {tok} is not documented in "
         "docs/object-service.md"
         for tok in OBJECT_DOC_TOKENS
+        if tok not in text
+    )
+    return problems
+
+
+# The fleet lab's metric families plus the backpressure family it
+# exposed as missing (docs/fleet.md owns the grammar, scoring semantics
+# and the device-to-transport backpressure chain those series
+# instrument — the same two-home rule as the resilience families), and
+# the operator surfaces that exist only as strings in the code.
+FLEET_PREFIXES = (
+    "noise_ec_fleet_",
+    "noise_ec_backpressure_",
+)
+FLEET_DOC_TOKENS = (
+    "-fleet-profile",
+    "-fleet-size",
+    "-fleet-report",
+    "/fleet",
+    "churn@",
+    "Retry-After",
+)
+
+
+def check_fleet_docs() -> list[str]:
+    """Fleet/backpressure families + surfaces vs docs/fleet.md."""
+    from noise_ec_tpu.obs.registry import METRICS
+
+    doc_path = REPO / "docs" / "fleet.md"
+    names = [n for n in METRICS if n.startswith(FLEET_PREFIXES)]
+    if not names:
+        return []
+    if not doc_path.exists():
+        return [f"docs file {doc_path} missing (fleet metrics exist)"]
+    text = doc_path.read_text(encoding="utf-8")
+    problems = [
+        f"fleet metric {n!r} is not documented in docs/fleet.md"
+        for n in names
+        if not re.search(rf"\b{re.escape(n)}\b", text)
+    ]
+    problems.extend(
+        f"fleet surface {tok} is not documented in docs/fleet.md"
+        for tok in FLEET_DOC_TOKENS
         if tok not in text
     )
     return problems
