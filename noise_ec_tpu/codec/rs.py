@@ -22,6 +22,7 @@ Backends:
 
 from __future__ import annotations
 
+import hashlib
 import logging
 from typing import Optional, Sequence, Union
 
@@ -111,9 +112,62 @@ class ReedSolomon:
     # -- internals ---------------------------------------------------------
 
     def _mul(self, M: np.ndarray, D: np.ndarray) -> np.ndarray:
+        """One matrix x stripes product, routed THROUGH the live-path
+        coalescer (ops/coalesce.py): concurrent same-(matrix, shape)
+        requests — the plugin's encode/decode, the object service, the
+        store's degraded reads, the fleet lab — batch into a single
+        device dispatch and fan back out. An uncontended call flushes
+        immediately (the coalescer never taxes the solo path)."""
+        from noise_ec_tpu.ops.coalesce import coalesce_cutoff_bytes, coalescer
+
+        D = np.asarray(D)
+        if D.nbytes > coalesce_cutoff_bytes():
+            # Compute-bound regime (ops/coalesce.py cutoff): batching a
+            # payload this large amortizes nothing — dispatch directly,
+            # same breaker/fallback body.
+            return self._mul_batch(M, [D])[0]
+        return coalescer().submit(
+            self._mul_key(M, D.shape, D.dtype), self._batch_fn(M), D
+        )
+
+    def matmul_many(self, M: np.ndarray, Ds: Sequence[np.ndarray]) -> list:
+        """Explicit batched ``_mul``: B same-shape products through one
+        coalesced dispatch (the repair engine's group reconstruct rides
+        this, sharing the coalescer's queue — and the DeviceGate behind
+        it — with live traffic). Same fallback guarantees as ``_mul``."""
+        from noise_ec_tpu.ops.coalesce import coalescer
+
+        Ds = [np.asarray(D) for D in Ds]
+        if not Ds:
+            return []
+        return coalescer().submit_many(
+            self._mul_key(M, Ds[0].shape, Ds[0].dtype),
+            self._batch_fn(M), Ds,
+        )
+
+    def _mul_key(self, M: np.ndarray, shape: tuple, dtype) -> tuple:
+        """Coalescer bucket key: everything that must match for two
+        requests to legally share one batched dispatch."""
+        M = np.ascontiguousarray(np.asarray(M, dtype=self.gf.dtype))
+        digest = hashlib.blake2b(M.tobytes(), digest_size=12).digest()
+        kernel = self._dev.kernel if self._dev is not None else "host"
+        return (
+            "mul", self.field, self.backend, kernel, M.shape, digest,
+            tuple(shape), np.dtype(dtype).str,
+        )
+
+    def _batch_fn(self, M: np.ndarray):
+        def run(Ds: list) -> list:
+            return self._mul_batch(M, Ds)
+
+        return run
+
+    def _mul_batch(self, M: np.ndarray, Ds: list) -> list:
+        """The coalesced batch body (runs on the bucket leader's thread;
+        every instance sharing the bucket key produces identical bytes)."""
         if self._dev is not None:
             if self._breaker.allow():
-                out = self._mul_device(M, D)
+                out = self._mul_device_many(M, Ds)
                 if out is not None:
                     return out
             else:
@@ -122,13 +176,14 @@ class ReedSolomon:
                 record_codec_fallback("open")
         # Graceful degradation: the golden host arithmetic — bit-exact
         # with the device kernels (that equivalence is the golden codec's
-        # whole job), so a breaker trip costs throughput, never bytes.
-        return host_matvec(self.gf, M, D)
+        # whole job), so a breaker trip — even mid-batch — costs
+        # throughput, never bytes, for every member of the batch.
+        return [host_matvec(self.gf, M, D) for D in Ds]
 
-    def _mul_device(self, M: np.ndarray, D: np.ndarray):
-        """One device matmul under the breaker: retry a failure once
-        in-call (transient), trip the breaker on the second, and report
-        the outcome so a half-open probe slot is always released.
+    def _mul_device_many(self, M: np.ndarray, Ds: list):
+        """One batched device dispatch under the breaker: retry a failure
+        once in-call (transient), trip the breaker on the second, and
+        report the outcome so a half-open probe slot is always released.
         Returns None when the caller must run the host fallback."""
         from noise_ec_tpu.ops.dispatch import (
             ensure_codec_prober,
@@ -138,7 +193,7 @@ class ReedSolomon:
         last_exc = None
         for attempt in range(2):
             try:
-                out = self._dev.matmul_stripes(M, D)
+                out = self._dev.matmul_stripes_many(M, Ds)
             except NotImplementedError:
                 # Designed host-tier routing, not a device fault: the
                 # breaker must not trip (and a half-open probe counts as
@@ -172,6 +227,17 @@ class ReedSolomon:
             if arr.size % 2:
                 raise ValueError(f"{name}: gf65536 shards need even byte length")
             arr = arr.view("<u2")
+        # No-copy fast path: every shard on the live receive path lands
+        # here, and an aligned, C-contiguous buffer of the right dtype IS
+        # already in symbol form — skip the generic np.array machinery
+        # (which re-checks and may copy) and return the view itself
+        # (tests/test_dispatch_path.py pins shares_memory).
+        if (
+            arr.dtype == self.gf.dtype
+            and arr.flags.c_contiguous
+            and arr.flags.aligned
+        ):
+            return arr
         return np.ascontiguousarray(arr, dtype=self.gf.dtype)
 
     def _gather(self, shards: Sequence[Optional[Buffer]], need_all: bool):

@@ -771,11 +771,13 @@ class ShardPlugin:
 
             enc = StreamingEncoder(k, n - k, chunk_bytes=B)
             for sc in enc.encode_stream(chunks):
-                # memoryview rows, not .tobytes(): the wire marshal joins
-                # from the buffer directly, one copy instead of two.
-                yield sc.index, [
-                    Share(i, sc.shards[i].data) for i in range(n)
-                ]
+                # Row buffers, not .tobytes(): the wire marshal joins from
+                # each buffer directly. rows() keeps the parity-only-fetch
+                # split — data rows are zero-copy views of the caller's
+                # payload, parity rows the (r, stride) D2H fetch — so no
+                # (n, stride) codeword buffer is ever assembled.
+                rows = sc.rows()
+                yield sc.index, [Share(i, rows[i].data) for i in range(n)]
             return
         import numpy as np
 

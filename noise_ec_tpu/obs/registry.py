@@ -441,6 +441,40 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "End-to-end GET/range latency through stripe reads and decode",
         (),
     ),
+    # --- host<->device data path (ops/coalesce.py, ops/dispatch.py
+    # buffer pool; docs/design.md "host<->device data path" owns the
+    # buffer lifecycle and flush policy those series instrument)
+    "noise_ec_coalesce_batches_total": (
+        "counter",
+        "Coalesced dispatches flushed by the live-path coalescer (each "
+        "covers >= 1 member requests)",
+        (),
+    ),
+    "noise_ec_coalesce_batch_size": (
+        "histogram",
+        "Batch size each coalesced request rode (one observation per "
+        "member request, so the p50 answers 'was a typical request "
+        "amortized')",
+        (),
+    ),
+    "noise_ec_coalesce_flush_reason_total": (
+        "counter",
+        "Why each coalesced batch flushed, labeled by reason (solo = "
+        "idle dispatcher, immediate; linger = latency budget expired; "
+        "full = max_batch reached; bulk = explicit pre-formed batch)",
+        ("reason",),
+    ),
+    "noise_ec_device_buffer_pool_hits_total": (
+        "counter",
+        "Staging-buffer acquisitions served from the device buffer pool "
+        "(no allocation, pad tail already zero)",
+        (),
+    ),
+    "noise_ec_device_buffer_pool_misses_total": (
+        "counter",
+        "Staging-buffer acquisitions that allocated a fresh zeroed page",
+        (),
+    ),
     # --- backpressure (ops/dispatch.py device gate, host/transport.py
     # dispatcher; docs/fleet.md owns the propagation story)
     "noise_ec_backpressure_waits_total": (
@@ -522,6 +556,10 @@ _HISTOGRAM_BUCKETS: dict[str, tuple[float, ...]] = {
     # Device dispatches live in the us range; the host-scale x2 buckets
     # collapse sub-0.1 ms ops into one bin (obs/metrics.py).
     "noise_ec_device_op_seconds": DEVICE_LATENCY_BUCKETS,
+    # Small-integer counts: batch sizes, not latencies.
+    "noise_ec_coalesce_batch_size": (
+        1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0, 48.0, 64.0,
+    ),
 }
 
 

@@ -22,6 +22,7 @@ import functools
 import logging
 import threading
 import time
+import weakref
 from typing import Optional
 
 import jax
@@ -288,6 +289,157 @@ def configure_device_gate(**kwargs) -> DeviceGate:
         return _device_gate
 
 
+# ------------------------------------------------- device buffer pool
+#
+# The host↔device data path used to allocate per dispatch: a fresh
+# zeroed pad buffer on the host (a full memset of k * TWp bytes even
+# when only the tail columns needed zeroing), a fresh device input
+# buffer, and a fresh HBM output buffer. In steady state every one of
+# those is the same shape call after call. The pool closes the loop:
+#
+# - HOST staging: ``acquire_padded`` hands back a recycled page of the
+#   right (rows, cols) shape whose pad tail is ALREADY zero (only the
+#   columns the previous lease dirtied are re-zeroed), so the per-call
+#   cost is the payload memcpy alone. Leases are released only after
+#   the dispatch's output has materialized — the buffer backs the H2D
+#   transfer, so handing it to the next caller earlier would race an
+#   in-flight copy.
+# - DEVICE buffers: JAX arrays are immutable, so a device input cannot
+#   be refilled in place — instead the stripe-matmul entry points are
+#   jitted with ``donate_argnums`` (``_fused_words_fn(..., donate=True)``)
+#   so XLA recycles the input's HBM for the output and steady-state
+#   encode/decode never grows the allocation high-water mark. Donation
+#   is only legal for arrays this module itself staged (callers of the
+#   words entries keep ownership of theirs); the pool's ``donate``
+#   bookkeeping enforces the invalidated-exactly-once rule.
+#
+# noise_ec_device_buffer_pool_{hits,misses}_total count the staging
+# reuse rate; a miss rate that climbs under steady traffic means the
+# shape working set outgrew max_per_key.
+
+
+class BufferLease:
+    """One checked-out staging buffer (see DeviceBufferPool)."""
+
+    __slots__ = ("arr", "key", "payload_cols")
+
+    def __init__(self, arr: np.ndarray, key: tuple, payload_cols: int):
+        self.arr = arr
+        self.key = key
+        self.payload_cols = payload_cols
+
+
+class DeviceBufferPool:
+    """Reusable host staging buffers + device donation bookkeeping
+    (module comment above)."""
+
+    def __init__(self, max_per_key: int = 8):
+        self.max_per_key = max_per_key
+        self._lock = threading.Lock()
+        self._free: dict[tuple, list[tuple[np.ndarray, int]]] = {}
+        # id(arr) -> weakref (or the array itself when weakrefs are not
+        # supported); presence means the buffer was already donated.
+        self._donated: dict[int, object] = {}
+        from noise_ec_tpu.obs.registry import default_registry
+
+        reg = default_registry()
+        self._hits = reg.counter(
+            "noise_ec_device_buffer_pool_hits_total"
+        ).labels()
+        self._misses = reg.counter(
+            "noise_ec_device_buffer_pool_misses_total"
+        ).labels()
+
+    def acquire_padded(self, rows: int, cols: int, payload_cols: int,
+                       dtype=np.uint8) -> BufferLease:
+        """A (rows, cols) staging buffer whose columns >= payload_cols
+        are zero. Fill ``[:, :payload_cols]`` and release after the
+        dispatch's output materializes."""
+        key = (rows, cols, np.dtype(dtype).str)
+        with self._lock:
+            stack = self._free.get(key)
+            entry = stack.pop() if stack else None
+        if entry is not None:
+            arr, prev_payload = entry
+            if payload_cols < prev_payload:
+                # Only the columns the previous lease dirtied: the rest
+                # of the tail is still zero from its own zeroing.
+                arr[:, payload_cols:prev_payload] = 0
+            self._hits.add(1)
+        else:
+            arr = np.zeros((rows, cols), dtype=dtype)
+            self._misses.add(1)
+        return BufferLease(arr, key, payload_cols)
+
+    def release(self, lease: BufferLease) -> None:
+        with self._lock:
+            stack = self._free.setdefault(lease.key, [])
+            if len(stack) < self.max_per_key:
+                stack.append((lease.arr, lease.payload_cols))
+
+    def donate(self, arr) -> None:
+        """Record that ``arr``'s device buffer is being donated to a
+        jitted call. A buffer may be invalidated exactly once; a second
+        donation is a use-after-free in waiting and raises."""
+        key = id(arr)
+        with self._lock:
+            prior = self._donated.get(key)
+            if prior is not None:
+                held = prior() if isinstance(prior, weakref.ref) else prior
+                if held is arr:
+                    raise RuntimeError(
+                        "device buffer donated twice (donation invalidates "
+                        "the input exactly once)"
+                    )
+            try:
+                self._donated[key] = weakref.ref(
+                    arr, lambda _, k=key: self._donated.pop(k, None)
+                )
+            except TypeError:  # non-weakref-able: keep a bounded record
+                self._donated[key] = arr
+            while len(self._donated) > 4096:
+                self._donated.pop(next(iter(self._donated)))
+
+    def was_donated(self, arr) -> bool:
+        with self._lock:
+            prior = self._donated.get(id(arr))
+        if prior is None:
+            return False
+        held = prior() if isinstance(prior, weakref.ref) else prior
+        return held is arr
+
+
+_buffer_pool: Optional[DeviceBufferPool] = None
+_buffer_pool_lock = threading.Lock()
+
+
+def buffer_pool() -> DeviceBufferPool:
+    """The process-wide staging buffer pool (lazy singleton)."""
+    global _buffer_pool
+    with _buffer_pool_lock:
+        if _buffer_pool is None:
+            _buffer_pool = DeviceBufferPool()
+        return _buffer_pool
+
+
+def configure_buffer_pool(**kwargs) -> DeviceBufferPool:
+    """Replace the process pool (tests shrink max_per_key; a fresh
+    instance also drops all cached buffers)."""
+    global _buffer_pool
+    with _buffer_pool_lock:
+        _buffer_pool = DeviceBufferPool(**kwargs)
+        return _buffer_pool
+
+
+def donation_supported() -> bool:
+    """True when the backend honors donate_argnums (TPU/GPU; the CPU
+    backend ignores donation and would warn per call)."""
+    try:
+        return jax.default_backend() in ("tpu", "gpu")
+    except Exception:  # noqa: BLE001 — no backend, no donation
+        return False
+
+
 @functools.lru_cache(maxsize=256)
 def _fused_xla_fn(degree: int, r: int, k: int, S: int):
     """Compiled (masks, shards) -> product stripes, shape-generic kernel."""
@@ -381,13 +533,26 @@ def _fused_words_pipeline(r: int, m: int, bits_rows: tuple, interpret: bool):
         planes_out = tiled_to_planes(out, W).reshape(r, m, W)
         return unpack(planes_out, interpret=interpret)
 
+    return f
+
+
+def _jit_words(f, donate: bool):
+    """jit a words pipeline, donating the input words' HBM into the
+    output when asked AND the backend supports it (docs/design.md
+    donation rules: only callers that staged the device array themselves
+    may ask — the words entries' public contract keeps caller
+    ownership)."""
+    if donate and donation_supported():
+        return jax.jit(f, donate_argnums=(0,))
     return jax.jit(f)
 
 
 @functools.lru_cache(maxsize=256)
-def _fused_words_fn(r: int, bits_rows: tuple, interpret: bool):
+def _fused_words_fn(r: int, bits_rows: tuple, interpret: bool,
+                    donate: bool = False):
     """GF(2^8) fused encode on uint32 WORDS: (k, TW) -> (r, TW)."""
-    return _fused_words_pipeline(r, 8, bits_rows, interpret)
+    return _jit_words(_fused_words_pipeline(r, 8, bits_rows, interpret),
+                      donate)
 
 
 # Pad-to multiples for the words entry points: the lane-pack grouping unit
@@ -405,13 +570,15 @@ def pad_words16(TW: int) -> int:
 
 
 @functools.lru_cache(maxsize=256)
-def _fused_words16_fn(r: int, bits_rows: tuple, interpret: bool):
+def _fused_words16_fn(r: int, bits_rows: tuple, interpret: bool,
+                      donate: bool = False):
     """GF(2^16) fused encode on uint32 WORDS: (k, TW) -> (r, TW).
 
     Each word holds two little-endian uint16 symbols; the 16x16 delta-swap
     network packs 16 planes per shard.
     """
-    return _fused_words_pipeline(r, 16, bits_rows, interpret)
+    return _jit_words(_fused_words_pipeline(r, 16, bits_rows, interpret),
+                      donate)
 
 
 # Baked XOR-network kernels scale with the generator's set-bit count:
@@ -688,22 +855,116 @@ class DeviceCodec:
             # record here would double-count the traffic.
             return self._mxu_for().encode_stripes(M, D)
         TWp = pad_words(-(-S // 4))
+        lease = None
         if 4 * TWp != S:
-            buf = np.zeros((k, 4 * TWp), dtype=self.gf.dtype)
+            # Pooled staging page with a pre-zeroed pad tail: the per-call
+            # cost is the payload memcpy, not an allocation + full memset.
+            lease = buffer_pool().acquire_padded(
+                k, 4 * TWp, S, dtype=self.gf.dtype
+            )
+            buf = lease.arr
             buf[:, :S] = D
         else:
             buf = np.ascontiguousarray(D)
         words = buf.view("<u4")
+        # This entry stages its own device array (device_put below), so
+        # the input HBM is donated into the output: steady-state encode /
+        # reconstruct reuses one allocation instead of growing two.
         fn = _fused_words_fn(
-            r, self.bits_rows_for(M), self.kernel == "pallas_interpret"
+            r, self.bits_rows_for(M), self.kernel == "pallas_interpret",
+            True,
         )
-        words_dev = jnp.asarray(words)
+        words_dev = jax.device_put(words)
+        if donation_supported():
+            buffer_pool().donate(words_dev)
         # np.array: writable copy (np.asarray of a jax array is read-only
         # and callers are promised an ordinary ndarray).
         out_w = np.array(fn(words_dev))
+        if lease is not None:
+            # Output materialized => the H2D copy is long done; the
+            # staging page is safe to hand to the next dispatch.
+            buffer_pool().release(lease)
         if dt.route == "compile":
-            maybe_analyze_program(dt.entry, fn, words_dev)
+            # ShapeDtypeStruct, not the live array: the input was donated
+            # and must not be touched again.
+            maybe_analyze_program(
+                dt.entry, fn, jax.ShapeDtypeStruct(words.shape, words.dtype)
+            )
         return np.ascontiguousarray(out_w.view(self.gf.dtype)[:, :S])
+
+    def matmul_stripes_many(self, M: np.ndarray, Ds: list) -> list:
+        """B same-shape stripes products through ONE gated dispatch.
+
+        The CoalescingDispatcher's batch entry: concurrent live requests
+        sharing (matrix, stripe shape) stack into a single
+        ``matmul_words_batch``-class device call (vmap over the batch
+        axis) on the baked GF(2^8) routes, or a stripe-axis concatenation
+        (symbols are positionwise, so ``M @ [D1|D2|..]`` is exact) on the
+        XLA kernel and the byte-sliced wide field. Results are
+        byte-identical to B separate :meth:`matmul_stripes` calls; one
+        DeviceGate slot and one telemetry window cover the whole batch.
+        """
+        Ds = [np.asarray(D, dtype=self.gf.dtype) for D in Ds]
+        if not Ds:
+            return []
+        if len(Ds) == 1:
+            return [self.matmul_stripes(M, Ds[0])]
+        M = np.asarray(M)
+        r, k = M.shape
+        S = Ds[0].shape[1]
+        for D in Ds:
+            if D.shape != (k, S):
+                raise ValueError(
+                    "matmul_stripes_many requires same-shape stripes "
+                    f"(got {D.shape} vs {(k, S)})"
+                )
+        # Batch-size LADDER: runtime batch sizes are whatever concurrency
+        # produced (3 today, 7 the next call), but every distinct batched
+        # shape is its own jitted program — unquantized, a traffic wave
+        # would compile once per novel size (seconds each over the
+        # tunnel). Rounding B up to the next power of two bounds the
+        # program set to log2(max_batch) variants; the pad members are
+        # DISCARDED rows, so they need no zeroing — whatever bytes the
+        # pooled staging page already holds are valid GF symbols.
+        B = len(Ds)
+        B_pad = 1 << (B - 1).bit_length()
+        entry = f"matmul_stripes_{self.kernel}"
+        nbytes = sum(D.nbytes for D in Ds)
+        record_kernel(entry, nbytes)
+        key = dispatch_key(
+            entry, self.kernel, M, (B_pad,) + Ds[0].shape
+        )
+        with device_gate(), device_op(entry, key, nbytes=nbytes) as dt:
+            if self.kernel != "xla" and self.gf.degree == 8:
+                return self._stripes_many_words(M, Ds, B_pad, dt)
+            pad = (
+                [np.empty((k, (B_pad - B) * S), dtype=self.gf.dtype)]
+                if B_pad != B else []
+            )
+            out = self._matmul_stripes_dispatch(
+                M, np.concatenate(Ds + pad, axis=1), dt
+            )
+            return [
+                np.ascontiguousarray(out[:, b * S : (b + 1) * S])
+                for b in range(B)
+            ]
+
+    def _stripes_many_words(self, M: np.ndarray, Ds: list, B_pad: int,
+                            dt) -> list:
+        """GF(2^8) batch route: stack into (B_pad, k, TWp) pooled staging
+        words and run the one vmapped fused dispatch."""
+        B = len(Ds)
+        k, S = Ds[0].shape
+        TWp = pad_words(-(-S // 4))
+        lease = buffer_pool().acquire_padded(B_pad * k, 4 * TWp, S)
+        buf = lease.arr
+        for b, D in enumerate(Ds):
+            buf[b * k : (b + 1) * k, :S] = D
+        words = buf.view("<u4").reshape(B_pad, k, TWp)
+        out_w = np.array(self._matmul_words_batch_dispatch(M, words, dt))
+        buffer_pool().release(lease)
+        res = out_w.view(self.gf.dtype)  # (B_pad, r, 4*TWp) symbols
+        return [np.ascontiguousarray(res[b, :, :S]) for b in range(B)]
 
     def syndrome_stripes(
         self, A: np.ndarray, rows: np.ndarray
@@ -838,13 +1099,20 @@ class DeviceCodec:
         """
         return self.matmul_words_batch(M, words[None])[0]
 
-    def matmul_words_batch(self, M: np.ndarray, words: jnp.ndarray) -> jnp.ndarray:
+    def matmul_words_batch(self, M: np.ndarray, words: jnp.ndarray, *,
+                           donate: bool = False) -> jnp.ndarray:
         """Batched words entry: (B, k, TW) uint32 -> (B, r, TW) uint32.
 
         vmap of the fused lane pipeline per object (the same kernels the
         single-object path runs; vmap adds a grid dimension).
         ``matmul_words`` delegates here with B=1; the streaming encoder
         uses it directly for many same-geometry device-resident objects.
+
+        ``donate=True`` is an explicit caller opt-in that the input device
+        array will never be touched again: on TPU/GPU the B=1 baked route
+        then donates the words' HBM into the output (the streaming
+        encoder's steady-state no-realloc contract). The default keeps
+        caller ownership — bench's chained loops reuse their input.
         """
         if self.kernel == "xla":
             raise ValueError(
@@ -860,10 +1128,12 @@ class DeviceCodec:
         key = dispatch_key("matmul_words", self.kernel, M, tuple(words.shape))
         # Same bounded-queue admission as matmul_stripes (device gate).
         with device_gate(), device_op("matmul_words", key, nbytes=nbytes) as dt:
-            return self._matmul_words_batch_dispatch(M, words, dt)
+            return self._matmul_words_batch_dispatch(
+                M, words, dt, donate=donate
+            )
 
     def _matmul_words_batch_dispatch(self, M: np.ndarray, words: jnp.ndarray,
-                                     dt) -> jnp.ndarray:
+                                     dt, donate: bool = False) -> jnp.ndarray:
         TW = words.shape[2]
         TWp = pad_words(TW) if self.gf.degree == 8 else pad_words16(TW)
         if self.gf.degree == 8 and self.route_for(M) == "mxu":
@@ -883,9 +1153,13 @@ class DeviceCodec:
                     "/ matmul_stripes), not the interleaved words entry"
                 )
             mk = _fused_words_fn if self.gf.degree == 8 else _fused_words16_fn
+            # Donation only on the single-object baked route: vmap wraps
+            # the jit (donation would not thread through), and a padded
+            # input is a fresh on-device copy anyway.
+            donate = donate and words.shape[0] == 1 and TWp == TW
             fn = mk(
                 M.shape[0], self.bits_rows_for(M),
-                self.kernel == "pallas_interpret",
+                self.kernel == "pallas_interpret", donate,
             )
         if TWp != TW:
             words = jnp.pad(words, ((0, 0), (0, 0), (0, TWp - TW)))
@@ -893,13 +1167,16 @@ class DeviceCodec:
             # Single object: skip the vmap wrapper (its extra grid
             # dimension measurably slows wide codes — RS(50,20) 243 vs
             # 201 GB/s on v5e).
+            shape0 = jax.ShapeDtypeStruct(words.shape[1:], words.dtype)
             out = fn(words[0])[None]
         else:
+            shape0 = jax.ShapeDtypeStruct(words.shape[1:], words.dtype)
             out = jax.vmap(fn)(words)
         if dt.route == "compile":
             # Best-effort: the MXU partial has no .lower and a traced
-            # call passes tracers; the analysis degrades to None.
-            maybe_analyze_program("matmul_words", fn, words[0])
+            # call passes tracers; the analysis degrades to None. Shape
+            # struct, not the live array — it may have been donated.
+            maybe_analyze_program("matmul_words", fn, shape0)
         return out[:, :, :TW] if TWp != TW else out
 
     def matmul_planes(self, M: np.ndarray, planes: jnp.ndarray) -> jnp.ndarray:

@@ -131,6 +131,22 @@ class BatchCodec:
         parity = self._matmul_words(self.parity_matrix, words, kernel)
         return jnp.concatenate([jnp.asarray(words, jnp.uint32), parity], axis=1)
 
+    def device_codec(self, kernel: str = "auto"):
+        """The lazily built words-path DeviceCodec (shared with
+        :meth:`_matmul_words`'s cache). Raises for the XLA kernel — the
+        words pipeline has no XLA route; use :meth:`matmul_batch`."""
+        from noise_ec_tpu.ops.dispatch import DeviceCodec, _resolve_kernel
+
+        resolved = _resolve_kernel(kernel)
+        if resolved == "xla":
+            raise ValueError(
+                "no words-path DeviceCodec for the XLA kernel; use "
+                "matmul_batch"
+            )
+        if self._dev is None or self._dev.kernel != resolved:
+            self._dev = DeviceCodec(field=self.field_name, kernel=resolved)
+        return self._dev
+
     def _matmul_words(self, M: np.ndarray, words: jnp.ndarray,
                       kernel: str) -> jnp.ndarray:
         """(R, k) GF matrix x (B, k, TW) words -> (B, R, TW) words.

@@ -1,21 +1,37 @@
-"""Chunked streaming encode for wide / high-rate codes (BASELINE config 3).
+"""Chunked streaming encode/decode for wide / high-rate codes (BASELINE
+config 3) — the double-buffered host↔device data path.
 
 The reference encodes whole messages in one call (main.go:262); for long
-objects (RS(17,3), RS(50,20) streaming configs) the TPU build chunks the byte
-stream on the host and keeps the device busy via JAX's async dispatch: chunk
-i+1 is transferred H2D while chunk i computes (SURVEY.md §2.4 "PP" row — a
-host-side chunk pipeline overlapping H2D/compute/D2H, not mesh pipeline
-parallelism).
+objects (RS(17,3), RS(50,20) streaming configs) the TPU build chunks the
+byte stream on the host and keeps THREE stages of the data path busy at
+once: while chunk i computes on device, chunk i+1's H2D staging is
+already submitted (``jax.device_put`` is asynchronous) and chunk i−1's
+parity is flowing D2H (``copy_to_host_async`` + an explicit readiness
+handle, never a per-chunk ``block_until_ready``). The consumer blocks
+only when the in-flight window is full AND the oldest chunk is still
+computing.
 
-Each chunk is an independent codeword batch, so a lost chunk only costs that
-chunk's shards — the same per-message isolation the reference's mempool gives
-(main.go:55).
+Two transfer-volume rules keep the tunnel/PCIe link the only bound:
+
+- **parity-only fetch**: the device computes and returns ONLY the r
+  parity rows. The k data rows already live on the host (they are the
+  caller's bytes) — shipping them down just to ship them back was
+  ~(n−k+n)/r times the necessary D2H volume (RS(10,4): 3.5x).
+- **donated staging**: the words staged for a chunk are device-put and
+  their HBM donated into the parity output
+  (``matmul_words_batch(donate=True)``), so steady-state encode never
+  grows the device allocation high-water mark (ops/dispatch.py pool
+  rules).
+
+Each chunk is an independent codeword batch, so a lost chunk only costs
+that chunk's shards — the same per-message isolation the reference's
+mempool gives (main.go:55).
 """
 
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from collections import deque
 from typing import Iterable, Iterator, Optional
 
 import jax
@@ -25,23 +41,87 @@ import numpy as np
 from noise_ec_tpu.obs.registry import default_registry
 from noise_ec_tpu.parallel.batch import BatchCodec
 
+__all__ = [
+    "StreamChunk",
+    "StreamingDecoder",
+    "StreamingEncoder",
+    "decode_stream",
+]
 
-@dataclass
+
+def _is_ready(arr) -> bool:
+    """Non-blocking readiness probe of a device array (the explicit
+    handle the double-buffer window polls instead of blocking)."""
+    probe = getattr(arr, "is_ready", None)
+    if probe is None:
+        return True  # plain ndarray: nothing in flight
+    try:
+        return bool(probe())
+    except Exception:  # noqa: BLE001 — a deleted/odd array counts ready
+        return True
+
+
 class StreamChunk:
-    """Encoded shards for one chunk of the stream."""
+    """Encoded shards for one chunk of the stream.
 
-    index: int           # chunk sequence number
-    shards: np.ndarray   # (n, shard_len) uint8 — systematic codeword
-    data_len: int        # unpadded payload bytes in this chunk
+    Constructed either from separate ``data`` (k, stride) / ``parity``
+    (r, stride) uint8 rows — the parity-only-fetch fast path, where the
+    data rows are zero-copy views of the caller's bytes — or from a full
+    ``shards`` (n, stride) array (tests and legacy callers). ``shards``
+    is assembled (one concat copy) only if someone asks for it.
+    """
+
+    __slots__ = ("index", "data_len", "_shards", "data", "parity")
+
+    def __init__(self, index: int, shards: Optional[np.ndarray] = None,
+                 data_len: int = 0, *, data: Optional[np.ndarray] = None,
+                 parity: Optional[np.ndarray] = None):
+        self.index = index
+        self.data_len = data_len
+        self._shards = shards
+        self.data = data
+        self.parity = parity
+        if shards is None and (data is None or parity is None):
+            raise ValueError("StreamChunk needs shards or data+parity")
+
+    @property
+    def shards(self) -> np.ndarray:
+        """(n, stride) codeword rows (assembled and cached on demand)."""
+        if self._shards is None:
+            self._shards = np.concatenate([self.data, self.parity], axis=0)
+        return self._shards
+
+    def rows(self) -> list:
+        """Per-row buffers for wire marshal — zero-copy when the chunk
+        carries split data/parity (no (n, stride) assembly)."""
+        if self._shards is not None:
+            return [self._shards[i] for i in range(self._shards.shape[0])]
+        return (
+            [self.data[i] for i in range(self.data.shape[0])]
+            + [self.parity[i] for i in range(self.parity.shape[0])]
+        )
+
+
+class _Pending:
+    """One in-flight chunk of the double-buffered window."""
+
+    __slots__ = ("index", "data_len", "data", "parity_dev", "t0")
+
+    def __init__(self, index, data_len, data, parity_dev, t0):
+        self.index = index
+        self.data_len = data_len
+        self.data = data
+        self.parity_dev = parity_dev
+        self.t0 = t0
 
 
 class StreamingEncoder:
     """Encode an arbitrary byte stream as a sequence of RS codewords.
 
     ``chunk_bytes`` is the payload per codeword; it is split into k equal
-    stripes (zero-padded tail chunk) and parity is computed on device. The
-    returned iterator is pipelined: the next chunk's H2D copy and compute are
-    dispatched before the previous chunk's result is fetched.
+    stripes (zero-padded tail chunk) and parity is computed on device
+    through the double-buffered window (module docstring): H2D of chunk
+    i+1 overlaps compute of chunk i and the D2H of chunk i−1.
     """
 
     def __init__(self, data_shards: int, parity_shards: int, *,
@@ -50,6 +130,7 @@ class StreamingEncoder:
         self.codec = BatchCodec(data_shards, parity_shards, field=field,
                                 matrix=matrix)
         self.k = data_shards
+        self.r = parity_shards
         self.n = data_shards + parity_shards
         sym = self.codec.gf.degree // 8
         from noise_ec_tpu.ops.dispatch import _resolve_kernel
@@ -79,33 +160,78 @@ class StreamingEncoder:
             "noise_ec_stream_chunk_seconds"
         ).labels()
 
-    def _to_stripes(self, chunk: bytes) -> np.ndarray:
+    def _stage(self, chunk) -> np.ndarray:
+        """(k, stride) uint8 data rows. Full chunks are zero-copy views
+        of the caller's bytes (the caller holds them for the call — the
+        same retention contract as the host shim path); short tail
+        chunks get their own padded buffer, since the rows escape to the
+        consumer inside the yielded StreamChunk."""
         buf = np.frombuffer(chunk, dtype=np.uint8)
-        stride = self._padded_bytes // self.k
         if buf.size < self._padded_bytes:
             pad = np.zeros(self._padded_bytes, dtype=np.uint8)
             pad[: buf.size] = buf
             buf = pad
-        stripes = buf.reshape(self.k, stride)
-        if self.codec.gf.degree == 16:
-            stripes = stripes.view("<u2")
-        return stripes
+        return buf.reshape(self.k, self._padded_bytes // self.k)
+
+    def _dispatch_chunk(self, idx: int, chunk, t0: float) -> _Pending:
+        """Submit one chunk's H2D + parity compute; returns the pending
+        handle without waiting on anything."""
+        data = self._stage(chunk)
+        if self._use_words:
+            from noise_ec_tpu.ops.dispatch import (
+                buffer_pool,
+                donation_supported,
+            )
+
+            # (1, k, TW) from the start so the device_put result is the
+            # ONLY reference to the staged buffer — donation then truly
+            # recycles its HBM into the parity output.
+            words = np.ascontiguousarray(data).view("<u4")[None]
+            words_dev = jax.device_put(words)
+            donate = donation_supported()
+            if donate:
+                buffer_pool().donate(words_dev)
+            dev = self.codec.device_codec(self._kernel)
+            parity_dev = dev.matmul_words_batch(
+                self.codec.parity_matrix, words_dev, donate=donate
+            )[0]
+        else:
+            sym = data.view("<u2") if self.codec.gf.degree == 16 else data
+            parity_dev = self.codec.matmul_batch(
+                self.codec.parity_matrix, jnp.asarray(sym)[None]
+            )[0]
+        # Start the D2H now (explicit readiness handle; the window polls
+        # is_ready and blocks only when full).
+        try:
+            parity_dev.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — backends without the hint
+            pass
+        return _Pending(idx, len(chunk), data, parity_dev, t0)
+
+    def _finish(self, pend: _Pending) -> StreamChunk:
+        arr = np.asarray(pend.parity_dev)  # blocks only if not ready yet
+        if arr.dtype != np.uint8:
+            arr = arr.view(np.uint8)
+        self._chunk_hist.observe(time.perf_counter() - pend.t0)
+        return StreamChunk(
+            index=pend.index, data_len=pend.data_len,
+            data=pend.data, parity=arr,
+        )
+
+    def _drain(self, window: deque, depth: int) -> Iterator[StreamChunk]:
+        """Yield leading chunks in index order: ready heads always flow
+        (free progress while the device works); a still-computing head
+        blocks the consumer only once the window exceeds ``depth``."""
+        while window and (
+            len(window) > depth or _is_ready(window[0].parity_dev)
+        ):
+            yield self._finish(window.popleft())
 
     def encode_stream(self, chunks: Iterable[bytes],
                       depth: int = 4) -> Iterator[StreamChunk]:
-        """Yield encoded StreamChunks; keeps up to ``depth`` in flight.
-
-        Results are fetched in GROUPS (one ``jax.device_get`` over the
-        oldest half of the in-flight window) rather than one array per
-        round-trip: on links with per-transfer latency (PCIe small
-        transfers; the axon tunnel's ~130 ms fixed RPC cost) a grouped
-        fetch amortizes that latency across several chunks — see
-        BASELINE.md's device-tier note. Keeping the other half in flight
-        preserves compute/consume overlap on low-latency links: the
-        device still holds dispatched work while the consumer handles the
-        yielded group.
-        """
-        inflight: list[tuple[int, int, jnp.ndarray, float]] = []
+        """Yield encoded StreamChunks; keeps up to ``depth`` in flight
+        (the double-buffered window — module docstring)."""
+        window: deque = deque()
         idx = 0
         for chunk in chunks:
             if len(chunk) > self.chunk_bytes:
@@ -114,22 +240,10 @@ class StreamingEncoder:
                     f"{self.chunk_bytes}"
                 )
             t0 = time.perf_counter()
-            stripes = self._to_stripes(chunk)
-            # B=1 batch; async dispatch returns immediately. On TPU the
-            # chunk rides as uint32 words through the fused lane pipeline
-            # (host view is free); elsewhere the portable symbol path.
-            if self._use_words:
-                words = np.ascontiguousarray(stripes).view("<u4")
-                full = self.codec.encode_batch_words(
-                    jnp.asarray(words)[None], kernel=self._kernel)[0]
-            else:
-                full = self.codec.encode_batch(jnp.asarray(stripes)[None])[0]
-            inflight.append((idx, len(chunk), full, t0))
+            window.append(self._dispatch_chunk(idx, chunk, t0))
             idx += 1
-            if len(inflight) >= depth:
-                yield from self._drain_group(inflight, keep=depth // 2)
-        while inflight:
-            yield from self._drain_group(inflight)
+            yield from self._drain(window, depth)
+        yield from self._drain(window, 0)
 
     def encode_bytes(self, data: bytes, depth: int = 4) -> Iterator[StreamChunk]:
         """Convenience: chunk a contiguous buffer and encode_stream it."""
@@ -140,19 +254,61 @@ class StreamingEncoder:
             return iter(())
         return self.encode_stream(gen(), depth=depth)
 
-    def _drain_group(self, inflight, keep: int = 0) -> Iterator[StreamChunk]:
-        """One coalesced device_get of the oldest in-flight results,
-        leaving ``keep`` still in flight for compute/consume overlap."""
-        cut = max(len(inflight) - keep, 1)
-        group = inflight[:cut]
-        del inflight[:cut]
-        arrs = jax.device_get([full for (_, _, full, _) in group])
-        done = time.perf_counter()
-        for (i, dlen, _, t0), arr in zip(group, arrs):
-            self._chunk_hist.observe(done - t0)
-            if arr.dtype != np.uint8:
-                arr = arr.view(np.uint8)
-            yield StreamChunk(index=i, shards=arr, data_len=dlen)
+
+class StreamingDecoder:
+    """Pipelined degraded-chunk rebuild: the decode path's half of the
+    double-buffered window. Chunks whose shards share one erasure
+    pattern ride ``BatchCodec.reconstruct_batch_words`` with the same
+    H2D / compute / D2H overlap as the encoder — H2D of chunk i+1
+    overlaps the reconstruct of chunk i and the fetch of chunk i−1."""
+
+    def __init__(self, data_shards: int, parity_shards: int, *,
+                 field: str = "gf256", matrix: str = "cauchy",
+                 kernel: str = "auto"):
+        self.codec = BatchCodec(data_shards, parity_shards, field=field,
+                                matrix=matrix)
+        self.k = data_shards
+        self.n = data_shards + parity_shards
+        self._kernel = kernel
+
+    def reconstruct_stream(self, chunks: Iterable[tuple],
+                           present: list[int],
+                           depth: int = 4) -> Iterator[tuple]:
+        """``chunks``: iterable of (index, rows) with ``rows`` a
+        (len(present), stride_bytes) uint8 array of the surviving shards
+        in ``present`` index order. Yields (index, full (n, stride)
+        uint8 codeword rows) in input order, pipelined ``depth`` deep."""
+        window: deque = deque()
+
+        def finish(entry):
+            idx, dev_rows = entry
+            out = np.asarray(dev_rows)
+            if out.dtype != np.uint8:
+                out = (
+                    np.ascontiguousarray(out).view(np.uint8)
+                    .reshape(self.n, -1)
+                )
+            return idx, out
+
+        for idx, rows in chunks:
+            rows = np.asarray(rows)
+            if rows.dtype != np.uint8:
+                rows = rows.view(np.uint8)
+            words = np.ascontiguousarray(rows).view("<u4")
+            dev_rows = self.codec.reconstruct_batch_words(
+                jnp.asarray(words)[None], present, kernel=self._kernel
+            )[0]
+            try:
+                dev_rows.copy_to_host_async()
+            except Exception:  # noqa: BLE001
+                pass
+            window.append((idx, dev_rows))
+            while window and (
+                len(window) > depth or _is_ready(window[0][1])
+            ):
+                yield finish(window.popleft())
+        while window:
+            yield finish(window.popleft())
 
 
 def decode_stream(chunks: Iterable[StreamChunk], data_shards: int,
@@ -160,7 +316,10 @@ def decode_stream(chunks: Iterable[StreamChunk], data_shards: int,
     """Reassemble the byte stream from (in-order, complete) StreamChunks."""
     parts = []
     for c in chunks:
-        arr = np.asarray(c.shards[:data_shards])
+        arr = (
+            np.asarray(c.data) if c.data is not None
+            else np.asarray(c.shards[:data_shards])
+        )
         if arr.dtype != np.uint8:  # rebuilt gf65536 chunks arrive as uint16
             arr = arr.view(np.uint8)
         data = arr.reshape(-1)[: c.data_len]

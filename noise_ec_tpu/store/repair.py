@@ -147,7 +147,6 @@ class RepairEngine:
         self._pinned: set[str] = set()
         self._last_fetch: OrderedDict[str, float] = OrderedDict()
         self._last_respond: OrderedDict[str, float] = OrderedDict()
-        self._batch_codecs: dict[tuple[int, int, str], object] = {}
         self._fecs: dict[tuple[int, int, str], object] = {}
         self._thread: Optional[threading.Thread] = None
         self._closed = False
@@ -328,15 +327,6 @@ class RepairEngine:
 
     # ------------------------------------------------- local reconstruct
 
-    def _batch_codec(self, k: int, n: int, field: str):
-        bkey = (k, n, field)
-        bc = self._batch_codecs.get(bkey)
-        if bc is None:
-            from noise_ec_tpu.parallel.batch import BatchCodec
-
-            bc = self._batch_codecs[bkey] = BatchCodec(k, n - k, field=field)
-        return bc
-
     def _sym_dtype(self, field: str):
         return np.dtype("<u2") if field == "gf65536" else np.dtype(np.uint8)
 
@@ -352,26 +342,36 @@ class RepairEngine:
         repaired = 0
         with span("repair", stripes=len(members), k=k, n=n, **node_attrs()):
             if len(members) >= self.batch_min:
-                bc = self._batch_codec(k, n, fieldname)
-                stack = np.stack([
+                # One coalesced dispatch for the whole group: the engine
+                # no longer keeps a private batch path — it hands the
+                # pre-formed batch to the live-path CoalescingDispatcher
+                # (rs.matmul_many -> ops/coalesce.py submit_many), so
+                # repair work and live encode/decode traffic share one
+                # queue (and the DeviceGate admission behind it), and a
+                # concurrent same-shape live request can ride the same
+                # device call as a repair storm.
+                from noise_ec_tpu.matrix.linalg import reconstruction_matrix
+
+                rs = self.store.codec(k, n, fieldname)
+                basis = sorted(trusted)[:k]
+                R = reconstruction_matrix(rs.gf, rs.G, basis, wanted)
+                stacks = [
                     np.stack([
                         np.frombuffer(shards[i], dtype=np.uint8).view(dt)
-                        for i in trusted
+                        for i in basis
                     ])
                     for _, shards in members
-                ])
-                full = np.asarray(
-                    bc.reconstruct_batch(stack, list(trusted))
-                )
+                ]
+                filled = rs.matmul_many(R, stacks)
                 self.metrics.batches.add(1)
                 self.metrics.batch_stripes.add(len(members))
                 rebuilt = [
                     {
-                        i: np.ascontiguousarray(full[b, i])
+                        i: np.ascontiguousarray(rows[row])
                         .view(np.uint8).tobytes()
-                        for i in wanted
+                        for row, i in enumerate(wanted)
                     }
-                    for b in range(len(members))
+                    for rows in filled
                 ]
             else:
                 rs = self.store.codec(k, n, fieldname)

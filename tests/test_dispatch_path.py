@@ -1,0 +1,354 @@
+"""Host↔device data-path tests (ISSUE 8): the live-path coalescer,
+the staging buffer pool + donation rules, the `_to_sym` no-copy fast
+path, and the double-buffered streaming window (docs/design.md §12)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from noise_ec_tpu.codec.rs import ReedSolomon
+from noise_ec_tpu.golden.codec import GoldenCodec
+from noise_ec_tpu.obs.registry import default_registry
+from noise_ec_tpu.ops import dispatch
+from noise_ec_tpu.ops.coalesce import (
+    CoalescingDispatcher,
+    configure_coalescer,
+    set_coalesce_cutoff,
+)
+from noise_ec_tpu.parallel.streaming import (
+    StreamChunk,
+    StreamingDecoder,
+    StreamingEncoder,
+    decode_stream,
+)
+
+
+def counter_value(name: str, **labels) -> float:
+    return default_registry().counter(name).labels(**labels).value
+
+
+@pytest.fixture(autouse=True)
+def _fresh_data_path_state():
+    """Every test gets (and leaves behind) default process-wide data-path
+    state: coalescer, payload cutoff, staging pool, codec breaker."""
+    yield
+    configure_coalescer()
+    set_coalesce_cutoff(None)
+    dispatch.configure_buffer_pool()
+    dispatch.configure_codec_breaker()
+
+
+# ------------------------------------------------------------ coalescer
+
+
+def _warm_hot(disp: CoalescingDispatcher) -> None:
+    """Mark the dispatcher hot: one solo submit from ANOTHER thread puts
+    the next main-thread submit inside the cross-thread hot window."""
+    t = threading.Thread(
+        target=lambda: disp.submit("warm", lambda ps: ps, 0), daemon=True
+    )
+    t.start()
+    t.join()
+
+
+def test_solo_request_on_idle_dispatcher_flushes_immediately():
+    disp = CoalescingDispatcher(linger_seconds=5.0, max_batch=8,
+                                hot_window_seconds=0.0)
+    solo0 = counter_value("noise_ec_coalesce_flush_reason_total",
+                          reason="solo")
+    t0 = time.perf_counter()
+    assert disp.submit("k", lambda ps: [p + 1 for p in ps], 41) == 42
+    # An uncontended request must never pay the linger budget.
+    assert time.perf_counter() - t0 < 1.0
+    assert counter_value(
+        "noise_ec_coalesce_flush_reason_total", reason="solo"
+    ) == solo0 + 1
+
+
+def test_flush_on_timeout_is_bounded_by_the_linger_budget():
+    """A hot leader with no followers flushes once the linger budget
+    expires — the bounded-latency contract (reason="linger")."""
+    disp = CoalescingDispatcher(linger_seconds=0.25, max_batch=8,
+                                hot_window_seconds=30.0)
+    _warm_hot(disp)
+    linger0 = counter_value("noise_ec_coalesce_flush_reason_total",
+                            reason="linger")
+    t0 = time.perf_counter()
+    assert disp.submit("k", lambda ps: [p * 2 for p in ps], 21) == 42
+    elapsed = time.perf_counter() - t0
+    assert 0.2 <= elapsed < 3.0, elapsed
+    assert counter_value(
+        "noise_ec_coalesce_flush_reason_total", reason="linger"
+    ) == linger0 + 1
+
+
+def test_follower_joins_lingering_leader_and_full_bucket_flushes_early():
+    """A second same-key request rides the leader's batch, and a full
+    bucket flushes WITHOUT waiting out the (here: absurd) linger."""
+    disp = CoalescingDispatcher(linger_seconds=30.0, max_batch=2,
+                                hot_window_seconds=30.0)
+    _warm_hot(disp)
+    sizes: list = []
+
+    def batch_fn(ps):
+        sizes.append(len(ps))
+        return [p * 10 for p in ps]
+
+    results: dict = {}
+
+    def follower():
+        time.sleep(0.1)
+        results["f"] = disp.submit("k", batch_fn, 2)
+
+    thr = threading.Thread(target=follower, daemon=True)
+    thr.start()
+    t0 = time.perf_counter()
+    results["leader"] = disp.submit("k", batch_fn, 1)
+    elapsed = time.perf_counter() - t0
+    thr.join(timeout=10)
+    assert results == {"leader": 10, "f": 20}  # fan-out kept per-caller
+    assert sizes == [2]  # ONE dispatch served both
+    assert elapsed < 10.0  # full bucket never waits out the linger
+
+
+def test_submit_many_is_one_bulk_flush_without_linger():
+    disp = CoalescingDispatcher(linger_seconds=30.0, max_batch=32,
+                                hot_window_seconds=30.0)
+    _warm_hot(disp)  # even hot, a pre-formed batch must not linger
+    bulk0 = counter_value("noise_ec_coalesce_flush_reason_total",
+                          reason="bulk")
+    batches0 = counter_value("noise_ec_coalesce_batches_total")
+    t0 = time.perf_counter()
+    out = disp.submit_many("k", lambda ps: [p + 1 for p in ps], [1, 2, 3])
+    assert time.perf_counter() - t0 < 5.0
+    assert out == [2, 3, 4]
+    assert counter_value(
+        "noise_ec_coalesce_flush_reason_total", reason="bulk"
+    ) == bulk0 + 1
+    assert counter_value("noise_ec_coalesce_batches_total") == batches0 + 1
+
+
+def test_batch_fn_error_fans_out_to_every_member():
+    disp = CoalescingDispatcher(linger_seconds=30.0, max_batch=2,
+                                hot_window_seconds=30.0)
+    _warm_hot(disp)
+
+    def boom(ps):
+        raise RuntimeError("injected batch fault")
+
+    errors: list = []
+
+    def follower():
+        time.sleep(0.1)
+        try:
+            disp.submit("k", boom, 2)
+        except RuntimeError as exc:
+            errors.append(str(exc))
+
+    thr = threading.Thread(target=follower, daemon=True)
+    thr.start()
+    with pytest.raises(RuntimeError, match="injected batch fault"):
+        disp.submit("k", boom, 1)
+    thr.join(timeout=10)
+    assert errors == ["injected batch fault"]
+
+
+def test_coalesced_mixed_interleaved_geometries_byte_identical(rng):
+    """Concurrent encodes of TWO interleaved geometries through the
+    process coalescer: every result byte-identical to the numpy-backend
+    truth (buckets must never mix shapes/matrices)."""
+    set_coalesce_cutoff(1 << 30)  # force the coalescing regime
+    configure_coalescer(linger_seconds=0.002, max_batch=8,
+                        hot_window_seconds=0.05)
+    geos = [(3, 5), (5, 9)]
+    codecs = {g: ReedSolomon(g[0], g[1] - g[0]) for g in geos}
+    truth = {g: ReedSolomon(g[0], g[1] - g[0], backend="numpy")
+             for g in geos}
+    per_thread, n_threads, S = 6, 4, 256
+    stripes = {
+        g: [rng.integers(0, 256, size=(g[0], S)).astype(np.uint8)
+            for _ in range(per_thread)]
+        for g in geos
+    }
+    want = {
+        g: [np.stack(truth[g].encode(list(D))[g[0]:]) for D in stripes[g]]
+        for g in geos
+    }
+    start = threading.Barrier(n_threads)
+    failures: list = []
+
+    def worker(tid: int):
+        g = geos[tid % len(geos)]
+        rs = codecs[g]
+        start.wait()
+        for i, D in enumerate(stripes[g]):
+            out = rs._mul(rs.G[g[0]:], D)
+            if not np.array_equal(out, want[g][i]):
+                failures.append((tid, i))
+
+    threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not failures
+    # matmul_many (the repair engine's entry) agrees with per-call _mul.
+    g = geos[0]
+    rs = codecs[g]
+    outs = rs.matmul_many(rs.G[g[0]:], stripes[g])
+    for out, w in zip(outs, want[g]):
+        np.testing.assert_array_equal(out, w)
+
+
+def test_breaker_trip_mid_batch_degrades_every_member_to_golden(
+    rng, monkeypatch
+):
+    """An injected device fault under a coalesced batch: the breaker
+    trips, and EVERY member of the batch still gets golden-host-exact
+    bytes through its own fallback arm."""
+    set_coalesce_cutoff(1 << 30)
+    configure_coalescer(linger_seconds=0.002, max_batch=8,
+                        hot_window_seconds=0.05)
+    br = dispatch.configure_codec_breaker(reset_timeout=60.0,
+                                          max_reset_timeout=120.0)
+    k, r, S = 4, 2, 128
+    rs = ReedSolomon(k, r)
+    truth = ReedSolomon(k, r, backend="numpy")
+    stripes = [rng.integers(0, 256, size=(k, S)).astype(np.uint8)
+               for _ in range(5)]
+    want = [np.stack(truth.encode(list(D))[k:]) for D in stripes]
+
+    def boom(self, M, Ds):
+        raise RuntimeError("injected device fault")
+
+    with monkeypatch.context() as mp:
+        mp.setattr(dispatch.DeviceCodec, "matmul_stripes_many", boom)
+        outs = rs.matmul_many(rs.G[k:], stripes)
+        for out, w in zip(outs, want):
+            np.testing.assert_array_equal(out, w)
+        assert br.state() == "open"
+        # While open: the device is not attempted, members still served.
+        np.testing.assert_array_equal(rs._mul(rs.G[k:], stripes[0]), want[0])
+
+
+# ------------------------------------------------- staging buffer pool
+
+
+def test_buffer_pool_reuses_pages_and_rezeroes_only_dirty_tail():
+    pool = dispatch.configure_buffer_pool(max_per_key=4)
+    hits0 = counter_value("noise_ec_device_buffer_pool_hits_total")
+    miss0 = counter_value("noise_ec_device_buffer_pool_misses_total")
+    lease = pool.acquire_padded(4, 64, 48)
+    assert lease.arr.shape == (4, 64)
+    assert not lease.arr[:, 48:].any()  # pad tail arrives zero
+    lease.arr[:, :48] = 0xFF  # dirty exactly the payload columns
+    pool.release(lease)
+    # Smaller payload on the recycled page: the previously dirty columns
+    # are re-zeroed, the rest of the tail was never touched.
+    lease2 = pool.acquire_padded(4, 64, 16)
+    assert lease2.arr is lease.arr
+    assert not lease2.arr[:, 16:].any()
+    assert counter_value("noise_ec_device_buffer_pool_hits_total") == hits0 + 1
+    assert counter_value(
+        "noise_ec_device_buffer_pool_misses_total"
+    ) == miss0 + 1  # only the first acquire allocated
+
+
+def test_donation_bookkeeping_invalidates_exactly_once():
+    pool = dispatch.configure_buffer_pool()
+    arr = np.arange(16, dtype=np.uint8)
+    assert not pool.was_donated(arr)
+    pool.donate(arr)
+    assert pool.was_donated(arr)
+    with pytest.raises(RuntimeError, match="donated twice"):
+        pool.donate(arr)
+    # A DIFFERENT array reusing the id slot after gc is not blocked:
+    # the weakref callback drops the stale record with its referent.
+    del arr
+    other = np.arange(16, dtype=np.uint8)
+    assert not pool.was_donated(other)
+    pool.donate(other)
+
+
+# ------------------------------------------------- _to_sym no-copy path
+
+
+def test_to_sym_skips_copy_for_aligned_contiguous_buffers(rng):
+    rs = ReedSolomon(4, 2)
+    arr = rng.integers(0, 256, size=64).astype(np.uint8)
+    assert rs._to_sym(arr, "x") is arr  # the live receive-path case
+    raw = arr.tobytes()
+    out = rs._to_sym(raw, "x")
+    assert np.shares_memory(out, np.frombuffer(raw, dtype=np.uint8))
+    # Non-contiguous input still lands in symbol form (copied).
+    sliced = arr[::2]
+    out2 = rs._to_sym(sliced, "x")
+    assert out2.flags.c_contiguous and not np.shares_memory(out2, arr)
+    # Wide field: an even-length byte buffer reinterprets in place.
+    rs16 = ReedSolomon(4, 2, field="gf65536")
+    out16 = rs16._to_sym(arr, "x")
+    assert out16.dtype == np.dtype("<u2")
+    assert np.shares_memory(out16, arr)
+
+
+# ------------------------------------- double-buffered streaming window
+
+
+def test_double_buffered_encode_stream_orders_and_roundtrips(rng):
+    """CPU-backend ordering pin for the in-flight window: chunks come
+    back strictly in index order, parity is golden-exact per chunk, and
+    the split data/parity StreamChunk round-trips the byte stream."""
+    k, r, chunk_payload = 10, 4, 10 * 64
+    n_chunks = 7
+    data = rng.integers(
+        0, 256, size=chunk_payload * (n_chunks - 1) + 131
+    ).astype(np.uint8).tobytes()
+    enc = StreamingEncoder(k, r, chunk_bytes=chunk_payload)
+    golden = GoldenCodec(k, k + r)
+    chunks = list(enc.encode_bytes(data, depth=3))
+    assert [c.index for c in chunks] == list(range(n_chunks))
+    for c in chunks:
+        want_parity = np.asarray(golden.encode(np.asarray(c.data)))
+        np.testing.assert_array_equal(np.asarray(c.parity), want_parity)
+        assert c.shards.shape == (k + r, chunk_payload // k)
+        assert len(c.rows()) == k + r
+    assert decode_stream(iter(chunks), k, total_len=len(data)) == data
+
+
+def test_streaming_decoder_reconstructs_in_order(rng):
+    k, r, S = 4, 2, 64
+    n = k + r
+    enc = StreamingEncoder(k, r, chunk_bytes=k * S)
+    data = rng.integers(0, 256, size=k * S * 5).astype(np.uint8).tobytes()
+    chunks = list(enc.encode_bytes(data, depth=2))
+    present = [i for i in range(n) if i not in (1, 4)]  # lose data+parity
+    degraded = [
+        (c.index, np.asarray(c.shards)[present]) for c in chunks
+    ]
+    dec = StreamingDecoder(k, r)
+    out = list(dec.reconstruct_stream(iter(degraded), present, depth=2))
+    assert [idx for idx, _ in out] == [c.index for c in chunks]
+    for (idx, rows), c in zip(out, chunks):
+        np.testing.assert_array_equal(rows, np.asarray(c.shards))
+    rebuilt = [
+        StreamChunk(index=idx, shards=rows, data_len=c.data_len)
+        for (idx, rows), c in zip(out, chunks)
+    ]
+    assert decode_stream(iter(rebuilt), k, total_len=len(data)) == data
+
+
+def test_stream_chunk_split_rows_are_zero_copy():
+    data = np.arange(40, dtype=np.uint8).reshape(4, 10)
+    parity = np.arange(20, dtype=np.uint8).reshape(2, 10)
+    c = StreamChunk(index=0, data_len=40, data=data, parity=parity)
+    rows = c.rows()
+    assert np.shares_memory(rows[0], data)  # no (n, stride) assembly
+    assert np.shares_memory(rows[4], parity)
+    np.testing.assert_array_equal(
+        c.shards, np.concatenate([data, parity], axis=0)
+    )
+    with pytest.raises(ValueError):
+        StreamChunk(index=1, data_len=1)

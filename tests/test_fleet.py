@@ -24,6 +24,34 @@ def counter_total(name: str) -> float:
     )
 
 
+def _exposition_hist_buckets(text: str, family: str) -> dict:
+    """{le bound: cumulative count} for a histogram on /metrics text
+    (empty when the family has not been exposed yet)."""
+    buckets: dict = {}
+    for line in text.splitlines():
+        if line.startswith(f"{family}_bucket"):
+            le = line.split('le="', 1)[1].split('"', 1)[0]
+            bound = float("inf") if le == "+Inf" else float(le)
+            buckets[bound] = float(line.rsplit(" ", 1)[1])
+    return buckets
+
+
+def _hist_delta_p50(before: dict, after: dict) -> float:
+    """p50 of the observations made BETWEEN two /metrics scrapes (the
+    registry is process-global, so earlier tests' observations must not
+    dilute the window): smallest bound reaching half the new count."""
+    deltas = sorted(
+        (bound, cum - before.get(bound, 0.0)) for bound, cum in after.items()
+    )
+    assert deltas, "histogram never exposed"
+    total = deltas[-1][1]
+    assert total > 0, "no observations in the scrape window"
+    for bound, cum in deltas:
+        if cum >= total / 2:
+            return bound
+    return float("inf")
+
+
 # ------------------------------------------------------------- grammar
 
 
@@ -316,6 +344,10 @@ def test_small_fleet_acceptance_mixed_traffic_under_named_chaos():
     server = StatsServer()
     lab.attach(server)
     try:
+        with urlopen(f"{server.url}/metrics", timeout=5) as resp:
+            co_before = _exposition_hist_buckets(
+                resp.read().decode(), "noise_ec_coalesce_batch_size"
+            )
         report = lab.run()
         delivery = report["delivery"]
         assert delivery["expected"] >= 800, report
@@ -331,6 +363,16 @@ def test_small_fleet_acceptance_mixed_traffic_under_named_chaos():
         assert report["chaos_profile"] == "lossy"
         # The named profile actually injected faults.
         assert report["chaos"]["dropped"] + report["chaos"]["corrupted"] > 0
+
+        # Live-path coalescing really amortized the fleet's codec calls
+        # (ISSUE 8): the batch-size p50 ON /metrics over the run's own
+        # observations is above 1 — a typical request rode a batched
+        # device dispatch.
+        with urlopen(f"{server.url}/metrics", timeout=5) as resp:
+            co_after = _exposition_hist_buckets(
+                resp.read().decode(), "noise_ec_coalesce_batch_size"
+            )
+        assert _hist_delta_p50(co_before, co_after) > 1.0
 
         # GET /fleet serves live harness status via the PR-6 route table.
         with urlopen(f"{server.url}/fleet", timeout=5) as resp:

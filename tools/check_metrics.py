@@ -162,6 +162,7 @@ def check() -> list[str]:
     problems.extend(check_device_docs())
     problems.extend(check_object_docs())
     problems.extend(check_fleet_docs())
+    problems.extend(check_datapath_docs())
     return problems
 
 
@@ -302,6 +303,51 @@ def check_fleet_docs() -> list[str]:
     problems.extend(
         f"fleet surface {tok} is not documented in docs/fleet.md"
         for tok in FLEET_DOC_TOKENS
+        if tok not in text
+    )
+    return problems
+
+
+# The host<->device data path (docs/design.md §12 owns the buffer
+# lifecycle, donation rules and coalescer flush policy the
+# noise_ec_coalesce_* / noise_ec_device_buffer_pool_* families
+# instrument): its families must be documented THERE as well as in the
+# observability registry table, plus the surfaces that exist only as
+# identifiers in the code.
+DATAPATH_PREFIXES = (
+    "noise_ec_coalesce_",
+    "noise_ec_device_buffer_pool_",
+)
+DATAPATH_DOC_TOKENS = (
+    "CoalescingDispatcher",
+    "DeviceBufferPool",
+    "donate_argnums",
+    "copy_to_host_async",
+    "submit_many",
+    "matmul_stripes_many",
+)
+
+
+def check_datapath_docs() -> list[str]:
+    """Data-path families + surfaces vs docs/design.md §12."""
+    from noise_ec_tpu.obs.registry import METRICS
+
+    doc_path = REPO / "docs" / "design.md"
+    names = [n for n in METRICS if n.startswith(DATAPATH_PREFIXES)]
+    if not names:
+        return []
+    if not doc_path.exists():
+        return [f"docs file {doc_path} missing (data-path metrics exist)"]
+    text = doc_path.read_text(encoding="utf-8")
+    problems = [
+        f"data-path metric {n!r} is not documented in docs/design.md "
+        "(host<->device data path section)"
+        for n in names
+        if n not in text
+    ]
+    problems.extend(
+        f"data-path surface {tok} is not documented in docs/design.md"
+        for tok in DATAPATH_DOC_TOKENS
         if tok not in text
     )
     return problems
