@@ -134,7 +134,11 @@ class ReedSolomon:
         """Explicit batched ``_mul``: B same-shape products through one
         coalesced dispatch (the repair engine's group reconstruct rides
         this, sharing the coalescer's queue — and the DeviceGate behind
-        it — with live traffic). Same fallback guarantees as ``_mul``."""
+        it — with live traffic). On a multi-chip rig the batched
+        dispatch additionally shards its batch axis over the mesh
+        dispatch tier (parallel/mesh.py), so a repair storm and the
+        live encodes it coalesces with run on ALL visible chips. Same
+        fallback guarantees as ``_mul``."""
         from noise_ec_tpu.ops.coalesce import coalescer
 
         Ds = [np.asarray(D) for D in Ds]

@@ -446,6 +446,7 @@ def _try_fused_single_row(
     e: int,
     systematic: bool,
     recurse,
+    device=None,
 ):
     """Speculative whole-share decode: one fused pass when a single basis
     row explains the corruption.
@@ -464,13 +465,19 @@ def _try_fused_single_row(
     per-column; MDS and par1 callers pass their own decoder so the
     per-column guarantee matches the caller's contract).
 
+    On the DEVICE route the same speculation runs the decode1 fold
+    (ops/dispatch.decode1_fold_matrix) instead of the shim: corrected
+    row + rank-1 consistency rows as ONE generator-shaped device matmul
+    — the same kernel class (and rate) as encode, and the entry the
+    mesh dispatch tier shards for batched decodes (parallel/mesh.py).
+    Columns whose consistency rows are nonzero defeated the hypothesis
+    and recurse exactly like the shim path's ``state == 2`` columns.
+
     Returns NotImplemented when the speculation does not apply (caller
     runs the generic path), None when a gathered leftover column is
     beyond the decoding radius, or the (data_rows, touched, corrected)
     result.
     """
-    from noise_ec_tpu.shim import gf16_decode1_fused, gf_decode1_fused
-
     S = rows[0].size
     probe = min(_probe_symbols(gf), S)
     res = _syndrome(gf, A, [r_[:probe] for r_ in rows], k)
@@ -487,11 +494,26 @@ def _try_fused_single_row(
         if cand >= k or (j is not None and cand != j):
             return NotImplemented
         j = cand
-    fused_fn = gf_decode1_fused if gf.degree == 8 else gf16_decode1_fused
-    fused = fused_fn(A, rows[:k], rows[k:], j, e, S)
-    if fused is None:
-        return NotImplemented
-    out_row, state = fused
+    if device is not None:
+        from noise_ec_tpu.ops.dispatch import decode1_fold_matrix
+
+        try:
+            Dm = decode1_fold_matrix(gf, A, j)
+        except ValueError:  # < 2 check rows: no verify behind the fold
+            return NotImplemented
+        out = np.asarray(device.matmul_stripes(Dm, np.stack(rows)))
+        out_row = np.ascontiguousarray(out[0])
+        # Any nonzero consistency byte defeats the hypothesis there —
+        # same column contract as the shim's state == 2.
+        state = (out[1:] != 0).any(axis=0).astype(np.uint8) * 2
+    else:
+        from noise_ec_tpu.shim import gf16_decode1_fused, gf_decode1_fused
+
+        fused_fn = gf_decode1_fused if gf.degree == 8 else gf16_decode1_fused
+        fused = fused_fn(A, rows[:k], rows[k:], j, e, S)
+        if fused is None:
+            return NotImplemented
+        out_row, state = fused
     corrections: dict[int, list] = {j: [("replace", out_row)]}
     overrides = {}
     leftover = np.flatnonzero(state == 2)
@@ -521,18 +543,21 @@ def _maybe_fused_single_row(
     speculate: bool,
 ):
     """One owner for the speculation gate shared by both decoders: arm the
-    fused path only on wide host-tier decodes (both shim fields) with
-    correction actually permitted (callers fold contract knobs like
-    max_support into ``speculate``). NotImplemented = generic path."""
+    fused path only on wide decodes (both shim fields; the device route
+    arms at the same byte budget — one device pass beats materializing
+    the syndrome there too) with correction actually permitted (callers
+    fold contract knobs like max_support into ``speculate``).
+    NotImplemented = generic path."""
     if not (
-        speculate and e >= 1 and device is None
+        speculate and e >= 1
         and gf.degree in (8, 16)
         and rows[0].size >= _speculate_min_symbols(gf)
     ):
         return NotImplemented
     try:
         return _try_fused_single_row(
-            gf, k, nums, rows, Gb_inv, A, e, systematic, recurse
+            gf, k, nums, rows, Gb_inv, A, e, systematic, recurse,
+            device=device,
         )
     except ImportError:  # shim package unavailable: generic path
         return NotImplemented

@@ -475,6 +475,36 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "Staging-buffer acquisitions that allocated a fresh zeroed page",
         (),
     ),
+    # --- mesh dispatch tier (parallel/mesh.py; docs/design.md §13 owns
+    # the axis layout, tier decision table and donation-on-mesh rules)
+    "noise_ec_mesh_devices": (
+        "gauge",
+        "Devices the active codec mesh spans (1 = single-device tier; "
+        "the power-of-two floor of the router's device list when the "
+        "mesh dispatch tier is enabled)",
+        (),
+    ),
+    "noise_ec_mesh_sharded_dispatches_total": (
+        "counter",
+        "Batched codec dispatches sharded over the stripes mesh axis, "
+        "labeled by tier (shard_map = manual-SPMD Pallas words pipeline, "
+        "pjit = GSPMD-partitioned XLA planes pipeline)",
+        ("mode",),
+    ),
+    "noise_ec_mesh_shard_bytes": (
+        "histogram",
+        "Per-device payload bytes of each mesh-sharded dispatch (total "
+        "batch bytes over the mesh width)",
+        (),
+    ),
+    "noise_ec_mesh_reshard_total": (
+        "counter",
+        "Committed device inputs that arrived at a mesh program with a "
+        "different sharding than its pinned in_shardings (a resharding "
+        "transfer; stays 0 on chained encode->decode paths whose "
+        "out_shardings match the next stage)",
+        (),
+    ),
     # --- backpressure (ops/dispatch.py device gate, host/transport.py
     # dispatcher; docs/fleet.md owns the propagation story)
     "noise_ec_backpressure_waits_total": (
@@ -560,6 +590,8 @@ _HISTOGRAM_BUCKETS: dict[str, tuple[float, ...]] = {
     "noise_ec_coalesce_batch_size": (
         1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0, 48.0, 64.0,
     ),
+    # Payload bytes per device per sharded dispatch.
+    "noise_ec_mesh_shard_bytes": SIZE_BUCKETS,
 }
 
 

@@ -261,7 +261,17 @@ def coalesce_cutoff_bytes() -> int:
         import jax
 
         if jax.default_backend() in ("tpu", "gpu"):
-            return 8 << 20
+            base = 8 << 20
+            # Mesh dispatch tier (parallel/mesh.py): with N chips the
+            # batch SHARDS, so per-chip payload is nbytes/N — batching
+            # keeps amortizing N× further up the payload scale before a
+            # member becomes compute-bound on its own chip.
+            from noise_ec_tpu.parallel.mesh import mesh_router
+
+            router = mesh_router()
+            if router.enabled:
+                base *= router.n_pow2
+            return base
     except Exception:  # noqa: BLE001 — no jax, host regime
         pass
     return 128 << 10

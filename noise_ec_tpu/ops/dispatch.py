@@ -937,6 +937,21 @@ class DeviceCodec:
         with device_gate(), device_op(entry, key, nbytes=nbytes) as dt:
             if self.kernel != "xla" and self.gf.degree == 8:
                 return self._stripes_many_words(M, Ds, B_pad, dt)
+            # Mesh dispatch tier (parallel/mesh.py, docs/design.md §13):
+            # the batch dimension shards over the "stripes" axis of all
+            # visible chips — the XLA kernel on the pjit tier, the baked
+            # wide field on the byte-sliced words tier. Same gate slot,
+            # telemetry window and breaker wrapping as the single-device
+            # routes (a mesh fault fans out through the callers' own
+            # fallback arms like any other dispatch error).
+            from noise_ec_tpu.parallel.mesh import mesh_router
+
+            router = mesh_router()
+            if router.should_shard(B_pad):
+                if self.kernel == "xla":
+                    return router.matmul_sym_many(self, M, Ds, B_pad)
+                if self.gf.degree == 16 and self.route_for(M) != "mxu":
+                    return router.matmul_bytesliced_many(self, M, Ds, B_pad)
             pad = (
                 [np.empty((k, (B_pad - B) * S), dtype=self.gf.dtype)]
                 if B_pad != B else []
@@ -1134,6 +1149,24 @@ class DeviceCodec:
 
     def _matmul_words_batch_dispatch(self, M: np.ndarray, words: jnp.ndarray,
                                      dt, donate: bool = False) -> jnp.ndarray:
+        # Mesh dispatch tier (parallel/mesh.py, docs/design.md §13): a
+        # real batch on the baked GF(2^8) route shards its batch axis
+        # over the "stripes" mesh axis — ONE shard_map program of the
+        # same vmapped fused pipeline, donate_argnums preserved
+        # per-shard. The router's compile helper quantizes to the
+        # power-of-two ladder, so program count stays bounded. Roofline
+        # analysis is skipped here (the mesh families carry their own
+        # dispatch/shard-bytes telemetry).
+        if words.shape[0] > 1 and self.gf.degree == 8 and (
+            self.route_for(M) != "mxu"
+        ):
+            from noise_ec_tpu.parallel.mesh import mesh_router
+
+            router = mesh_router()
+            if router.should_shard(words.shape[0]):
+                return router.matmul_words_batch(
+                    self, M, words, donate=donate
+                )
         TW = words.shape[2]
         TWp = pad_words(TW) if self.gf.degree == 8 else pad_words16(TW)
         if self.gf.degree == 8 and self.route_for(M) == "mxu":

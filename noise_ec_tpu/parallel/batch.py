@@ -29,29 +29,15 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # JAX >= 0.4.35 exposes shard_map at top level
-    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
-except ImportError:  # pragma: no cover - version compat
-    from jax.experimental.shard_map import shard_map as _shard_map
-
 from noise_ec_tpu.gf.bitmatrix import expand_generator_masks_cached
 from noise_ec_tpu.gf.field import GF, GF256, GF65536
 from noise_ec_tpu.matrix.generators import generator_matrix
 from noise_ec_tpu.matrix.linalg import reconstruction_matrix
 from noise_ec_tpu.ops.bitops import pack_bitplanes_jax, unpack_bitplanes_jax
 from noise_ec_tpu.ops.gf2mm import gf2_matmul_jax
+from noise_ec_tpu.parallel.mesh import _shard_map_compat, mesh_router
 
 _FIELDS = {"gf256": GF256, "gf65536": GF65536}
-
-
-def _shard_map_compat(f, mesh, in_specs, out_specs):
-    """shard_map across JAX versions (check_rep -> check_vma rename)."""
-    try:
-        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                          check_vma=False)
-    except TypeError:  # pragma: no cover - older JAX
-        return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                          check_rep=False)
 
 
 def _fold_matmul(masks: jnp.ndarray, shards: jnp.ndarray, degree: int,
@@ -106,9 +92,21 @@ class BatchCodec:
     # -- single-device batched ops ----------------------------------------
 
     def matmul_batch(self, M: np.ndarray, batch: jnp.ndarray) -> jnp.ndarray:
-        """(R, k) GF matrix x (B, k, S) -> (B, R, S), one fused device call."""
+        """(R, k) GF matrix x (B, k, S) -> (B, R, S), one fused device call.
+
+        When the mesh dispatch tier is active (parallel/mesh.py), the
+        batch axis shards over the "stripes" mesh axis instead — the
+        pjit tier with the mask matrix replicated — so encode_batch AND
+        reconstruct_batch (both delegate here) ride all visible chips.
+        """
         M = np.ascontiguousarray(np.asarray(M, dtype=self.gf.dtype))
-        masks = jnp.asarray(self._masks(M))
+        masks_np = self._masks(M)
+        router = mesh_router()
+        if router.should_shard(int(batch.shape[0])):
+            return router.matmul_sym_batch(
+                self.gf.degree, M.shape[0], masks_np, jnp.asarray(batch)
+            )
+        masks = jnp.asarray(masks_np)
         return _jit_fold_matmul(self.gf.degree, M.shape[0])(masks, batch)
 
     def encode_batch(self, batch: jnp.ndarray) -> jnp.ndarray:

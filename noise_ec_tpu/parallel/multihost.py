@@ -44,6 +44,13 @@ def initialize(coordinator_address: str, num_processes: int,
         num_processes=num_processes,
         process_id=process_id,
     )
+    # The mesh dispatch tier (parallel/mesh.py) caches its router over
+    # the device list seen at first use; joining the distributed runtime
+    # replaces that list with the GLOBAL one, so drop the router and let
+    # the next dispatch rebuild over every process's chips.
+    from noise_ec_tpu.parallel.mesh import reset_mesh_router
+
+    reset_mesh_router()
 
 
 def global_mesh(axis_names: Sequence[str],

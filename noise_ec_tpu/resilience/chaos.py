@@ -367,6 +367,20 @@ class ChaosProxy:
         """Relative (schedule) time."""
         return self._loop.time() - self._epoch
 
+    def rebase_clock(self) -> None:
+        """Re-anchor the schedule clock at NOW: ``reset@T`` /
+        ``partition@T`` fire T seconds from this call instead of from
+        :meth:`start`. Harnesses call it once their peers have
+        REGISTERED, so scheduled chaos always hits a live connection —
+        on a loaded box, registration (dial + handshake + gossip) can
+        take longer than the first scheduled event, which then aborts
+        zero connections and the run never exercises the fault it was
+        scored on (the chaos-soak transport-timing flake). Already-fired
+        resets are re-armed; ``loop.time()`` is thread-safe, so no loop
+        hop is needed."""
+        self._epoch = self._loop.time()
+        self._fired_resets.clear()
+
     def _killed(self, now: float) -> bool:
         """One-shot kill windows plus expanded churn windows."""
         return self.profile.killed(now) or any(

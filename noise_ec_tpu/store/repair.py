@@ -109,7 +109,7 @@ class RepairEngine:
         network=None,
         *,
         batch_min: int = 2,
-        max_batch: int = 64,
+        max_batch: Optional[int] = None,
         linger_seconds: float = 0.05,
         fetch_interval_seconds: float = 30.0,
         respond_interval_seconds: float = 30.0,
@@ -120,6 +120,20 @@ class RepairEngine:
         self.store = store
         self.network = network
         self.batch_min = batch_min
+        if max_batch is None:
+            # A drain's group dispatch rides rs.matmul_many through the
+            # mesh dispatch tier (parallel/mesh.py): with N chips one
+            # batched reconstruct shards N ways, so a repair storm may
+            # drain N× wider per dispatch at the same per-chip load.
+            max_batch = 64
+            try:
+                from noise_ec_tpu.parallel.mesh import mesh_router
+
+                router = mesh_router()
+                if router.enabled:
+                    max_batch = min(64 * router.n_pow2, 512)
+            except Exception:  # noqa: BLE001 — no jax, host drain width
+                pass
         self.max_batch = max_batch
         self.linger_seconds = linger_seconds
         self.fetch_interval_seconds = fetch_interval_seconds

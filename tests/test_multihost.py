@@ -60,6 +60,14 @@ def _launch_workers(port: int) -> list[tuple[int, str, str]]:
     return results
 
 
+# Backend capability, not a code bug: XLA's CPU backend has no
+# multiprocess collective implementation, so the cross-process
+# all-gather this test exists for cannot run on a CPU-mesh rig. The
+# workers die with this exact runtime signature; anything else is a
+# real failure and must assert.
+_NO_MULTIPROCESS = "Multiprocess computations aren't implemented"
+
+
 def test_two_process_global_mesh_encode():
     # _free_port has an inherent close-to-rebind race; one retry with a
     # fresh port covers the rare case of the port being snatched between.
@@ -67,6 +75,11 @@ def test_two_process_global_mesh_encode():
         results = _launch_workers(_free_port())
         if all(rc == 0 for rc, _, _ in results):
             break
+        if any(_NO_MULTIPROCESS in err for _, _, err in results):
+            pytest.skip(
+                "backend lacks multiprocess collectives (CPU mesh rig); "
+                "the two-process DCN tier needs TPU/GPU hardware"
+            )
         if attempt == 1:
             # Collect BOTH stderrs before asserting: when one worker dies
             # at startup the other only shows a generic coordinator

@@ -386,6 +386,9 @@ def test_tcp_reconnect_after_forced_reset():
         while time.time() < deadline and (not b.peers or not a.peers):
             time.sleep(0.02)
         assert b.peers and a.peers
+        # Schedule the reset relative to registration (deflake: see the
+        # chaos-soak test's rebase_clock note).
+        proxy.rebase_clock()
         # Wait for the scheduled reset to drop the connection...
         deadline = time.time() + 10
         while time.time() < deadline and proxy.reset_count == 0:
@@ -544,6 +547,11 @@ def test_chaos_soak_eventual_delivery_and_health_flip():
         while time.time() < deadline and (not b.peers or not a.peers):
             time.sleep(0.02)
         assert b.peers and a.peers, (a.errors, b.errors)
+        # Anchor the chaos schedule on REGISTRATION, not proxy start: on
+        # a loaded box registration can outlast reset@0.6, which then
+        # aborts zero connections and the soak never exercises the
+        # reconnect it asserts on (the transport-timing flake).
+        proxy.rebase_clock()
 
         for i in range(200):
             payload = f"chaos soak msg {i:04d}!".encode()  # 20 B: k=5 stripes

@@ -20,7 +20,12 @@ north-star bar) — but until this tool nothing *noticed* when
   data-path rebuild made the number code-bound again, so the gate
   watches it;
 - checks the headline against the ``BASELINE.json`` north star
-  (``vs_baseline >= 1``) when a headline line is present.
+  (``vs_baseline >= 1``) when a headline line is present;
+- on fresh runs, flags ``batch_mesh_devices`` regressing back to 1 when
+  the recorded ``MULTICHIP_r*.json`` rounds prove the rig runs an
+  N-device mesh (:func:`mesh_rig_check` — the ISSUE-9 guard; the
+  ``batch_mesh_*`` sweep keys themselves ride the tight device
+  tolerance, the host-staged ``mesh_*`` stats the load-tail one).
 
 Modes:
 
@@ -62,9 +67,14 @@ DEFAULT_TOLERANCE = 0.10
 # Host-path stats ride a single shared core with measured 10-40% load
 # tails; a tight gate there would cry wolf every round.
 HOST_TOLERANCE = 0.35
+# "mesh_" covers the host-STAGED mesh stats (mesh_repair_gbps,
+# mesh_decode_corrupt_p50_ms: payloads cross the host boundary per
+# call, so load tails apply); the device-resident sweep keys are
+# "batch_mesh_*" and deliberately do NOT match — they ride the tight
+# device tolerance like every other slope-timed kernel stat.
 HOST_PREFIXES = (
     "host_node_", "decode_corrupt_", "cpu_shim_", "partition_recovery_",
-    "store_repair_", "object_", "fleet_",
+    "store_repair_", "object_", "fleet_", "mesh_",
 )
 
 
@@ -121,6 +131,46 @@ def compare(old: dict, new: dict) -> list[dict]:
             "regressed": bad > metric_tolerance(name),
         })
     return findings
+
+
+def newest_multichip_devices(repo: Path = REPO) -> int:
+    """n_devices of the newest green MULTICHIP_r*.json round (0 = no
+    recorded multichip capability)."""
+    best = 0
+    for path in sorted(repo.glob("MULTICHIP_r*.json")):
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, ValueError):
+            continue
+        if doc.get("ok") and not doc.get("skipped"):
+            best = int(doc.get("n_devices", 0))
+    return best
+
+
+def mesh_rig_check(stats: dict, repo: Path = REPO) -> list[str]:
+    """Flag ``batch_mesh_devices`` regressing back to 1 on a rig whose
+    recorded MULTICHIP rounds prove an N-device mesh runs there.
+
+    This is the guard ISSUE 9 exists for: rounds r02–r05 shipped
+    ``batch_mesh_devices: 1`` next to a green 8-device MULTICHIP file
+    and nothing noticed. Applied to FRESH runs only (main() skips it
+    for --current replays of recorded rounds, which genuinely carry the
+    old value)."""
+    rig = newest_multichip_devices(repo)
+    if rig <= 1:
+        return []
+    devices = stats.get("batch_mesh_devices")
+    try:
+        devices = int(devices)
+    except (TypeError, ValueError):
+        devices = 0
+    if devices > 1:
+        return []
+    return [
+        f"batch_mesh_devices is {devices or 'missing'} but the recorded "
+        f"MULTICHIP rounds show this rig runs a {rig}-device mesh — the "
+        "mesh dispatch tier regressed to single-device"
+    ]
 
 
 def north_star_check(stats: dict) -> list[str]:
@@ -365,6 +415,10 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     problems, findings = gate(against, current)
+    if not args.current:
+        # Fresh-run-only rig check (recorded rounds before the mesh tier
+        # genuinely carry batch_mesh_devices: 1; replays must stay green).
+        problems.extend(mesh_rig_check(current))
     if args.json:
         print(json.dumps(
             {"against": against_name, "findings": findings,

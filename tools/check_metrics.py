@@ -163,6 +163,7 @@ def check() -> list[str]:
     problems.extend(check_object_docs())
     problems.extend(check_fleet_docs())
     problems.extend(check_datapath_docs())
+    problems.extend(check_mesh_docs())
     return problems
 
 
@@ -348,6 +349,47 @@ def check_datapath_docs() -> list[str]:
     problems.extend(
         f"data-path surface {tok} is not documented in docs/design.md"
         for tok in DATAPATH_DOC_TOKENS
+        if tok not in text
+    )
+    return problems
+
+
+# The mesh dispatch tier (docs/design.md §13 owns the axis layout, the
+# shard_map-vs-pjit decision table and the donation-on-mesh rules the
+# noise_ec_mesh_* families instrument): its families must be documented
+# there as well as in the observability registry table, plus the
+# surfaces that exist only as identifiers in the code.
+MESH_PREFIXES = ("noise_ec_mesh_",)
+MESH_DOC_TOKENS = (
+    "MeshRouter",
+    "configure_mesh_router",
+    "shard_map",
+    "pjit",
+    "in_shardings",
+    "out_shardings",
+)
+
+
+def check_mesh_docs() -> list[str]:
+    """Mesh-tier families + surfaces vs docs/design.md §13."""
+    from noise_ec_tpu.obs.registry import METRICS
+
+    doc_path = REPO / "docs" / "design.md"
+    names = [n for n in METRICS if n.startswith(MESH_PREFIXES)]
+    if not names:
+        return []
+    if not doc_path.exists():
+        return [f"docs file {doc_path} missing (mesh metrics exist)"]
+    text = doc_path.read_text(encoding="utf-8")
+    problems = [
+        f"mesh metric {n!r} is not documented in docs/design.md "
+        "(mesh dispatch tier section)"
+        for n in names
+        if n not in text
+    ]
+    problems.extend(
+        f"mesh surface {tok} is not documented in docs/design.md"
+        for tok in MESH_DOC_TOKENS
         if tok not in text
     )
     return problems
