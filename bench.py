@@ -918,14 +918,20 @@ def main() -> None:
         except SmokeMismatch:
             raise
         except Exception as exc:  # noqa: BLE001 — secondary stat only
+            t_d1 = None
             stats["decode_corrupt_device_error"] = str(exc)[:80]
 
-        # --- config 3: high-rate RS(17,3) and wide RS(50,20) streaming
-        # encode (HBM-resident chunked stream, stripe axis folded). Each
-        # geometry gets its own correctness smoke: wide codes exercise
-        # different kernel tile brackets than RS(10,4) (a pack/unpack tile
-        # mismatch once corrupted exactly these shapes).
-        for (k3, r3) in ((17, 3), (50, 20)):
+        # --- config 3: high-rate RS(17,3), wide RS(50,20) and
+        # archival-grade RS(100,30) streaming encode (HBM-resident
+        # chunked stream, stripe axis folded). Each geometry gets its
+        # own correctness smoke: wide codes exercise different kernel
+        # tile brackets than RS(10,4) (a pack/unpack tile mismatch once
+        # corrupted exactly these shapes). RS(100,30) rides the
+        # block-panel K-tiled tier (ops/pallas_gf2mm "panel tier") —
+        # its XOR network is past the whole-plane budget — so this key
+        # is the wide-geometry sweep's mid point between RS(50,20)
+        # (whole-plane) and RS(200,56) (the widest panel geometry).
+        for (k3, r3) in ((17, 3), (50, 20), (100, 30)):
             G3 = generator_matrix(gf, k3, k3 + r3, "cauchy")
             sm3 = rng.integers(0, 256, size=(k3, 8192)).astype(np.uint8)
             check_smoke(
@@ -953,14 +959,19 @@ def main() -> None:
             )
             stats[f"rs{k3}_{r3}_encode_gbps"] = round(k3 * S3 * 4 / t3 / 1e9, 2)
 
-        # --- config 3b (round 5): near-field-limit RS(200,56) — routed to
-        # the dense MXU kernel (the XOR-network family cannot plan or
-        # compile ~361k XORs; dispatch.route_for). MACs/byte scale with r
-        # (64*56 = 3584), so the int8 roofline is ~110 GB/s; the (448,
-        # 1600) operand fills the MXU tiles (~84% vs RS(50,20)'s 49%).
+        # --- config 3b (round 5, re-tiered in round 6): near-field-limit
+        # RS(200,56) — the block-panel K-tiled VPU tier (its ~361k-XOR
+        # network could not plan on the whole-plane kernels and the MXU's
+        # int8 roofline at r=56 is only ~110 GB/s; panels Paar-factor in
+        # seconds to ~132k ops and VMEM per grid step is panel-sized).
+        # dispatch.route_for routes it; a Mosaic compile-probe failure
+        # demotes back to the MXU route, so the stat degrades instead of
+        # erroring. The per-tile attribution is in the
+        # noise_ec_kernel_tile_* families / the device_tile_* summary.
         try:
             kN, rN = 200, 56
             GN = generator_matrix(gf, kN, kN + rN, "cauchy")
+            stats["rs200_56_route"] = dev._route_plan(GN[kN:])[0]
             smN = rng.integers(0, 256, size=(kN, 4096)).astype(np.uint8)
             check_smoke(
                 np.array_equal(
@@ -977,6 +988,42 @@ def main() -> None:
                 lambda s: dev.matmul_words(GN[kN:], s), wN, n_hi=60
             )
             stats["rs200_56_encode_gbps"] = round(kN * SN * 4 / tN / 1e9, 2)
+
+            # Corrupted-share decode at the same geometry: the decode1
+            # fold (corrected row + consistency rows as ONE (56, 256)
+            # generator-shaped matmul — matrix/bw.py contract) whose
+            # expanded network also rides the panel tier. p50 of 9
+            # wall-clock rounds on a 16 MiB device-resident codeword,
+            # one whole data share corrupted.
+            from noise_ec_tpu.matrix.linalg import gf_inv as _gfiN
+
+            AN = gf.matmul(
+                GN[kN:].astype(np.int64),
+                _gfiN(gf, GN[:kN]).astype(np.int64),
+            ).astype(np.uint8)
+            SNd = 64 << 10  # bytes/shard: 256 rows -> 16 MiB codeword
+            dataN = rng.integers(0, 256, size=(kN, SNd)).astype(np.uint8)
+            parityN = np.asarray(dev.matmul_stripes(GN[kN:], dataN))
+            cwN = np.concatenate([dataN, parityN], axis=0)
+            cwN[1] ^= 0xA5  # whole-share corruption of data share 1
+            wNd = jnp.asarray(np.ascontiguousarray(cwN).view("<u4"))
+            cN, bN = dev.decode1_words(AN, 1, wNd)
+            check_smoke(
+                np.array_equal(
+                    np.asarray(cN)[None].view(np.uint8)[0], dataN[1]
+                )
+                and not np.asarray(bN).any(),
+                "RS(200,56) decode1 != corrupted row truth",
+            )
+            tsN = []
+            for _ in range(9):
+                t0 = time.perf_counter()
+                cN, bN = dev.decode1_words(AN, 1, wNd)
+                np.asarray(cN), np.asarray(bN)
+                tsN.append(time.perf_counter() - t0)
+            stats["rs200_56_decode_corrupt_p50_ms"] = round(
+                sorted(tsN)[4] * 1e3, 3
+            )
         except SmokeMismatch:
             raise
         except Exception as exc:  # noqa: BLE001 — secondary stat only
@@ -1023,6 +1070,58 @@ def main() -> None:
             stats["rs10_4_gf65536_encode_gbps"] = round(
                 2 * k * TW8 * 4 / t16 / 1e9, 2
             )
+
+            # --- wide-field decode parity: GF(2^16) corrupted-share
+            # decode on the PACKED byte-sliced layout
+            # (decode1_words_bytesliced — both byte planes of a symbol
+            # adjacent in one (2m, TW8) panel, so the decode rides the
+            # same 3-round m=8 kernel tier as GF(2^8) instead of the
+            # 4-round 16-plane expansion) vs the GF(2^8) device decode
+            # above, SAME data volume (14 MiB codeword, 1 MiB shards).
+            # The ratio is the bench-gated contract (downward-only:
+            # lower is better, 1.0 = field-blind decode).
+            from noise_ec_tpu.matrix.linalg import gf_inv as _gfi16
+            from noise_ec_tpu.ops.pallas_pack import (
+                pack_u16_bytesliced as _p16,
+            )
+
+            data16 = rng.integers(
+                0, 1 << 16, size=(k, (1 << 20) // 2)
+            ).astype(np.uint16)  # 1 MiB shards
+            cw16 = np.asarray(
+                GoldenCodec(k, k + r, field="gf65536").encode_all(data16)
+            )
+            cw16[1] ^= 0xA5A5  # whole-share corruption of data share 1
+            A16 = gf16.matmul(
+                G16[k:].astype(np.int64),
+                _gfi16(gf16, G16[:k]).astype(np.int64),
+            ).astype(np.uint16)
+            w16d = jnp.asarray(
+                np.ascontiguousarray(_p16(cw16)).view("<u4")
+            )  # (2m, TW8) packed byte-sliced words
+            c16, b16 = dev16.decode1_words_bytesliced(A16, 1, w16d)
+            got16 = np.ascontiguousarray(
+                np.asarray(c16).view(np.uint8).reshape(2, -1)
+                .transpose(1, 0)
+            ).view("<u2").reshape(-1)
+            check_smoke(
+                np.array_equal(got16, data16[1])
+                and not np.asarray(b16).any(),
+                "GF(2^16) byte-sliced decode1 != corrupted row truth",
+            )
+            t16d = chained_seconds_per_iter(
+                lambda s: (lambda c, b: c[0][:128] ^ b[:128])(
+                    *dev16.decode1_words_bytesliced(A16, 1, s)
+                ),
+                w16d,
+            )
+            stats["decode_corrupt_device_gf65536_ms"] = round(
+                t16d * 1e3, 3
+            )
+            if t_d1:
+                stats["gf65536_vs_gf256_decode_ratio"] = round(
+                    t16d / t_d1, 3
+                )
         except Exception as exc:  # noqa: BLE001 — secondary stat only
             stats["rs10_4_gf65536_error"] = str(exc)[:80]
 
@@ -1058,10 +1157,11 @@ def main() -> None:
     # artifact so the recorded trajectory carries them too (bench_gate
     # skips them: they describe the run, not the perf contract).
     try:
-        from noise_ec_tpu.obs.device import roofline_summary
+        from noise_ec_tpu.obs.device import roofline_summary, tile_summary
         from noise_ec_tpu.obs.registry import default_registry
 
         stats.update(roofline_summary())
+        stats.update(tile_summary())
         compiles = default_registry().counter("noise_ec_jit_compiles_total")
         total_compiles = sum(c.value for _, c in compiles.children())
         if total_compiles:

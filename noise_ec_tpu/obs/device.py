@@ -59,10 +59,13 @@ __all__ = [
     "install_hbm_gauges",
     "maybe_analyze_program",
     "peak_hbm_gbps",
+    "record_tile_dispatch",
     "reset_dispatch_tracking",
     "roofline_summary",
     "set_analysis_interval",
     "set_peak_hbm_gbps",
+    "tile_achieved_gbps",
+    "tile_summary",
 ]
 
 log = logging.getLogger("noise_ec_tpu.obs")
@@ -129,6 +132,7 @@ def reset_dispatch_tracking() -> None:
     with _lock:
         _seen_keys.clear()
         _op_stats.clear()
+        _tile_stats.clear()
         _last_analysis.clear()
 
 
@@ -137,10 +141,18 @@ class DeviceOpTimer:
 
     Class-based context manager for the same reason Span is: the
     generator machinery costs ~3x on a path measured in microseconds.
+
+    ``tile`` is the per-dispatch tile-config attribution hook: a
+    dispatch that runs a block-panel kernel sets it to the plan's
+    ``tile_label`` (e.g. ``kb128_rb32_tl512``) before the window
+    closes, and the exit path feeds the ``noise_ec_kernel_tile_*``
+    families — so the roofline gain (or loss) of an auto-tuned tile
+    triple is attributable per config, not hidden in the aggregate
+    kernel series.
     """
 
     __slots__ = ("entry", "key", "nbytes", "registry", "route", "elapsed",
-                 "_t0")
+                 "tile", "_t0")
 
     def __init__(self, entry: str, key: bytes, nbytes: int,
                  registry: Optional[Registry]):
@@ -150,6 +162,7 @@ class DeviceOpTimer:
         self.registry = registry
         self.route = ""
         self.elapsed = 0.0
+        self.tile = ""
 
     def __enter__(self) -> "DeviceOpTimer":
         with _lock:
@@ -196,6 +209,11 @@ class DeviceOpTimer:
                     _install_utilization_gauge(self.entry, reg)
                 st[0] += self.nbytes
                 st[1] += self.elapsed
+        if self.tile:
+            record_tile_dispatch(
+                self.entry, self.tile, self.nbytes, self.elapsed,
+                route=self.route, registry=reg,
+            )
         return False
 
     def _record_compile(self, reg: Optional[Registry]) -> None:
@@ -256,6 +274,95 @@ def _install_utilization_gauge(entry: str,
         )
     except Exception:  # noqa: BLE001 — a gauge must not fail a dispatch
         log.debug("roofline gauge install failed for %s", entry)
+
+
+# -------------------------------------------------- per-tile attribution
+#
+# The block-panel kernels are auto-tuned: the planner picks a
+# (KB, RB, TL) tile triple per geometry from the VMEM cost model, and
+# the triple is part of the dispatch cache key — but a cache key is
+# invisible on /metrics. These families make the chosen config a LABEL,
+# so "did the auto-tuner's pick actually deliver" is answerable per tile
+# config: dispatch/byte counters plus an achieved-bandwidth-over-peak
+# utilization gauge per (kernel entry, tile), the tile-resolved view of
+# noise_ec_roofline_utilization.
+
+# (entry, tile) -> [execute_bytes_total, execute_seconds_total]
+_tile_stats: dict[tuple[str, str], list] = {}
+_tile_children: dict[tuple[str, str], tuple] = {}
+
+
+def tile_achieved_gbps(entry: str, tile: str) -> float:
+    """Cumulative execute-route payload bandwidth for one (kernel
+    entry, tile config) pair (0.0 until a warm dispatch lands)."""
+    with _lock:
+        st = _tile_stats.get((entry, tile))
+    if not st or st[1] <= 0:
+        return 0.0
+    return st[0] / st[1] / 1e9
+
+
+def record_tile_dispatch(entry: str, tile: str, nbytes: int,
+                         seconds: float, *, route: str = "execute",
+                         registry: Optional[Registry] = None) -> None:
+    """Attribute one dispatch to its tile config (module comment).
+    Compile-route dispatches count calls/bytes but stay out of the
+    bandwidth stats — a first-call trace+compile is not kernel time."""
+    if registry is None:
+        pair = _tile_children.get((entry, tile))
+        if pair is None:
+            r = default_registry()
+            pair = _tile_children[(entry, tile)] = (
+                r.counter("noise_ec_kernel_tile_dispatches_total").labels(
+                    entry=entry, tile=tile
+                ),
+                r.counter("noise_ec_kernel_tile_bytes_total").labels(
+                    entry=entry, tile=tile
+                ),
+            )
+    else:
+        pair = (
+            registry.counter(
+                "noise_ec_kernel_tile_dispatches_total"
+            ).labels(entry=entry, tile=tile),
+            registry.counter(
+                "noise_ec_kernel_tile_bytes_total"
+            ).labels(entry=entry, tile=tile),
+        )
+    pair[0].add(1)
+    pair[1].add(nbytes)
+    if route != "execute":
+        return
+    reg = registry if registry is not None else default_registry()
+    with _lock:
+        st = _tile_stats.get((entry, tile))
+        fresh = st is None
+        if fresh:
+            st = _tile_stats[(entry, tile)] = [0.0, 0.0]
+        st[0] += nbytes
+        st[1] += seconds
+    if fresh:
+        try:
+            reg.gauge("noise_ec_kernel_tile_utilization").set_callback(
+                lambda e=entry, t=tile: (
+                    tile_achieved_gbps(e, t) / max(peak_hbm_gbps(), 1e-9)
+                ),
+                entry=entry, tile=tile,
+            )
+        except Exception:  # noqa: BLE001 — telemetry must not raise
+            log.debug("tile gauge install failed for %s/%s", entry, tile)
+
+
+def tile_summary() -> dict:
+    """Flat per-(entry, tile) achieved GB/s for bench/report output."""
+    out: dict = {}
+    with _lock:
+        keys = list(_tile_stats)
+    for entry, tile in keys:
+        a = tile_achieved_gbps(entry, tile)
+        if a > 0:
+            out[f"device_tile_{entry}_{tile}_gbps"] = round(a, 2)
+    return out
 
 
 # Dispatch-time analysis rate limit: the AOT lower walk is cheap for a

@@ -322,6 +322,27 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "form of the record_kernel counter bag)",
         ("entry",),
     ),
+    "noise_ec_kernel_tile_dispatches_total": (
+        "counter",
+        "Block-panel kernel dispatches per (entry, tile config) — tile "
+        "is the auto-tuner's kbKB_rbRB_tlTL triple, so a plan change is "
+        "a visible label split, not a silent re-route",
+        ("entry", "tile"),
+    ),
+    "noise_ec_kernel_tile_bytes_total": (
+        "counter",
+        "Payload bytes dispatched per (entry, tile config) on the "
+        "block-panel kernels",
+        ("entry", "tile"),
+    ),
+    "noise_ec_kernel_tile_utilization": (
+        "gauge",
+        "Achieved execute-route payload bandwidth over the device peak "
+        "(0..1) per (entry, tile config) — the tile-resolved view of "
+        "noise_ec_roofline_utilization that attributes a wide-geometry "
+        "gain to the panel plan that produced it",
+        ("entry", "tile"),
+    ),
     "noise_ec_kernel_bytes_total": (
         "counter",
         "Payload bytes moved per device-kernel entry point (the registry "

@@ -37,8 +37,11 @@ from noise_ec_tpu.gf.field import GF, GF256, GF65536
 from noise_ec_tpu.ops.bitops import pack_bitplanes_jax, unpack_bitplanes_jax
 from noise_ec_tpu.ops.gf2mm import gf2_matmul_jax
 from noise_ec_tpu.ops.pallas_gf2mm import (
+    PANEL_XOR_BUDGET,
     bits_to_rows,
+    gf2_matmul_pallas_panel_rows,
     gf2_matmul_pallas_sparse_rows,
+    panel_plan,
     planes_to_tiled,
     tiled_to_planes,
 )
@@ -581,18 +584,104 @@ def _fused_words16_fn(r: int, bits_rows: tuple, interpret: bool,
                       donate)
 
 
-# Baked XOR-network kernels scale with the generator's set-bit count:
-# Mosaic program size is O(XORs) and Paar factoring is super-linear in
-# terms, so near-field-limit geometries (k -> 256 is first-class contract,
-# main.go:248; RS(200,56) expands to ~350k raw XORs) must not even attempt
-# them — factoring alone ran >9 min there. Above this raw-XOR budget the
-# dense MXU bit-plane kernel (ops/mxu_gf2.py) runs the product instead:
-# fixed 64*r*k int8 MACs per byte on the systolic array, no per-geometry
-# network to plan or compile, and MXU utilization *improves* with size
-# (the (8r, 8k) operand at k=200 fills the 128x128 array; the RS(50,20)
-# measurement's 49% tile-padding floor does not apply). RS(50,20)
-# (~32k raw XORs, the widest code the VPU network wins) stays baked.
+# ----------------------------------------------------- panel words tier
+
+
+def _panel_words_pipeline(r_rows: int, m: int, bits_rows: tuple,
+                          plan: tuple, interpret: bool):
+    """Wide-geometry words pipeline: row-blocked lane pack -> block-panel
+    K-tiled matmul -> row-blocked unpack. Same layout contract as
+    _fused_words_pipeline (pack and unpack share one TL by construction
+    — pallas_pack.PACK_ROW_BLOCK), so the two tiers are byte-identical
+    and interchangeable per matrix."""
+    from noise_ec_tpu.ops.pallas_pack import (
+        pack_words_lanes_blocked,
+        unpack_words_lanes_blocked,
+    )
+
+    def f(words):
+        k, TW = words.shape
+        W8 = TW // (8 * m)
+        tiled = pack_words_lanes_blocked(words, m, interpret=interpret)
+        out = gf2_matmul_pallas_panel_rows(
+            bits_rows, tiled.reshape(k * m, 8, W8), plan=plan,
+            interpret=interpret,
+        )
+        return unpack_words_lanes_blocked(
+            out.reshape(r_rows, m, 8, W8), interpret=interpret
+        )
+
+    return f
+
+
+@functools.lru_cache(maxsize=128)
+def _panel_words_fn(r_rows: int, m: int, bits_rows: tuple, plan: tuple,
+                    interpret: bool, donate: bool = False):
+    """Jitted panel-tier words entry: (k, TW) u32 -> (r_rows, TW) u32
+    with the (KB, RB, TL) plan baked (the plan is part of the program —
+    and of the dispatch cache key, so a plan change is a visible
+    recompile, not a silent one)."""
+    return _jit_words(
+        _panel_words_pipeline(r_rows, m, bits_rows, plan, interpret),
+        donate,
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _panel_probe_compiles(bits_rows: tuple, C: int, plan: tuple) -> bool:
+    """AOT-compile two lane tiles of the panel matmul under ``plan``;
+    True iff Mosaic accepts it (same two-tile rationale as the fused
+    planner's probe: past two tiles VMEM pressure is grid-length
+    independent). A panel plan that fails the probe demotes the matrix
+    to the MXU route instead of failing the dispatch."""
+    KB, RB, TL, _cap = plan
+    try:
+        shape = jax.ShapeDtypeStruct((C, 8, 2 * TL), jnp.uint32)
+
+        def f(planes):
+            return gf2_matmul_pallas_panel_rows(
+                bits_rows, planes, plan=plan
+            )
+
+        jax.jit(f).lower(shape).compile()
+        return True
+    except Exception:  # noqa: BLE001 — any compile failure demotes
+        log.warning(
+            "panel plan %s failed to compile; demoting matrix to the "
+            "MXU route", plan,
+        )
+        return False
+
+
+def tile_label(plan: tuple) -> str:
+    """The (KB, RB, TL) triple as the `tile` label value of the
+    noise_ec_kernel_tile_* families (temp cap excluded: it is derived
+    from the triple and the label set must stay bounded)."""
+    return f"kb{plan[0]}_rb{plan[1]}_tl{plan[2]}"
+
+
+# Whole-plane baked XOR-network kernels scale with the generator's
+# set-bit count: Mosaic program size is O(XORs) and Paar factoring is
+# super-linear in terms, so geometries past this raw-XOR budget leave the
+# whole-plane kernels. They used to fall straight to the dense MXU
+# bit-plane kernel (ops/mxu_gf2.py: fixed 64*r int8 MACs per input byte —
+# a ~110 GB/s roofline at r=56, under half the ROADMAP bar); the
+# block-panel tier (pallas_gf2mm "panel tier") now sits between: Paar
+# factoring runs PER PANEL (seconds, not the >9 min a whole RS(200,56)
+# network costs) and VMEM per grid step is panel-sized, so the XOR
+# network stays on the VPU up to PANEL_XOR_BUDGET raw XORs. RS(50,20)
+# (~32k raw XORs, the widest code the single-step kernel wins) stays on
+# the whole-plane route.
 _BAKED_XOR_BUDGET = 60_000
+
+# The panel tier on the interpret kernel (CPU tests) shares the
+# whole-plane budget instead of PANEL_XOR_BUDGET: interpret mode exists
+# for correctness coverage, and tracing + XLA:CPU-compiling a
+# multi-hundred-k-op unrolled network takes minutes per geometry there
+# (measured ~220 s for RS(200,56)) — the MXU route is bit-exact and
+# cheap to build, so wide interpret runs use it. Tests that need the
+# panel kernels at interpret force them via the explicit plan override.
+_PANEL_XOR_BUDGET_INTERPRET = _BAKED_XOR_BUDGET
 
 # The baked pipeline's pack/unpack stages hold (rows, 8, 2*TL) u32 tiles in
 # VMEM regardless of the XOR cost, so a matrix with many INPUT or OUTPUT
@@ -688,25 +777,35 @@ class DeviceCodec:
         """(r, k) GF matrix -> (m*r, m*k) uint32 select-mask matrix, cached."""
         return expand_generator_masks_cached(self.gf, M)
 
+    def _panel_xor_budget(self) -> int:
+        """The raw-XOR ceiling of the panel tier for THIS codec's kernel
+        (module comment at _PANEL_XOR_BUDGET_INTERPRET: interpret mode
+        cannot afford multi-hundred-k-op unrolled programs)."""
+        if self.kernel == "pallas_interpret":
+            return _PANEL_XOR_BUDGET_INTERPRET
+        return PANEL_XOR_BUDGET
+
     def bits_rows_for(self, M: np.ndarray) -> tuple:
         """(r, k) GF matrix -> hashable per-row term tuples for the sparse
         kernel (cached).
 
         The shared choke point for EVERY baked-kernel entry (words,
-        planes, byte-sliced), so the PLANNING-TIME guard lives here: a
-        network past the XOR budget must never reach Paar factoring
-        (>9 min measured) or bake an unboundedly large program, through
-        any path. Only the XOR-cost bound applies at this level — the
-        row bound models the words entries' pack-stage VMEM, which the
-        planes entry never runs, so it is enforced by route_for at the
+        planes, byte-sliced, panel), so the PLANNING-TIME guard lives
+        here: a network past THIS KERNEL'S panel budget must never reach
+        Paar factoring (the panel tier factors per panel, but raw
+        expansion/term-listing of a truly huge network is itself wasted
+        work) or bake an unboundedly large program, through any path.
+        Only the XOR-cost bound applies at this level — the row bound
+        models the words entries' pack-stage VMEM, which the planes
+        entry never runs, so it is enforced by route_for at the
         words/stripes routing decision instead (a (3, 200)
         reconstruction matrix stays legal here for matmul_planes).
         matmul_stripes/matmul_words route over-budget matrices to the
         MXU before ever calling this; direct callers get the clear error.
         """
-        if self._xor_cost_for(M) > _BAKED_XOR_BUDGET:
+        if self._xor_cost_for(M) > self._panel_xor_budget():
             raise NotImplementedError(
-                "matrix exceeds the baked-kernel XOR budget; use "
+                "matrix exceeds the panel-tier XOR budget; use "
                 "matmul_stripes/matmul_words (gf256) or the byte-sliced "
                 "entries (gf65536) — the MXU route"
             )
@@ -735,23 +834,66 @@ class DeviceCodec:
         return hit
 
     def route_for(self, M: np.ndarray) -> str:
-        """Which kernel family runs this matrix: "baked" (planned
-        XOR-network VPU kernels) or "mxu" (dense int8 bit-plane matmul).
-        Exposed so tests can pin the near-field-limit fallback.
+        """Which kernel family runs this matrix: "baked" (whole-plane
+        XOR-network VPU kernels), "panel" (block-panel K-tiled VPU
+        kernels — wide geometries), or "mxu" (dense int8 bit-plane
+        matmul — past every XOR-network budget). Exposed so tests can
+        pin the tier decision; NO supported geometry raises here — the
+        old "must not even attempt" refusal became this routing.
 
-        The row bound counts the rows the BAKED PIPELINE runs: symbol
-        rows for gf256, 2x byte rows for the byte-sliced wide field —
-        one bound (_BAKED_MAX_ROWS) for the one pack stage both share.
-        Past either bound, both fields run the same MXU kernel (the bit
-        matrix is field-blind) via their routed entries.
+        The row bound counts the rows the WHOLE-PLANE pipeline runs:
+        symbol rows for gf256, 2x byte rows for the byte-sliced wide
+        field — one bound (_BAKED_MAX_ROWS) for the one pack stage both
+        share. Past the row bound OR the whole-plane XOR budget the
+        matrix moves to the panel tier (row-blocked pack, K-tiled
+        matmul — no whole-matrix VMEM residency, so no row bound), and
+        past this kernel's panel XOR budget to the MXU.
         """
         r, k = np.asarray(M).shape
         rows = 2 * max(r, k) if self.gf.degree == 16 else max(r, k)
-        if rows > _BAKED_MAX_ROWS:
+        cost = self._xor_cost_for(M)
+        if cost > self._panel_xor_budget():
             return "mxu"
-        if self._xor_cost_for(M) > _BAKED_XOR_BUDGET:
-            return "mxu"
+        if rows > _BAKED_MAX_ROWS or cost > _BAKED_XOR_BUDGET:
+            return "panel"
         return "baked"
+
+    def panel_plan_for(self, M: np.ndarray):
+        """The verified (KB, RB, TL, temp_cap) panel plan for a
+        panel-routed matrix, or None when no candidate compiles (the
+        dispatch then falls back to the MXU route — a Mosaic stack OOM
+        must demote, not fail the encode). Cached per matrix; the plan
+        triple joins the dispatch cache key and the
+        ``noise_ec_kernel_tile_*`` telemetry labels."""
+        bits_rows = self.bits_rows_for(M)
+        m = self.gf.degree
+        C = (2 * M.shape[1] * 8) if m == 16 else (M.shape[1] * 8)
+        plan = panel_plan(bits_rows, C)
+        if self.kernel == "pallas_interpret":
+            return plan  # no scoped-vmem limit to probe against
+        return plan if _panel_probe_compiles(bits_rows, C, plan) else None
+
+    def _route_plan(self, M: np.ndarray):
+        """(route, plan): the tier decision plus, for the panel tier,
+        the verified tile plan. A panel-routed matrix whose plan fails
+        the compile probe demotes to ("mxu", None) here — the one place
+        the demotion can happen, so every entry point agrees."""
+        route = self.route_for(M)
+        if route != "panel":
+            return route, None
+        plan = self.panel_plan_for(M)
+        return ("panel", plan) if plan is not None else ("mxu", None)
+
+    def _key_shape(self, M: np.ndarray, shape: tuple) -> tuple:
+        """Dispatch-cache key shape: panel-routed matrices append the
+        (KB, RB, TL) tile triple, so a plan change (auto-tuner update,
+        probe demotion) reads as a compile-route dispatch in the
+        telemetry instead of silently re-timing under the old key."""
+        if self.kernel != "xla":
+            route, plan = self._route_plan(M)
+            if route == "panel":
+                return shape + ("panel",) + plan[:3]
+        return shape
 
     def _m2_for_wide(self, M: np.ndarray):
         """Cached (16r, 16k) int8 bit expansion of a gf65536 matrix for
@@ -805,7 +947,7 @@ class DeviceCodec:
             raise ValueError(f"matrix cols {k} != stripe rows {D.shape[0]}")
         entry = f"matmul_stripes_{self.kernel}"
         record_kernel(entry, D.nbytes)
-        key = dispatch_key(entry, self.kernel, M, D.shape)
+        key = dispatch_key(entry, self.kernel, M, self._key_shape(M, D.shape))
         # Bounded device queue: admission BEFORE the telemetry window so
         # a gated wait reads as backpressure, not kernel latency.
         with device_gate(), device_op(entry, key, nbytes=D.nbytes) as dt:
@@ -830,27 +972,28 @@ class DeviceCodec:
             # not a read-only view of the device buffer.
             return np.array(out)
         if m == 16:
-            # BYTE-SLICED GF(2^16): each u16 symbol splits into (lo, hi)
-            # byte rows (2k rows of S bytes), and the device runs the
+            # PACKED BYTE-SLICED GF(2^16): each u16 symbol splits into
+            # ADJACENT (lo, hi) byte rows (the packed (2k, S) panel —
+            # pallas_pack.pack_u16_bytesliced), and the device runs the
             # GF(2^8)-shaped m=8 pipeline — the expanded bit matrix needs
             # NO permutation because the flat plane index is identical:
             # 16*j + b == (2*j + b//8)*8 + b%8. This trades two host
             # relayout passes for the 3-round delta-swap transpose
             # (vs 4 rounds for 16-plane groups) and the m=8 lane quantum.
-            Db = (
-                np.ascontiguousarray(D)
-                .view(np.uint8)
-                .reshape(k, S, 2)
-                .transpose(0, 2, 1)  # (k, 2, S): row 0 = lo bytes (LE)
-                .reshape(2 * k, S)
+            from noise_ec_tpu.ops.pallas_pack import (
+                pack_u16_bytesliced,
+                unpack_u16_bytesliced,
             )
-            out_b = self._bytesliced_words(M, Db, 2 * r)
-            return np.ascontiguousarray(
-                out_b.reshape(r, 2, S).transpose(0, 2, 1)
-            ).view("<u2").reshape(r, S)
-        if self.route_for(M) == "mxu":
-            # Near-field-limit geometries: dense MXU bit-plane product
-            # (no XOR network to plan/compile — see _BAKED_XOR_BUDGET).
+
+            out_b = self._bytesliced_words(
+                M, pack_u16_bytesliced(D), 2 * r, dt
+            )
+            return unpack_u16_bytesliced(out_b)
+        route, plan = self._route_plan(M)
+        if route == "mxu":
+            # Past every XOR-network budget (_BAKED_XOR_BUDGET /
+            # PANEL_XOR_BUDGET, or a panel plan the probe demoted):
+            # dense MXU bit-plane product.
             # Already charged to matmul_stripes_{kernel} above; a second
             # record here would double-count the traffic.
             return self._mxu_for().encode_stripes(M, D)
@@ -870,10 +1013,17 @@ class DeviceCodec:
         # This entry stages its own device array (device_put below), so
         # the input HBM is donated into the output: steady-state encode /
         # reconstruct reuses one allocation instead of growing two.
-        fn = _fused_words_fn(
-            r, self.bits_rows_for(M), self.kernel == "pallas_interpret",
-            True,
-        )
+        if route == "panel":
+            dt.tile = tile_label(plan)
+            fn = _panel_words_fn(
+                r, 8, self.bits_rows_for(M), plan,
+                self.kernel == "pallas_interpret", True,
+            )
+        else:
+            fn = _fused_words_fn(
+                r, self.bits_rows_for(M),
+                self.kernel == "pallas_interpret", True,
+            )
         words_dev = jax.device_put(words)
         if donation_supported():
             buffer_pool().donate(words_dev)
@@ -932,7 +1082,8 @@ class DeviceCodec:
         nbytes = sum(D.nbytes for D in Ds)
         record_kernel(entry, nbytes)
         key = dispatch_key(
-            entry, self.kernel, M, (B_pad,) + Ds[0].shape
+            entry, self.kernel, M,
+            self._key_shape(M, (B_pad,) + Ds[0].shape),
         )
         with device_gate(), device_op(entry, key, nbytes=nbytes) as dt:
             if self.kernel != "xla" and self.gf.degree == 8:
@@ -950,7 +1101,7 @@ class DeviceCodec:
             if router.should_shard(B_pad):
                 if self.kernel == "xla":
                     return router.matmul_sym_many(self, M, Ds, B_pad)
-                if self.gf.degree == 16 and self.route_for(M) != "mxu":
+                if self.gf.degree == 16 and self._route_plan(M)[0] != "mxu":
                     return router.matmul_bytesliced_many(self, M, Ds, B_pad)
             pad = (
                 [np.empty((k, (B_pad - B) * S), dtype=self.gf.dtype)]
@@ -1033,8 +1184,9 @@ class DeviceCodec:
         return corrected, bad
 
     def _bytesliced_words(self, M: np.ndarray, Db: np.ndarray,
-                          r2: int) -> np.ndarray:
-        """(2k, S) uint8 byte rows x the gf65536 matrix -> (2r, S) uint8.
+                          r2: int, dt=None) -> np.ndarray:
+        """(2k, S) uint8 packed byte rows x the gf65536 matrix ->
+        (2r, S) uint8.
 
         Runs the m=8 words pipeline over byte rows with the UNPERMUTED
         expanded GF(2^16) bits (see matmul_stripes).
@@ -1046,11 +1198,12 @@ class DeviceCodec:
             buf[:, :S] = Db
         else:
             buf = np.ascontiguousarray(Db)
-        if self.route_for(M) == "mxu":
-            # Near-field-limit wide-field matrices run the dense MXU
-            # kernel directly on the byte rows: the kernel is pure GF(2)
-            # and the UNPERMUTED (16r, 16k) expansion over 2k byte rows
-            # IS an (8R, 8K) bit matrix with R = 2r, K = 2k. Same route
+        route, plan = self._route_plan(M)
+        if route == "mxu":
+            # Over-budget wide-field matrices run the dense MXU kernel
+            # directly on the byte rows: the kernel is pure GF(2) and
+            # the UNPERMUTED (16r, 16k) expansion over 2k byte rows IS
+            # an (8R, 8K) bit matrix with R = 2r, K = 2k. Same route
             # gate as gf256 (route_for), closing the round-5 refusal gap.
             from noise_ec_tpu.ops.mxu_gf2 import mxu_encode_words_bits
 
@@ -1060,9 +1213,18 @@ class DeviceCodec:
                 interpret=self.kernel == "pallas_interpret",
             ))
             return out_w.view(np.uint8)[:, :S]
-        fn = _fused_words_fn(
-            r2, self.bits_rows_for(M), self.kernel == "pallas_interpret"
-        )
+        if route == "panel":
+            if dt is not None:
+                dt.tile = tile_label(plan)
+            fn = _panel_words_fn(
+                r2, 8, self.bits_rows_for(M), plan,
+                self.kernel == "pallas_interpret",
+            )
+        else:
+            fn = _fused_words_fn(
+                r2, self.bits_rows_for(M),
+                self.kernel == "pallas_interpret",
+            )
         out_w = np.array(fn(jnp.asarray(buf.view("<u4"))))
         return out_w.view(np.uint8)[:, :S]
 
@@ -1082,8 +1244,9 @@ class DeviceCodec:
         r2 = 2 * M.shape[0]
         TW = words.shape[1]
         TWp = pad_words(TW)
-        if self.route_for(M) == "mxu":
-            # Near-field-limit wide-field matrices: the dense MXU kernel
+        route, plan = self._route_plan(M)
+        if route == "mxu":
+            # Over-budget wide-field matrices: the dense MXU kernel
             # over the same byte rows (see _bytesliced_words).
             from noise_ec_tpu.ops.mxu_gf2 import mxu_encode_words_bits
 
@@ -1094,6 +1257,11 @@ class DeviceCodec:
                 k=2 * M.shape[1],
                 interpret=self.kernel == "pallas_interpret",
             )
+        elif route == "panel":
+            fn = _panel_words_fn(
+                r2, 8, self.bits_rows_for(M), plan,
+                self.kernel == "pallas_interpret",
+            )
         else:
             fn = _fused_words_fn(
                 r2, self.bits_rows_for(M), self.kernel == "pallas_interpret"
@@ -1101,6 +1269,33 @@ class DeviceCodec:
         if TWp != TW:
             return fn(jnp.pad(words, ((0, 0), (0, TWp - TW))))[:, :TW]
         return fn(words)
+
+    def decode1_words_bytesliced(
+        self, A: np.ndarray, j: int, rows_words: jnp.ndarray
+    ) -> tuple:
+        """Device-resident single-corrupt-row decode on the PACKED
+        byte-sliced GF(2^16) layout (the wide-field analogue of
+        :meth:`decode1_words`).
+
+        ``rows_words``: (2m, TW8) uint32 packed byte-sliced words of
+        all m received stripes (share i's lo-byte row at 2i, hi at
+        2i+1 — pallas_pack.words16_to_bytesliced). Returns
+        (corrected_lo_hi (2, TW8), verify_or (TW8,)): the corrected row
+        j as its two byte rows, and the OR-fold of every consistency
+        BYTE row — a nonzero byte defeats the single-support hypothesis
+        for that column exactly as in the gf256 entry (a u16 column is
+        bad iff either of its byte columns is). One generator-shaped
+        byte-sliced matmul, so GF(2^16) decode rides the same m=8
+        kernel tier (and panel route, when wide) as GF(2^8) instead of
+        the 4-round 16-plane expansion that doubled its round count.
+        """
+        D = self.decode1_matrix(A, j)  # raises for r2 < 2
+        out = self.matmul_words_bytesliced(D, rows_words)  # (2*r2, TW8)
+        corrected = out[:2]
+        bad = out[2]
+        for q in range(3, out.shape[0]):
+            bad = bad | out[q]
+        return corrected, bad
 
     def matmul_words(self, M: np.ndarray, words: jnp.ndarray) -> jnp.ndarray:
         """Device-resident words entry: (k, TW) uint32 -> (r, TW) uint32.
@@ -1140,7 +1335,10 @@ class DeviceCodec:
         # Async-entry caveat: this path returns a device array without
         # materializing, so the execute-route timing is the submit cost;
         # the compile route still times the synchronous trace+compile.
-        key = dispatch_key("matmul_words", self.kernel, M, tuple(words.shape))
+        key = dispatch_key(
+            "matmul_words", self.kernel, M,
+            self._key_shape(M, tuple(words.shape)),
+        )
         # Same bounded-queue admission as matmul_stripes (device gate).
         with device_gate(), device_op("matmul_words", key, nbytes=nbytes) as dt:
             return self._matmul_words_batch_dispatch(
@@ -1158,7 +1356,7 @@ class DeviceCodec:
         # analysis is skipped here (the mesh families carry their own
         # dispatch/shard-bytes telemetry).
         if words.shape[0] > 1 and self.gf.degree == 8 and (
-            self.route_for(M) != "mxu"
+            self._route_plan(M)[0] != "mxu"
         ):
             from noise_ec_tpu.parallel.mesh import mesh_router
 
@@ -1169,31 +1367,43 @@ class DeviceCodec:
                 )
         TW = words.shape[2]
         TWp = pad_words(TW) if self.gf.degree == 8 else pad_words16(TW)
-        if self.gf.degree == 8 and self.route_for(M) == "mxu":
-            # Near-field-limit geometries (see _BAKED_XOR_BUDGET): the
-            # dense MXU product, same words contract. WORD_QUANTUM is a
-            # multiple of the MXU lane tile, so the padding below fits
-            # both kernel families.
+        route, plan = self._route_plan(M)
+        if self.gf.degree == 8 and route == "mxu":
+            # Past every XOR-network budget (see _BAKED_XOR_BUDGET /
+            # PANEL_XOR_BUDGET): the dense MXU product, same words
+            # contract. WORD_QUANTUM is a multiple of the MXU lane
+            # tile, so the padding below fits both kernel families.
             mx = self._mxu_for()
             fn = functools.partial(mx.encode_words, M)
         else:
-            if self.gf.degree == 16 and self.route_for(M) == "mxu":
+            if self.gf.degree == 16 and route == "mxu":
                 # The MXU route consumes BYTE rows; this entry's
                 # interleaved-u16 layout has no kernel at this size.
                 raise NotImplementedError(
-                    "near-field-limit GF(2^16) matrices run the MXU route "
+                    "over-budget GF(2^16) matrices run the MXU route "
                     "on the byte-sliced entries (matmul_words_bytesliced "
                     "/ matmul_stripes), not the interleaved words entry"
                 )
-            mk = _fused_words_fn if self.gf.degree == 8 else _fused_words16_fn
             # Donation only on the single-object baked route: vmap wraps
             # the jit (donation would not thread through), and a padded
             # input is a fresh on-device copy anyway.
             donate = donate and words.shape[0] == 1 and TWp == TW
-            fn = mk(
-                M.shape[0], self.bits_rows_for(M),
-                self.kernel == "pallas_interpret", donate,
-            )
+            if route == "panel":
+                # Panel tier — the interleaved entry rides the m=16
+                # blocked pack; the packed byte-sliced entries stay the
+                # wide-field fast path (3 rounds, m=8 quantum).
+                dt.tile = tile_label(plan)
+                fn = _panel_words_fn(
+                    M.shape[0], self.gf.degree, self.bits_rows_for(M),
+                    plan, self.kernel == "pallas_interpret", donate,
+                )
+            else:
+                mk = (_fused_words_fn if self.gf.degree == 8
+                      else _fused_words16_fn)
+                fn = mk(
+                    M.shape[0], self.bits_rows_for(M),
+                    self.kernel == "pallas_interpret", donate,
+                )
         if TWp != TW:
             words = jnp.pad(words, ((0, 0), (0, 0), (0, TWp - TW)))
         if words.shape[0] == 1:
@@ -1229,9 +1439,24 @@ class DeviceCodec:
                     self._mask_dev_cache.clear()
                 self._mask_dev_cache[key] = dev
             return _gf2_matmul_jax_jit(dev, planes)
-        out = gf2_matmul_pallas_sparse_rows(
-            self.bits_rows_for(np.asarray(M)),
-            planes_to_tiled(planes),
-            interpret=self.kernel == "pallas_interpret",
-        )
+        M = np.asarray(M)
+        route, plan = self._route_plan(M)
+        if route == "mxu":
+            raise NotImplementedError(
+                "over-budget matrices have no planes-level XOR-network "
+                "kernel; use matmul_stripes/matmul_words (the MXU route)"
+            )
+        if route == "panel":
+            out = gf2_matmul_pallas_panel_rows(
+                self.bits_rows_for(M),
+                planes_to_tiled(planes),
+                plan=plan,
+                interpret=self.kernel == "pallas_interpret",
+            )
+        else:
+            out = gf2_matmul_pallas_sparse_rows(
+                self.bits_rows_for(M),
+                planes_to_tiled(planes),
+                interpret=self.kernel == "pallas_interpret",
+            )
         return tiled_to_planes(out, W)

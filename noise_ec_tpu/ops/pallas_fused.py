@@ -19,7 +19,10 @@ The layout contract is identical to the three-kernel path (the hot-path
 unit tests compare both against the golden codec), so DeviceCodec can pick
 whichever fits VMEM: the fused kernel needs in + out blocks (double-
 buffered) plus both plane scratches resident at once, so very wide codes
-fall back to the pipeline. Reference hot loop: /root/reference/main.go:262.
+fall back to the pipeline — and geometries past the whole-plane budgets
+leave this module entirely for the block-panel K-tiled tier
+(ops/pallas_gf2mm "panel tier", docs/design.md §14; dispatch.route_for
+owns the decision). Reference hot loop: /root/reference/main.go:262.
 """
 
 from __future__ import annotations
@@ -44,10 +47,12 @@ from noise_ec_tpu.ops.xor_factor import eval_bits_rows
 
 # 1 MiB tighter than pallas_gf2mm's VMEM_BUDGET_BYTES: the fused kernel
 # additionally keeps delta-swap pack/unpack temporaries on the Mosaic stack,
-# which the shared Paar-temp estimate does not cover. Calibration anchors:
-# GF(2^16) RS(10,4) at TL=512 OOMed at 17.97M scoped and must be REJECTED
-# (accounted 14.44M > 13M); GF(2^8) RS(50,20) at TL=128 compiled and must be
-# ACCEPTED (accounted 12.75M <= 13M).
+# which the shared Paar-temp estimate does not cover. Calibration anchors
+# (valid for WHOLE-PLANE kernels only — the panel tier counts its capped
+# per-panel temps at full size instead, pallas_gf2mm
+# PANEL_TEMP_ALIVE_FRACTION): GF(2^16) RS(10,4) at TL=512 OOMed at 17.97M
+# scoped and must be REJECTED (accounted 14.44M > 13M); GF(2^8) RS(50,20)
+# at TL=128 compiled and must be ACCEPTED (accounted 12.75M <= 13M).
 _FUSED_VMEM_BUDGET = 13 << 20
 
 

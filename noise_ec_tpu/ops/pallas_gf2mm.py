@@ -35,10 +35,25 @@ DEFAULT_TILE_LANES = 512
 VMEM_BUDGET_BYTES = 14 << 20
 # Paar temporaries ((8, TL) uint32 each) also live on the Mosaic stack.
 # Counting every temp at full size over-estimates (the allocator reuses
-# slots as liveness ends); 0.4 is calibrated against observed compiles:
-# RS(50,20) sparse at TL=256 OOMed at 24.7M scoped (must reject), the
-# fused RS(50,20) kernel at TL=128 compiled (must accept).
+# slots as liveness ends); 0.4 is calibrated against observed compiles
+# of WHOLE-PLANE kernels — grid steps that evaluate the full factored
+# network over all C input rows, where liveness windows are long enough
+# for the allocator to overlap ~60% of the temps (anchors: RS(50,20)
+# sparse at TL=256 OOMed at 24.7M scoped and must reject; the fused
+# RS(50,20) kernel at TL=128 compiled and must accept —
+# tests/test_panel.py pins both boundaries). It is NOT valid for the
+# block-panel tier: see PANEL_TEMP_ALIVE_FRACTION below.
 TEMP_ALIVE_FRACTION = 0.4
+
+# Panel-tier temp accounting. A panel kernel evaluates ONE small
+# sub-network per grid step inside a lax.switch branch; Mosaic's stack
+# overlap across branch boundaries is unmeasured, and the planner caps
+# the per-panel temp count explicitly (paar_factor max_temps derived
+# from VMEM headroom), so every temp is counted at FULL size — the cap,
+# not an overlap fraction, is what keeps the estimate honest. The
+# accept/reject boundary cases are pinned in tests/test_panel.py so the
+# estimator cannot silently OOM a launch.
+PANEL_TEMP_ALIVE_FRACTION = 1.0
 
 
 def xor_temp_bytes_per_lane(bits_rows: tuple, C: int) -> int:
@@ -245,6 +260,20 @@ def bits_to_rows(bits) -> tuple[tuple[int, ...], ...]:
     )
 
 
+def sparse_lane_tl(bits_rows: tuple, C: int, W8: int,
+                   tile_lanes: int = DEFAULT_TILE_LANES) -> int:
+    """The whole-plane sparse kernel's lane-tile choice: double-buffered
+    in+out bytes per lane of tile, plus the factored network's
+    temporaries (TEMP_ALIVE_FRACTION-scaled), capped to the VMEM
+    budget. Exposed so the calibration boundary tests can pin the
+    accept/reject edge without building a kernel."""
+    per_lane = (C + len(bits_rows)) * 8 * 4 * 2 + xor_temp_bytes_per_lane(
+        bits_rows, C
+    )
+    cap = max(128, VMEM_BUDGET_BYTES // per_lane // 128 * 128)
+    return min(tile_lanes, cap, max(128, -(-W8 // 128) * 128))
+
+
 def gf2_matmul_pallas_sparse_rows(
     bits_rows: tuple[tuple[int, ...], ...],  # STATIC: baked into the kernel
     tiled_planes: jnp.ndarray,  # (C, 8, W8) uint32
@@ -258,13 +287,7 @@ def gf2_matmul_pallas_sparse_rows(
     """
     C, sub, W8 = tiled_planes.shape
     assert sub == 8, tiled_planes.shape
-    # Double-buffered in+out bytes per lane of tile, plus the factored
-    # network's temporaries; cap TL to the budget.
-    per_lane = (C + len(bits_rows)) * sub * 4 * 2 + xor_temp_bytes_per_lane(
-        bits_rows, C
-    )
-    cap = max(128, VMEM_BUDGET_BYTES // per_lane // 128 * 128)
-    TL = min(tile_lanes, cap, max(128, -(-W8 // 128) * 128))
+    TL = sparse_lane_tl(bits_rows, C, W8, tile_lanes)
     W8p = -(-W8 // TL) * TL
     if W8p != W8:
         tiled_planes = jnp.pad(tiled_planes, ((0, 0), (0, 0), (0, W8p - W8)))
@@ -282,3 +305,227 @@ def gf2_matmul_pallas_sparse(
     return gf2_matmul_pallas_sparse_rows(
         bits_to_rows(bits), tiled_planes, tile_lanes=tile_lanes, interpret=interpret
     )
+
+
+# ---------------------------------------------------------------------------
+# Block-panel K-tiled kernel: the WIDE-GEOMETRY tier.
+#
+# The whole-plane kernels above grid only over the lane axis and keep all
+# C input plane-rows plus all R output rows resident in VMEM per grid
+# step, so wide codes must shrink the lane tile to dodge the VMEM ceiling
+# (RS(50,20) already forces TL=128) and near-field-limit codes
+# (RS(200,56): C=1600, R=448 plane rows) cannot fit at ANY tile. This
+# tier adds a K dimension (input-row axis) to the Pallas grid:
+#
+#   grid = (PR, NL, PK)          # R-blocks x lane tiles x K-blocks
+#   planes block (KB, 8, TL)     index (pr, i, pk) -> (pk, 0, i)
+#   out block    (RB, 8, TL)     index (pr, i, pk) -> (pr, 0, i)
+#
+# The out BlockSpec ignores the (innermost, fastest-varying) K axis, so
+# Pallas keeps the output tile VMEM-resident across the K steps and each
+# step XOR-accumulates its panel's partial into it — revision-safe via
+# @pl.when on the first K step (the MXU-matmul accumulator idiom), so no
+# garbage from a previous (pr, i) tile ever leaks in. VMEM per step is
+# (KB + RB) plane rows plus ONE panel's capped temporaries, independent
+# of C and R — which is what buys wide codes TL >= 256 instead of
+# falling off the route.
+#
+# Each (pr, pk) panel's sub-network is geometry-baked (Paar-factored
+# PER PANEL — xor_factor.split_bits_rows_panels; factoring whole
+# near-limit networks ran >9 min, panels factor in seconds) and selected
+# by lax.switch on the flattened panel id; the compiled program contains
+# every panel exactly once, the grid loop executes one per step.
+
+
+# A panel program's instruction count is O(total factored XORs) even
+# though each grid step runs one panel: every panel's branch is traced
+# into the switch. Past this raw-XOR budget the program is not worth
+# baking and the matrix routes to the dense MXU kernel instead (on the
+# interpret/CPU tier the budget is far lower — ops/dispatch.py).
+PANEL_XOR_BUDGET = 600_000
+
+
+def panel_vmem_bytes(KB: int, RB: int, TL: int, temps: int) -> int:
+    """VMEM bytes of one panel-kernel grid step: double-buffered input
+    panel, revisited output tile (counted twice — Pallas may overlap the
+    writeback of tile (pr, i) with the first K step of the next), and
+    one panel's temporaries at PANEL_TEMP_ALIVE_FRACTION (= full size;
+    the planner caps the count instead of guessing overlap)."""
+    blocks = (2 * KB + 2 * RB) * 8 * TL * 4
+    return blocks + int(temps * 8 * TL * 4 * PANEL_TEMP_ALIVE_FRACTION)
+
+
+def panel_temp_cap(KB: int, RB: int, TL: int) -> int:
+    """Largest per-panel temp count whose working set still fits the
+    VMEM budget at (KB, RB, TL) — the max_temps handed to the per-panel
+    Paar factoring. <= 0 means the tile triple cannot fit at all."""
+    headroom = VMEM_BUDGET_BYTES - (2 * KB + 2 * RB) * 8 * TL * 4
+    return int(headroom // (8 * TL * 4 * PANEL_TEMP_ALIVE_FRACTION))
+
+
+# Pre-factoring estimates for the candidate scan (factoring every
+# candidate would cost seconds each): factored/raw cost ratio measured
+# on 64x128 panels of real generator networks (RS(50,20) 0.38,
+# RS(100,30) 0.38, RS(200,56) 0.37; 0.45 keeps the estimate
+# conservative), and the same wide-tile preference the fused planner
+# measured.
+_PANEL_FACTOR_RATIO = 0.45
+_PANEL_TL_FACTOR = {512: 1.0, 256: 1.08, 128: 1.15}
+
+
+@functools.lru_cache(maxsize=512)
+def panel_plan(bits_rows: tuple, C: int) -> tuple:
+    """Auto-tuned (KB, RB, TL, temp_cap) for the panel kernel.
+
+    Scored by estimated VPU bytes per input byte from the same VMEM
+    cost model the whole-plane kernels use — factored network cost
+    (ratio-estimated; the chosen plan's panels are factored exactly at
+    build time under ``temp_cap``) plus the K-step accumulate traffic
+    ((PK-1) XOR+write passes over the R output rows) — instead of the
+    single shrinking lane knob. The roofline telemetry attributes the
+    result per tile triple (``noise_ec_kernel_tile_*``,
+    obs/device.py), which is how a mis-scored plan shows up instead of
+    hiding inside one aggregate kernel series. Raises ValueError when
+    no tile triple fits VMEM (cannot happen for KB=RB=32, TL=128 under
+    the 14 MiB budget, but the model guards it anyway).
+    """
+    from noise_ec_tpu.ops.xor_factor import xor_cost
+
+    R = len(bits_rows)
+    raw = xor_cost(bits_rows)
+    density = raw / max(1, R * C)
+    best = None
+    for TL in (512, 256, 128):
+        for KB in (256, 128, 64, 32):
+            for RB in (256, 128, 64, 32):
+                cap = panel_temp_cap(KB, RB, TL)
+                if cap < 32:  # factoring needs real headroom to help
+                    continue
+                PK = -(-C // KB)
+                # Factoring yield degrades when the VMEM headroom caps
+                # the per-panel temps below what an unconstrained Paar
+                # pass would use (~1/14 of the panel's terms, measured
+                # on 64x128 panels of real generator networks): the
+                # ratio interpolates linearly from the measured
+                # factored ratio back toward raw cost.
+                temps_want = max(1, int(KB * RB * density / 7))
+                ratio = _PANEL_FACTOR_RATIO
+                if cap < temps_want:
+                    ratio += (1.0 - ratio) * (1.0 - cap / temps_want)
+                # Panel evaluation + per-K-step accumulate into the
+                # revisited output tile (read + XOR + write ~ 3 passes
+                # counted as ops over R rows per extra K step).
+                est = raw * ratio + (PK - 1) * R * 3
+                score = _PANEL_TL_FACTOR[TL] * 32 * est
+                # Larger panels factor better and switch less; prefer
+                # them at equal score.
+                key = (score, -KB, -RB)
+                if best is None or key < best[0]:
+                    best = (key, (KB, RB, TL, min(cap, 4096)))
+    if best is None:
+        raise ValueError(
+            f"no panel tile fits VMEM for R={R}, C={C}"
+        )
+    return best[1]
+
+
+def _make_panel_kernel(nets_flat: tuple, PK: int, KB: int, RB: int,
+                       TL: int, temp_cap: int):
+    """nets_flat[pr * PK + pk] = the (pr, pk) panel's local sub-network
+    (RB rows over [0, KB) columns)."""
+    from noise_ec_tpu.ops.xor_factor import eval_bits_rows
+
+    def kernel(planes_ref, out_ref):
+        pr = pl.program_id(0)
+        pk = pl.program_id(2)
+        x = planes_ref[...]  # (KB, 8, TL)
+
+        def branch(net):
+            def f(xv):
+                outs = eval_bits_rows(
+                    net, KB,
+                    lambda c: xv[c],
+                    lambda: jnp.zeros((8, TL), dtype=jnp.uint32),
+                    max_temps=temp_cap,
+                )
+                return jnp.stack(outs)
+
+            return f
+
+        partial = jax.lax.switch(
+            pr * PK + pk, [branch(n) for n in nets_flat], x
+        )
+
+        @pl.when(pk == 0)
+        def _init():
+            out_ref[...] = partial
+
+        @pl.when(pk != 0)
+        def _accumulate():
+            out_ref[...] = out_ref[...] ^ partial
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=128)
+def _panel_call(nets_flat: tuple, PR: int, PK: int, Cp: int, W8p: int,
+                KB: int, RB: int, TL: int, temp_cap: int, interpret: bool):
+    kernel = _make_panel_kernel(nets_flat, PK, KB, RB, TL, temp_cap)
+    return pl.pallas_call(
+        kernel,
+        grid=(PR, W8p // TL, PK),
+        in_specs=[
+            pl.BlockSpec((KB, 8, TL), lambda pr, i, pk: (pk, 0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((RB, 8, TL), lambda pr, i, pk: (pr, 0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((PR * RB, 8, W8p), jnp.uint32),
+        interpret=interpret,
+    )
+
+
+def gf2_matmul_pallas_panel_rows(
+    bits_rows: tuple[tuple[int, ...], ...],  # STATIC: baked per panel
+    tiled_planes: jnp.ndarray,  # (C, 8, W8) uint32
+    *,
+    plan: tuple | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Block-panel K-tiled GF(2) matmul (module comment above).
+
+    Returns (R, 8, W8) uint32, byte-identical to the whole-plane sparse
+    kernel. ``plan`` overrides the auto-tuner's (KB, RB, TL, temp_cap)
+    — tests force small panels; dispatch passes its cached plan so the
+    telemetry tile key and the kernel agree.
+    """
+    from noise_ec_tpu.ops.xor_factor import split_bits_rows_panels
+
+    C, sub, W8 = tiled_planes.shape
+    assert sub == 8, tiled_planes.shape
+    R = len(bits_rows)
+    KB, RB, TL, temp_cap = plan if plan is not None else panel_plan(
+        bits_rows, C
+    )
+    # Sub-tile payloads: shrink the lane tile to the padded lane count
+    # (strictly less VMEM than planned, so the temp cap stays valid) —
+    # a 128-lane probe under a TL=512 plan must not compute 4x padding.
+    TL = min(TL, max(128, -(-W8 // 128) * 128))
+    PR = -(-R // RB)
+    PK = -(-C // KB)
+    Cp = PK * KB
+    W8p = -(-W8 // TL) * TL
+    pad_c = Cp - C
+    pad_w = W8p - W8
+    if pad_c or pad_w:
+        tiled_planes = jnp.pad(
+            tiled_planes, ((0, pad_c), (0, 0), (0, pad_w))
+        )
+    panels = split_bits_rows_panels(bits_rows, Cp, KB, RB)
+    nets_flat = tuple(p for row in panels for p in row)
+    out = _panel_call(
+        nets_flat, PR, PK, Cp, W8p, KB, RB, TL, temp_cap, interpret
+    )(tiled_planes)
+    if PR * RB != R or pad_w:
+        out = out[:R, :, :W8]
+    return out

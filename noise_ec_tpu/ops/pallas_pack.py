@@ -355,6 +355,90 @@ def unpack_words_lanes(tiled: jnp.ndarray, *,
 
 
 # ---------------------------------------------------------------------------
+# Row-blocked lane pack: wide-geometry (many-row) variant.
+#
+# The lane kernels above hold ALL rows of the in+out blocks in VMEM per
+# grid step, so _lane_tl rejects row counts past ~91 (m=8, TL=128) — the
+# (3, 200)-shaped reconstruction inputs and the near-field-limit codes
+# the panel matmul tier exists for. The pack transpose is row-wise
+# independent (every op acts within one row), so these variants simply
+# add a row-block grid axis: grid step (rb, c) packs rows
+# [rb*RB, (rb+1)*RB) of lane tile c. The TL choice is pinned to the
+# BLOCK row count, so the pack/unpack bijection is independent of the
+# total row count — both ends of a pipeline agree by construction.
+
+PACK_ROW_BLOCK = 32  # _lane_tl(…, rows=32) yields TL=256: pairwise bracket
+
+
+@functools.lru_cache(maxsize=256)
+def _pack_lanes_blocked_call(kp: int, TW: int, m: int, interpret: bool):
+    RB = PACK_ROW_BLOCK
+    TL = _lane_tl(TW, m, RB)
+    W8 = TW // (8 * m)
+    rounds = _ROUNDS if m == 8 else _ROUNDS16
+    return pl.pallas_call(
+        functools.partial(_pack_lanes_kernel, m, TL, rounds),
+        grid=(kp // RB, W8 // TL),
+        in_specs=[
+            pl.BlockSpec((RB, 8 * m * TL), lambda rb, c: (rb, c),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((RB, m, 8, TL), lambda rb, c: (rb, 0, 0, c),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((kp, m, 8, W8), jnp.uint32),
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _unpack_lanes_blocked_call(rp: int, TW: int, m: int, interpret: bool):
+    RB = PACK_ROW_BLOCK
+    TL = _lane_tl(TW, m, RB)
+    W8 = TW // (8 * m)
+    rounds = _ROUNDS if m == 8 else _ROUNDS16
+    return pl.pallas_call(
+        functools.partial(_unpack_lanes_kernel, m, TL, rounds),
+        grid=(rp // RB, W8 // TL),
+        in_specs=[
+            pl.BlockSpec((RB, m, 8, TL), lambda rb, c: (rb, 0, 0, c),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((RB, 8 * m * TL), lambda rb, c: (rb, c),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rp, TW), jnp.uint32),
+        interpret=interpret,
+    )
+
+
+def pack_words_lanes_blocked(xw: jnp.ndarray, m: int = 8, *,
+                             interpret: bool = False) -> jnp.ndarray:
+    """Row-blocked :func:`pack_words_lanes`: any row count (rows padded
+    to the PACK_ROW_BLOCK internally, sliced back). Inverse:
+    :func:`unpack_words_lanes_blocked` — the blocked pair shares one
+    TL by construction, so no ``rows_budget`` coordination is needed.
+    """
+    k, TW = xw.shape
+    kp = -(-k // PACK_ROW_BLOCK) * PACK_ROW_BLOCK
+    if kp != k:
+        xw = jnp.pad(xw, ((0, kp - k), (0, 0)))
+    out = _pack_lanes_blocked_call(kp, TW, m, interpret)(xw)
+    return out[:k] if kp != k else out
+
+
+def unpack_words_lanes_blocked(tiled: jnp.ndarray, *,
+                               interpret: bool = False) -> jnp.ndarray:
+    """(r, m, 8, W8) tiled bit-planes -> (r, m*8*W8) words (row-blocked
+    pack inverse; see :func:`pack_words_lanes_blocked`)."""
+    r, m, eight, W8 = tiled.shape
+    assert eight == 8, tiled.shape
+    rp = -(-r // PACK_ROW_BLOCK) * PACK_ROW_BLOCK
+    if rp != r:
+        tiled = jnp.pad(tiled, ((0, rp - r), (0, 0), (0, 0), (0, 0)))
+    out = _unpack_lanes_blocked_call(rp, 8 * m * W8, m, interpret)(tiled)
+    return out[:r] if rp != r else out
+
+
+# ---------------------------------------------------------------------------
 # GF(2^16): 16-plane variant. A group is 16 words = 32 little-endian uint16
 # symbols; after the 16x16 transpose, sublane i holds bit i of all 32 symbols
 # (bit position 16h + w of plane word <-> symbol (w, half h) — a fixed
@@ -448,6 +532,101 @@ def bytes_to_words(x: jnp.ndarray) -> jnp.ndarray:
     """(k, S) uint8 -> (k, S/4) uint32 (bitcast; S % 4 == 0)."""
     k, S = x.shape
     return lax.bitcast_convert_type(x.reshape(k, S // 4, 4), jnp.uint32)
+
+
+# ---------------------------------------------------------------------------
+# GF(2^16) PACKED byte-sliced layout.
+#
+# The byte-sliced route splits each u16 symbol into (lo, hi) byte rows
+# and runs the m=8 pipeline over 2k rows — 3 delta-swap rounds and the
+# TL=512 tile instead of the 16-plane kernels' 4 rounds and TL<=256, so
+# the m=8 expansion stops doubling the round count. The PACKED layout is
+# the canonical device-resident form of that route: shard j's byte rows
+# sit ADJACENT (row 2j = lo bytes, row 2j+1 = hi bytes) in one (2k, S)
+# panel, so a k-shard u16 object is ONE contiguous operand for the
+# words/panel kernels and the matrix's unpermuted bit expansion (flat
+# plane index 16j + b == (2j + b//8)*8 + b%8) applies with no row
+# shuffle. The helpers below convert between the interleaved-u16 word
+# layout and the packed byte-sliced layout on either side of the device
+# boundary.
+
+
+def pack_u16_bytesliced(x: "np.ndarray") -> "np.ndarray":
+    """HOST: (k, S) uint16 symbols -> (2k, S) uint8 packed byte rows
+    (row 2j = lo bytes of shard j, row 2j+1 = hi bytes; little-endian).
+    The single relayout pass every host-side GF(2^16) dispatch pays —
+    shared by ops/dispatch.py and parallel/mesh.py so the layout cannot
+    fork."""
+    import numpy as np
+
+    k, S = x.shape
+    return np.ascontiguousarray(
+        np.ascontiguousarray(x).view(np.uint8).reshape(k, S, 2)
+        .transpose(0, 2, 1)
+        .reshape(2 * k, S)
+    )
+
+
+def unpack_u16_bytesliced(b: "np.ndarray") -> "np.ndarray":
+    """HOST: (2r, S) uint8 packed byte rows -> (r, S) uint16 symbols
+    (:func:`pack_u16_bytesliced` inverse)."""
+    import numpy as np
+
+    r2, S = b.shape
+    r = r2 // 2
+    return (
+        np.ascontiguousarray(
+            b.reshape(r, 2, S).transpose(0, 2, 1)
+        ).view("<u2").reshape(r, S)
+    )
+
+
+def words16_to_bytesliced(xw: jnp.ndarray) -> jnp.ndarray:
+    """DEVICE: (k, TW) u32 interleaved-u16 words (two LE symbols per
+    word) -> (2k, TW/2) u32 packed byte-sliced words, pure lane-local
+    bit ops (no sub-word dtype relayout — see ops/dispatch.py on the
+    u8<->u32 bitcast cost). Involution partner:
+    :func:`bytesliced_to_words16`. TW must be even."""
+    k, TW = xw.shape
+    pairs = xw.reshape(k, TW // 2, 2)
+    w0, w1 = pairs[..., 0], pairs[..., 1]
+    ff = jnp.uint32(0xFF)
+    lo = (
+        (w0 & ff)
+        | (((w0 >> jnp.uint32(16)) & ff) << jnp.uint32(8))
+        | ((w1 & ff) << jnp.uint32(16))
+        | (((w1 >> jnp.uint32(16)) & ff) << jnp.uint32(24))
+    )
+    hi = (
+        ((w0 >> jnp.uint32(8)) & ff)
+        | (((w0 >> jnp.uint32(24)) & ff) << jnp.uint32(8))
+        | (((w1 >> jnp.uint32(8)) & ff) << jnp.uint32(16))
+        | (((w1 >> jnp.uint32(24)) & ff) << jnp.uint32(24))
+    )
+    return jnp.stack([lo, hi], axis=1).reshape(2 * k, TW // 2)
+
+
+def bytesliced_to_words16(bw: jnp.ndarray) -> jnp.ndarray:
+    """DEVICE: (2r, TW8) u32 packed byte-sliced words -> (r, 2*TW8) u32
+    interleaved-u16 words (:func:`words16_to_bytesliced` inverse)."""
+    r2, TW8 = bw.shape
+    r = r2 // 2
+    pairs = bw.reshape(r, 2, TW8)
+    lo, hi = pairs[:, 0, :], pairs[:, 1, :]
+    ff = jnp.uint32(0xFF)
+    w0 = (
+        (lo & ff)
+        | (((hi & ff)) << jnp.uint32(8))
+        | (((lo >> jnp.uint32(8)) & ff) << jnp.uint32(16))
+        | (((hi >> jnp.uint32(8)) & ff) << jnp.uint32(24))
+    )
+    w1 = (
+        ((lo >> jnp.uint32(16)) & ff)
+        | (((hi >> jnp.uint32(16)) & ff) << jnp.uint32(8))
+        | (((lo >> jnp.uint32(24)) & ff) << jnp.uint32(16))
+        | (((hi >> jnp.uint32(24)) & ff) << jnp.uint32(24))
+    )
+    return jnp.stack([w0, w1], axis=2).reshape(r, 2 * TW8)
 
 
 def words_to_bytes(xw: jnp.ndarray) -> jnp.ndarray:

@@ -157,6 +157,74 @@ def xor_cost(bits_rows: tuple[tuple[int, ...], ...]) -> int:
     return sum(max(len(t) - 1, 0) for t in bits_rows)
 
 
+# --------------------------------------------------------------------- panels
+#
+# The block-panel kernels (pallas_gf2mm "panel tier") split a wide
+# (R, C) network into a 2-D grid of (RB output-rows x KB input-cols)
+# panels and evaluate one panel's sub-network per grid step. Factoring
+# runs PER PANEL, which is what makes near-field-limit geometries
+# plannable at all: Paar is super-linear in terms, so the whole
+# RS(200,56) network (~361k raw XORs) ran >9 min while its 64x128
+# panels factor in seconds total — and the temp count (VMEM stack
+# pressure) is bounded per panel instead of per program.
+
+
+def split_bits_rows_panels(
+    bits_rows: tuple[tuple[int, ...], ...], C: int, KB: int, RB: int
+) -> tuple[tuple[tuple[tuple[int, ...], ...], ...], ...]:
+    """Partition an (R rows x C cols) network into ceil(R/RB) x
+    ceil(C/KB) panels.
+
+    ``out[pr][pk]`` is the sub-network of output rows
+    [pr*RB, (pr+1)*RB) over input columns [pk*KB, (pk+1)*KB), columns
+    re-indexed to the panel-local [0, KB) range. A padded final row
+    block simply carries empty rows; a padded final column block has
+    columns no term references — XOR over GF(2) is associative and
+    commutative, so the row sum of a panel row over all pk panels
+    equals the original row.
+    """
+    R = len(bits_rows)
+    PR = -(-R // RB) if R else 1
+    PK = -(-C // KB) if C else 1
+    out = []
+    for pr in range(PR):
+        rows = bits_rows[pr * RB : (pr + 1) * RB]
+        rows = rows + ((),) * (RB - len(rows))
+        row_panels = []
+        for pk in range(PK):
+            lo, hi = pk * KB, (pk + 1) * KB
+            row_panels.append(
+                tuple(
+                    tuple(c - lo for c in row if lo <= c < hi)
+                    for row in rows
+                )
+            )
+        out.append(tuple(row_panels))
+    return tuple(out)
+
+
+def panel_raw_costs(panels) -> tuple[int, int]:
+    """(total, max_single) raw XOR cost over a panel grid — the
+    planner's cheap pre-factoring score inputs."""
+    costs = [xor_cost(p) for row in panels for p in row]
+    return sum(costs), max(costs) if costs else 0
+
+
+def factor_panels(panels, KB: int, max_temps: int = 100_000):
+    """Factor every panel (cached per panel via paar_factor) and return
+    ``(total_factored_cost, max_temps_used)`` — the exact numbers the
+    VMEM model and the tile telemetry report, where the planner's
+    pre-factoring estimates were ratios."""
+    total = 0
+    worst = 0
+    for row in panels:
+        for p in row:
+            ops, rem = paar_factor(p, KB, max_temps=max_temps)
+            total += factored_cost(ops, rem)
+            worst = max(worst, len(ops))
+    return total, worst
+
+
 def factored_cost(
     ops: tuple[tuple[int, int, int], ...], rows: tuple[tuple[int, ...], ...]
 ) -> int:

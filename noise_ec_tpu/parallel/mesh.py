@@ -233,20 +233,31 @@ class MeshRouter:
             return fn
 
     def _words_program(self, kernel: str, r_out: int, bits_rows: tuple,
-                       n_dev: int, donate: bool):
-        """shard_map tier: the vmapped fused words pipeline per device
-        shard, (B, k, TWp) u32 -> (B, r_out, TWp) u32."""
+                       n_dev: int, donate: bool, plan: tuple = None):
+        """shard_map tier: the vmapped words pipeline per device shard,
+        (B, k, TWp) u32 -> (B, r_out, TWp) u32. ``plan`` selects the
+        block-panel pipeline for wide geometries (the (KB, RB, TL,
+        temp_cap) tile plan — GSPMD cannot partition a pallas_call, so
+        the panel kernels shard exactly like the fused ones: manual
+        SPMD, one vmapped pipeline per shard) and joins the program
+        cache key, mirroring the single-device dispatch key."""
         from noise_ec_tpu.ops.dispatch import (
             _fused_words_pipeline,
+            _panel_words_pipeline,
             donation_supported,
         )
 
         interpret = kernel == "pallas_interpret"
         donate = donate and donation_supported()
-        key = ("words", kernel, r_out, bits_rows, n_dev, donate)
+        key = ("words", kernel, r_out, bits_rows, n_dev, donate, plan)
 
         def build():
-            single = _fused_words_pipeline(r_out, 8, bits_rows, interpret)
+            if plan is not None:
+                single = _panel_words_pipeline(
+                    r_out, 8, bits_rows, plan, interpret
+                )
+            else:
+                single = _fused_words_pipeline(r_out, 8, bits_rows, interpret)
 
             def local(words_local):
                 return jax.vmap(single)(words_local)
@@ -262,19 +273,29 @@ class MeshRouter:
         return self._program(key, build)
 
     def _decode1_program(self, kernel: str, r2: int, bits_rows: tuple,
-                         n_dev: int):
+                         n_dev: int, plan: tuple = None):
         """shard_map tier, fused corrupted-share decode: one generator-
         shaped matmul per object (the decode1 fold — corrected row +
         consistency rows) with the verify-OR folded INSIDE the program,
-        so chained encode→decode has no intermediate host hop. Returns
-        (corrected (B, TWp), verify_or (B, TWp))."""
-        from noise_ec_tpu.ops.dispatch import _fused_words_pipeline
+        so chained encode→decode has no intermediate host hop. Wide
+        fold matrices ride the block-panel pipeline (``plan``), same as
+        the encode tier. Returns (corrected (B, TWp), verify_or
+        (B, TWp))."""
+        from noise_ec_tpu.ops.dispatch import (
+            _fused_words_pipeline,
+            _panel_words_pipeline,
+        )
 
         interpret = kernel == "pallas_interpret"
-        key = ("decode1", kernel, r2, bits_rows, n_dev)
+        key = ("decode1", kernel, r2, bits_rows, n_dev, plan)
 
         def build():
-            single = _fused_words_pipeline(r2, 8, bits_rows, interpret)
+            if plan is not None:
+                single = _panel_words_pipeline(
+                    r2, 8, bits_rows, plan, interpret
+                )
+            else:
+                single = _fused_words_pipeline(r2, 8, bits_rows, interpret)
 
             def one(w):
                 out = single(w)  # (r2, TWp)
@@ -336,7 +357,7 @@ class MeshRouter:
     # --------------------------------------------------- words batch entry
 
     def _words_dispatch(self, kernel: str, r_out: int, bits_rows: tuple,
-                        words, *, donate: bool):
+                        words, *, donate: bool, plan: tuple = None):
         """Shared body for the words-tier entries: ladder-pad the batch,
         quantum-pad the words, place (or reshard-count) the input, run
         the sharded program. ``words``: (B, k, TW) u32, np or jax.
@@ -357,7 +378,8 @@ class MeshRouter:
         # freshly padded input is an array THIS tier created — always
         # donatable; a caller's device array needs the explicit opt-in.
         donate = donation_supported() and (is_np or padded or donate)
-        fn = self._words_program(kernel, r_out, bits_rows, n_dev, donate)
+        fn = self._words_program(kernel, r_out, bits_rows, n_dev, donate,
+                                 plan)
         expected = self.sharding_for(n_dev)
         if is_np:
             if padded:
@@ -388,11 +410,14 @@ class MeshRouter:
         The hook ``DeviceCodec._matmul_words_batch_dispatch`` routes
         through (so the gate, breaker, and telemetry wrappers above it
         are unchanged). Byte-identical to the single-device vmap route.
+        Panel-routed (wide) matrices ride the same shard_map tier with
+        the block-panel pipeline per shard (``_words_program``).
         """
         M = np.asarray(M)
+        route, plan = codec._route_plan(M)
         out, B, TW = self._words_dispatch(
             codec.kernel, M.shape[0], codec.bits_rows_for(M), words,
-            donate=donate,
+            donate=donate, plan=plan if route == "panel" else None,
         )
         return out[:B, :, :TW]
 
@@ -415,7 +440,11 @@ class MeshRouter:
         B_pad = ladder_pad(B)
         n_dev = self.n_dev_for(B_pad)
         bits_rows = codec.bits_rows_for(D)
-        fn = self._decode1_program(codec.kernel, D.shape[0], bits_rows, n_dev)
+        route, plan = codec._route_plan(D)
+        fn = self._decode1_program(
+            codec.kernel, D.shape[0], bits_rows, n_dev,
+            plan if route == "panel" else None,
+        )
         TWp = pad_words(TW)
         expected = self.sharding_for(n_dev)
         arr = words
@@ -467,10 +496,13 @@ class MeshRouter:
     def encode_words_program(self, codec, M: np.ndarray, n_dev: int):
         """Compiled sharded words encode for bench/tests: (B, k, TWp)
         u32 -> (B, r, TWp), batch axis over ``n_dev`` mesh devices (no
-        donation — chained timing loops reuse their input)."""
+        donation — chained timing loops reuse their input). Wide
+        matrices get their panel plan, like the dispatch entries."""
         M = np.asarray(M)
+        route, plan = codec._route_plan(M)
         return self._words_program(
-            codec.kernel, M.shape[0], codec.bits_rows_for(M), n_dev, False
+            codec.kernel, M.shape[0], codec.bits_rows_for(M), n_dev, False,
+            plan if route == "panel" else None,
         )
 
     def encode_sym_program(self, codec, M: np.ndarray, n_dev: int):
@@ -523,8 +555,10 @@ class MeshRouter:
                 .reshape(k2, S)
             )
         words = buf.view("<u4").reshape(B_pad, k2, TWp)
+        route, plan = codec._route_plan(M)
         out, _, _ = self._words_dispatch(
-            codec.kernel, r2, codec.bits_rows_for(M), words, donate=True
+            codec.kernel, r2, codec.bits_rows_for(M), words, donate=True,
+            plan=plan if route == "panel" else None,
         )
         out_w = np.asarray(out)  # (B_pad, r2, TWp)
         buffer_pool().release(lease)

@@ -232,10 +232,16 @@ def test_mxu_codec_interpret_bit_exact(rng):
 
 
 def test_route_for_pins_kernel_family():
-    """The dispatch route gate: wide-but-bounded codes stay on the baked
-    XOR-network kernels; near-field-limit matrices (big networks OR many
-    rows, which OOM the pack stage's VMEM regardless of network size) go
-    to the dense MXU bit-plane kernel."""
+    """The dispatch tier decision: compact codes stay on the whole-plane
+    baked kernels; wide-but-plannable matrices (many rows, which OOM the
+    whole-plane pack stage's VMEM, or networks past the whole-plane XOR
+    budget but within the panel budget) go to the block-panel K-tiled
+    kernels; only matrices past every XOR-network budget fall to the
+    dense MXU bit-plane kernel. On the interpret kernel the panel budget
+    equals the whole-plane budget (ops/dispatch.py
+    _PANEL_XOR_BUDGET_INTERPRET), so RS(200,56) routes MXU here and
+    panel on a compiled `pallas` codec (tests/test_panel.py pins that
+    side)."""
     from noise_ec_tpu.matrix.generators import generator_matrix
     from noise_ec_tpu.ops.dispatch import DeviceCodec
 
@@ -245,11 +251,12 @@ def test_route_for_pins_kernel_family():
     g200 = generator_matrix(dev.gf, 200, 256, "cauchy")
     assert dev.route_for(g200[200:]) == "mxu"
     # Tiny network, many input rows: the (3, 200) reconstruction shape
-    # that OOMed pallas_pack on hardware must also route to the MXU.
+    # that OOMed pallas_pack on hardware routes to the panel tier (the
+    # row-blocked pack has no row bound), no longer to the MXU.
     import numpy as np
     small = np.zeros((3, 200), dtype=np.uint8)
     small[:, :3] = np.eye(3, dtype=np.uint8)
-    assert dev.route_for(small) == "mxu"
+    assert dev.route_for(small) == "panel"
 
 
 def test_near_limit_encode_matches_golden_interpret():

@@ -1,0 +1,471 @@
+"""Wide-geometry block-panel kernel tests (docs/design.md §14).
+
+Covers the K-tiled panel matmul's byte identity vs golden host
+arithmetic (dispatch-level across fields, incl. uneven tails), the
+XOR-abelian K-block permutation property, the VMEM estimator's
+accept/reject calibration boundaries, the three-way tier decision
+(no supported geometry raises — it only routes), the geometry-sweep
+recompile-flatness acceptance, the packed GF(2^16) byte-sliced decode,
+and the mesh tier's zero-reshard contract on panel-routed programs.
+
+The heaviest geometries (RS(200,56) and the wide-field RS(100,30) —
+multi-hundred-k-op networks that cost minutes to trace + compile on
+the interpret backend) are ``slow``-marked; tier-1 keeps the panel
+route honest on geometries whose networks trace in seconds.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from noise_ec_tpu.gf import gf2_matmul_planes
+from noise_ec_tpu.gf.bitmatrix import expand_generator_bits
+from noise_ec_tpu.gf.field import GF256, GF65536
+from noise_ec_tpu.golden.codec import GoldenCodec
+from noise_ec_tpu.matrix.generators import generator_matrix
+from noise_ec_tpu.obs.registry import default_registry
+from noise_ec_tpu.ops.dispatch import DeviceCodec
+from noise_ec_tpu.ops.pallas_gf2mm import (
+    PANEL_XOR_BUDGET,
+    VMEM_BUDGET_BYTES,
+    bits_to_rows,
+    gf2_matmul_pallas_panel_rows,
+    panel_plan,
+    panel_temp_cap,
+    panel_vmem_bytes,
+    planes_to_tiled,
+    sparse_lane_tl,
+    tiled_to_planes,
+)
+from noise_ec_tpu.ops.xor_factor import (
+    factor_panels,
+    split_bits_rows_panels,
+    xor_cost,
+)
+
+
+# ------------------------------------------------- kernel-level identity
+
+
+def test_panel_matmul_matches_planes_reference(rng):
+    """Byte identity vs the numpy planes reference on an uneven
+    geometry (R, C, W all non-multiples of every block size), with an
+    empty output row, across several forced tile plans including ones
+    that exercise multi-panel K and R axes."""
+    bits = rng.integers(0, 2, size=(19, 45)).astype(np.uint8)
+    bits[3] = 0  # empty-row path
+    planes = rng.integers(0, 2**32, size=(45, 777), dtype=np.uint32)
+    want = gf2_matmul_planes(bits, planes)
+    tiled = planes_to_tiled(jnp.asarray(planes))
+    rows = bits_to_rows(bits)
+    for plan in (None, (16, 8, 128, 512), (8, 4, 128, 64)):
+        out = gf2_matmul_pallas_panel_rows(
+            rows, tiled, plan=plan, interpret=True
+        )
+        got = np.asarray(tiled_to_planes(out, 777))
+        np.testing.assert_array_equal(got, want)
+
+
+def test_panel_kblock_accumulation_order_invariance(rng):
+    """XOR is abelian: permuting the K-block assignment (which panel's
+    partial lands in which accumulation step) must not change a single
+    byte. The permutation renumbers whole KB-column blocks of the
+    network and moves the matching input row blocks, so the K-step
+    accumulation order over the output tile genuinely differs."""
+    KB = 8
+    bits = rng.integers(0, 2, size=(11, 45)).astype(np.uint8)
+    planes = rng.integers(0, 2**32, size=(45, 300), dtype=np.uint32)
+    want = gf2_matmul_planes(bits, planes)
+    rows = bits_to_rows(bits)
+    nb = -(-45 // KB)
+    plan = (KB, 4, 128, 64)
+    for seed in (1, 2):
+        perm = np.random.default_rng(seed).permutation(nb)
+        pos = {int(oldb): newb for newb, oldb in enumerate(perm)}
+        planes_full = np.zeros((nb * KB, 300), np.uint32)
+        planes_full[:45] = planes
+        planes_p = np.concatenate(
+            [planes_full[b * KB : (b + 1) * KB] for b in perm]
+        )
+        rows_p = tuple(
+            tuple(sorted(pos[c // KB] * KB + c % KB for c in row))
+            for row in rows
+        )
+        out = gf2_matmul_pallas_panel_rows(
+            rows_p, planes_to_tiled(jnp.asarray(planes_p)), plan=plan,
+            interpret=True,
+        )
+        got = np.asarray(tiled_to_planes(out, 300))
+        np.testing.assert_array_equal(got, want)
+
+
+# ------------------------------------- VMEM estimator calibration pins
+
+
+def test_temp_model_boundary_cases():
+    """The calibration anchors from the estimator comments, pinned so a
+    recalibration cannot silently OOM a launch.
+
+    Whole-plane model (TEMP_ALIVE_FRACTION = 0.4): RS(50,20)'s factored
+    network at TL=256 OOMed at 24.7M scoped on hardware — the model
+    must REJECT 256 (pick 128); the same model must ACCEPT wide tiles
+    for a compact RS(10,4)-class network.
+
+    Panel model (PANEL_TEMP_ALIVE_FRACTION = 1.0, cap-based): a tile
+    triple whose blocks alone exceed the budget yields a non-positive
+    temp cap (REJECT — the planner must never emit it), and every plan
+    the auto-tuner emits must fit the budget at its own cap (ACCEPT),
+    with the per-panel factoring's actual temp usage bounded by the
+    cap it was given.
+    """
+    gf = GF256()
+    g50 = generator_matrix(gf, 50, 70, "cauchy")
+    rows50 = bits_to_rows(expand_generator_bits(gf, g50[50:]))
+    assert sparse_lane_tl(rows50, 400, 10**6) == 128  # reject TL>=256
+    g10 = generator_matrix(gf, 10, 14, "cauchy")
+    rows10 = bits_to_rows(expand_generator_bits(gf, g10[10:]))
+    assert sparse_lane_tl(rows10, 80, 10**6) == 512  # accept wide tile
+
+    # Panel reject boundary: (256, 256, 512) blocks = 16.8M > 14M.
+    assert panel_temp_cap(256, 256, 512) <= 0
+    # Panel accept boundary + cap enforcement on a real wide geometry.
+    g120 = generator_matrix(gf, 120, 124, "cauchy")
+    rows120 = bits_to_rows(expand_generator_bits(gf, g120[120:]))
+    plan = panel_plan(rows120, 8 * 120)
+    KB, RB, TL, cap = plan
+    assert cap > 0
+    assert panel_vmem_bytes(KB, RB, TL, cap) <= VMEM_BUDGET_BYTES
+    panels = split_bits_rows_panels(
+        rows120, -(-8 * 120 // KB) * KB, KB, RB
+    )
+    _total, worst = factor_panels(panels, KB, max_temps=cap)
+    assert 0 < worst <= cap
+
+
+# ----------------------------------------------- tier decision routing
+
+
+def test_tier_decision_routes_every_supported_geometry():
+    """The old RS(200,56) "must not even attempt" planning guard is a
+    tier decision now: across the supported range (k <= n <= 256, both
+    fields) nothing raises — route_for answers baked/panel/mxu, and
+    panel-routed matrices get a VMEM-fitting plan. On the compiled
+    `pallas` kernel the panel budget covers RS(200,56) encode AND its
+    decode1 fold; the interpret kernel keeps those on the MXU route
+    (multi-minute trace/compile is useless for CPU correctness runs),
+    which test_ops pins."""
+    from noise_ec_tpu.ops.dispatch import decode1_fold_matrix
+
+    for field, geoms in (
+        ("gf256", ((5, 3), (17, 3), (50, 20), (100, 30), (200, 56),
+                   (255, 1), (3, 200))),
+        ("gf65536", ((5, 3), (50, 4), (100, 30), (200, 56))),
+    ):
+        dev = DeviceCodec(field=field, kernel="pallas")
+        for k, r in geoms:
+            if k + r > 256 and field == "gf256":
+                continue
+            M = generator_matrix(dev.gf, k, min(256, k + r), "cauchy")[k:]
+            route = dev.route_for(M)
+            assert route in ("baked", "panel", "mxu"), (field, k, r)
+            if route == "panel":
+                KB, RB, TL, cap = panel_plan(
+                    dev.bits_rows_for(M), dev.gf.degree * k
+                )
+                assert panel_vmem_bytes(KB, RB, TL, cap) <= VMEM_BUDGET_BYTES
+    dev = DeviceCodec(field="gf256", kernel="pallas")
+    G = generator_matrix(dev.gf, 200, 256, "cauchy")
+    assert dev.route_for(G[200:]) == "panel"
+    assert xor_cost(dev.bits_rows_for(G[200:])) <= PANEL_XOR_BUDGET
+    # The fused corrupted-share decode fold rides the panel tier too.
+    from noise_ec_tpu.matrix.linalg import gf_inv
+
+    A = dev.gf.matmul(
+        G[200:].astype(np.int64), gf_inv(dev.gf, G[:200]).astype(np.int64)
+    ).astype(np.uint8)
+    D = decode1_fold_matrix(dev.gf, A, 1)
+    assert dev.route_for(D) == "panel"
+    # Past every XOR budget: the wide-field near-limit expansion (~1.4M
+    # raw XORs) still routes — to the MXU — instead of raising.
+    dev16 = DeviceCodec(field="gf65536", kernel="pallas")
+    G16 = generator_matrix(dev16.gf, 200, 256, "cauchy")
+    assert dev16.route_for(G16[200:]) == "mxu"
+
+
+# ------------------------------------------ dispatch-level byte identity
+
+
+def test_panel_dispatch_byte_identity_gf256(rng):
+    """RS(120,4) — wide-row geometry on the natural panel route (rows
+    past the whole-plane pack bound, network under every budget) —
+    through the public dispatch, uneven tail, vs the golden codec; the
+    tile telemetry must attribute the dispatch to the plan's label."""
+    k, r = 120, 4
+    dev = DeviceCodec(field="gf256", kernel="pallas_interpret")
+    G = generator_matrix(dev.gf, k, k + r, "cauchy")
+    assert dev.route_for(G[k:]) == "panel"
+    D = rng.integers(0, 256, size=(k, 3001)).astype(np.uint8)
+    got = dev.matmul_stripes(G[k:], D)
+    want = np.asarray(GoldenCodec(k, k + r).encode(D))
+    np.testing.assert_array_equal(got, want)
+    from noise_ec_tpu.ops.dispatch import tile_label
+
+    label = tile_label(dev.panel_plan_for(G[k:]))
+    tile_calls = default_registry().counter(
+        "noise_ec_kernel_tile_dispatches_total"
+    ).labels(entry="matmul_stripes_pallas_interpret", tile=label)
+    assert tile_calls.value >= 1
+
+
+def test_panel_dispatch_byte_identity_gf65536(rng):
+    """Wide-field RS(50,4) — 100 byte rows push it past the whole-plane
+    row bound onto the panel tier via the PACKED byte-sliced layout —
+    through the public dispatch, uneven tail, vs the golden codec."""
+    k, r = 50, 4
+    dev = DeviceCodec(field="gf65536", kernel="pallas_interpret")
+    G = generator_matrix(dev.gf, k, k + r, "cauchy")
+    assert dev.route_for(G[k:]) == "panel"
+    D = rng.integers(0, 1 << 16, size=(k, 501)).astype(np.uint16)
+    got = dev.matmul_stripes(G[k:], D)
+    want = np.asarray(GoldenCodec(k, k + r, field="gf65536").encode(D))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_panel_words_pipeline_rs50_20_identity(rng):
+    """RS(50,20) normally rides the whole-plane route; forcing its
+    network through the panel words pipeline (explicit plan) must be
+    byte-identical — the two tiers implement one layout contract and
+    the planner may move a geometry between them as budgets move."""
+    from noise_ec_tpu.ops.dispatch import _panel_words_fn
+
+    gf = GF256()
+    k, r = 50, 20
+    G = generator_matrix(gf, k, k + r, "cauchy")
+    dev = DeviceCodec(field="gf256", kernel="pallas_interpret")
+    assert dev.route_for(G[k:]) == "baked"
+    bits_rows = dev.bits_rows_for(G[k:])
+    plan = panel_plan(bits_rows, 8 * k)
+    TW = 8192
+    words = rng.integers(
+        0, 1 << 32, size=(k, TW), dtype=np.uint64
+    ).astype(np.uint32)
+    fn = _panel_words_fn(r, 8, bits_rows, plan, True)
+    got = np.asarray(fn(jnp.asarray(words)))
+    want_sym = gf.matvec_stripes(
+        G[k:], words.view(np.uint8).reshape(k, -1)
+    )
+    np.testing.assert_array_equal(
+        got.view(np.uint8).reshape(r, -1), want_sym
+    )
+
+
+# ----------------------------------------------- recompile-churn guard
+
+
+def test_panel_geometry_sweep_no_recompile_churn(rng):
+    """The PR-5 acceptance pattern on the panel tier: a repeated
+    geometry sweep must add ZERO compile-route dispatches the second
+    time around — the plan is deterministic and part of the cache key,
+    so warm panel traffic never re-jits."""
+    compiles = default_registry().counter("noise_ec_jit_compiles_total")
+
+    def total():
+        return sum(c.value for _, c in compiles.children())
+
+    dev8 = DeviceCodec(field="gf256", kernel="pallas_interpret")
+    dev16 = DeviceCodec(field="gf65536", kernel="pallas_interpret")
+    G8 = generator_matrix(dev8.gf, 120, 124, "cauchy")
+    G16 = generator_matrix(dev16.gf, 50, 54, "cauchy")
+    D8 = rng.integers(0, 256, size=(120, 3001)).astype(np.uint8)
+    D16 = rng.integers(0, 1 << 16, size=(50, 501)).astype(np.uint16)
+
+    def sweep():
+        dev8.matmul_stripes(G8[120:], D8)
+        dev16.matmul_stripes(G16[50:], D16)
+
+    sweep()  # first sweep may compile (fresh keys)
+    warm = total()
+    sweep()
+    sweep()
+    assert total() == warm, "repeat panel geometry sweep re-compiled"
+
+
+# --------------------------------------- packed GF(2^16) fused decode
+
+
+def test_decode1_words_bytesliced_corrects_and_verifies(rng):
+    """The packed byte-sliced fused corrupted-share decode: corrected
+    row equals the pre-corruption truth with all-clean verify on a
+    single corrupted share, and the verify OR goes nonzero when a
+    second share defeats the single-support hypothesis. The wide-field
+    fold matrix (108 byte rows) rides the panel tier."""
+    from noise_ec_tpu.matrix.linalg import gf_inv
+    from noise_ec_tpu.ops.pallas_pack import (
+        unpack_u16_bytesliced,
+        words16_to_bytesliced,
+    )
+
+    k, r = 50, 4
+    dev = DeviceCodec(field="gf65536", kernel="pallas_interpret")
+    gf = dev.gf
+    G = generator_matrix(gf, k, k + r, "cauchy")
+    data = rng.integers(0, 1 << 16, size=(k, 256)).astype(np.uint16)
+    cw = np.asarray(
+        GoldenCodec(k, k + r, field="gf65536").encode_all(data)
+    )
+    cw[1] ^= 0xA5A5  # whole-share corruption of data share 1
+    A = gf.matmul(
+        G[k:].astype(np.int64), gf_inv(gf, G[:k]).astype(np.int64)
+    ).astype(np.uint16)
+    assert dev.route_for(dev.decode1_matrix(A, 1)) == "panel"
+    w = jnp.asarray(np.ascontiguousarray(cw).view("<u4"))
+    bs = words16_to_bytesliced(w)
+    corrected, bad = dev.decode1_words_bytesliced(A, 1, bs)
+    got = unpack_u16_bytesliced(
+        np.ascontiguousarray(np.asarray(corrected)).view(np.uint8)
+    )
+    np.testing.assert_array_equal(got[0], data[1])
+    assert not np.asarray(bad).any()
+    # Second corrupted share: the hypothesis must be defeated somewhere.
+    cw2 = cw.copy()
+    cw2[2, 7] ^= 0x0100
+    bs2 = words16_to_bytesliced(
+        jnp.asarray(np.ascontiguousarray(cw2).view("<u4"))
+    )
+    _, bad2 = dev.decode1_words_bytesliced(A, 1, bs2)
+    assert np.asarray(bad2).any()
+
+
+# --------------------------------------------- mesh tier, zero reshard
+
+
+def test_mesh_panel_chained_encode_decode_zero_reshard(rng):
+    """The mesh acceptance on PANEL-routed programs: sharded wide-
+    geometry encode → on-device corruption → sharded fused decode1,
+    out_shardings matching in_shardings all the way —
+    noise_ec_mesh_reshard_total must not move, and bytes must match
+    the single-device truth."""
+    from noise_ec_tpu.parallel.mesh import (
+        configure_mesh_router,
+        reset_mesh_router,
+    )
+
+    router = configure_mesh_router(enable=True)
+    try:
+        assert router.enabled and router.n_pow2 == 8
+        gf = GF256()
+        k, r = 120, 4
+        G = generator_matrix(gf, k, k + r, "cauchy")
+        dev = DeviceCodec(field="gf256", kernel="pallas_interpret")
+        assert dev.route_for(G[k:]) == "panel"
+        B, TW = 8, 8192
+        words = rng.integers(
+            0, 1 << 32, size=(B, k, TW), dtype=np.uint64
+        ).astype(np.uint32)
+        n_dev = router.n_dev_for(B)
+        parity = router.matmul_words_batch(dev, G[k:], words)
+        mode_calls = default_registry().counter(
+            "noise_ec_mesh_sharded_dispatches_total"
+        ).labels(mode="shard_map")
+        assert mode_calls.value >= 1
+        want0 = gf.matvec_stripes(
+            G[k:], words[0].view(np.uint8).reshape(k, -1)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(parity)[0].view(np.uint8).reshape(r, -1), want0
+        )
+        data_dev = jax.device_put(words, router.sharding_for(n_dev))
+        assemble = jax.jit(
+            lambda d, p: jnp.concatenate([d, p], axis=1).at[:, 5, :].set(
+                jnp.concatenate([d, p], axis=1)[:, 5, :]
+                ^ np.uint32(0xA5A5A5A5)
+            ),
+            out_shardings=router.sharding_for(n_dev),
+        )
+        full = assemble(data_dev, parity)
+        from noise_ec_tpu.matrix.linalg import gf_inv
+
+        A = gf.matmul(
+            G[k:].astype(np.int64), gf_inv(gf, G[:k]).astype(np.int64)
+        ).astype(np.uint8)
+        assert dev.route_for(dev.decode1_matrix(A, 5)) == "panel"
+        reshard = default_registry().counter("noise_ec_mesh_reshard_total")
+        reshard0 = reshard.labels().value
+        corrected, bad = router.decode1_words_batch(dev, A, 5, full)
+        assert reshard.labels().value == reshard0, (
+            "chained panel encode→decode resharded"
+        )
+        assert not np.asarray(bad).any()
+        np.testing.assert_array_equal(
+            np.asarray(corrected), words[:, 5, :]
+        )
+    finally:
+        reset_mesh_router()
+
+
+# --------------------------------------------------- slow wide sweeps
+
+
+@pytest.mark.slow
+def test_panel_rs100_30_identity_slow(rng):
+    """RS(100,30) (the bench sweep's mid point) through the forced
+    panel words pipeline vs host truth — ~95k raw XORs, minutes of
+    trace+compile on the interpret backend, so slow-marked."""
+    from noise_ec_tpu.ops.dispatch import _panel_words_fn
+
+    gf = GF256()
+    k, r = 100, 30
+    G = generator_matrix(gf, k, k + r, "cauchy")
+    bits_rows = bits_to_rows(expand_generator_bits(gf, G[k:]))
+    plan = panel_plan(bits_rows, 8 * k)
+    TW = 8192
+    words = rng.integers(
+        0, 1 << 32, size=(k, TW), dtype=np.uint64
+    ).astype(np.uint32)
+    fn = _panel_words_fn(r, 8, bits_rows, plan, True)
+    got = np.asarray(fn(jnp.asarray(words)))
+    want = gf.matvec_stripes(G[k:], words.view(np.uint8).reshape(k, -1))
+    np.testing.assert_array_equal(got.view(np.uint8).reshape(r, -1), want)
+
+
+@pytest.mark.slow
+def test_panel_rs200_56_identity_both_fields_slow(rng):
+    """The widest sweep geometry, both fields, directly on the panel
+    matmul kernel (the words pipelines add nothing network-wise):
+    RS(200,56) gf256 (~361k raw XORs) byte-identical to the planes
+    reference; the gf65536 equivalent at the same (448-row) network
+    via its unpermuted byte-row expansion."""
+    gf = GF256()
+    k, r = 200, 56
+    G = generator_matrix(gf, k, k + r, "cauchy")
+    bits = expand_generator_bits(gf, G[k:])
+    rows = bits_to_rows(bits)
+    planes = rng.integers(0, 2**32, size=(8 * k, 160), dtype=np.uint32)
+    want = gf2_matmul_planes(bits, planes)
+    plan = panel_plan(rows, 8 * k)
+    out = gf2_matmul_pallas_panel_rows(
+        rows, planes_to_tiled(jnp.asarray(planes)), plan=plan,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(tiled_to_planes(out, 160)), want
+    )
+    # Wide field at the same scale: RS(100,30) gf65536 — its expanded
+    # byte-row network is RS(200,56)-sized (480 x 1600 bits).
+    gf16 = GF65536()
+    G16 = generator_matrix(gf16, 100, 130, "cauchy")
+    bits16 = expand_generator_bits(gf16, G16[100:])
+    rows16 = bits_to_rows(bits16)
+    plan16 = panel_plan(rows16, 16 * 100)
+    planes16 = rng.integers(
+        0, 2**32, size=(16 * 100, 160), dtype=np.uint32
+    )
+    want16 = gf2_matmul_planes(bits16, planes16)
+    out16 = gf2_matmul_pallas_panel_rows(
+        rows16, planes_to_tiled(jnp.asarray(planes16)), plan=plan16,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(tiled_to_planes(out16, 160)), want16
+    )

@@ -61,7 +61,15 @@ SKIP_KEYS = {
 # carries it with the proper direction and the north-star check.
 
 HIGHER_BETTER_SUFFIXES = ("_gbps", "_mb_per_s", "_msgs_per_s", "_per_s")
-LOWER_BETTER_SUFFIXES = ("_ms", "_s")
+# "_ratio" keys are cost ratios (e.g. gf65536_vs_gf256_decode_ratio:
+# wide-field decode time over gf256 decode time at equal data volume):
+# gated DOWNWARD-ONLY — an increase past tolerance regresses, a decrease
+# is the improvement the panel/packed-layout work exists to buy. They
+# ride the tight device tolerance (both sides are slope-timed kernels;
+# the wide-geometry sweep keys rs100_30_encode_gbps /
+# rs200_56_decode_corrupt_p50_ms get device tolerance from their
+# suffixes the same way).
+LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_ratio")
 
 DEFAULT_TOLERANCE = 0.10
 # Host-path stats ride a single shared core with measured 10-40% load

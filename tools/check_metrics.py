@@ -50,7 +50,13 @@ and cross-checks every referenced name against the declarative registry:
   ``-object-port`` / ``-tenants`` flags, the 503 ``Retry-After`` shed
   contract, the manifest magic) must appear in docs/object-service.md
   — that doc owns the API and tenancy semantics those series
-  instrument, the same two-home rule the resilience families follow.
+  instrument, the same two-home rule the resilience families follow;
+- **panel docs parity**: the wide-geometry panel-tier families
+  (``noise_ec_kernel_tile_*``) and the tier's surfaces (the panel
+  kernel/planner entry points, the packed GF(2^16) layout helpers, the
+  budget and calibration constants) must appear in docs/design.md §14
+  "Wide-geometry panel kernels" — that section owns the grid layout,
+  VMEM cost model and tile auto-tune policy those series attribute.
 
 Run directly (``python tools/check_metrics.py``; exit 1 on problems) or
 through the tier-1 test that wraps it (tests/test_obs.py).
@@ -164,6 +170,7 @@ def check() -> list[str]:
     problems.extend(check_fleet_docs())
     problems.extend(check_datapath_docs())
     problems.extend(check_mesh_docs())
+    problems.extend(check_panel_docs())
     return problems
 
 
@@ -390,6 +397,50 @@ def check_mesh_docs() -> list[str]:
     problems.extend(
         f"mesh surface {tok} is not documented in docs/design.md"
         for tok in MESH_DOC_TOKENS
+        if tok not in text
+    )
+    return problems
+
+
+# The wide-geometry panel tier (docs/design.md §14 owns the block-panel
+# grid layout, the VMEM cost model, the tile auto-tune policy and the
+# GF(2^16) packed byte-sliced layout the noise_ec_kernel_tile_* families
+# attribute): its families must be documented there as well as in the
+# observability registry table, plus the surfaces that exist only as
+# identifiers in the code.
+PANEL_PREFIXES = ("noise_ec_kernel_tile_",)
+PANEL_DOC_TOKENS = (
+    "gf2_matmul_pallas_panel_rows",
+    "panel_plan",
+    "split_bits_rows_panels",
+    "pack_words_lanes_blocked",
+    "decode1_words_bytesliced",
+    "PANEL_TEMP_ALIVE_FRACTION",
+    "pl.when",
+    "PANEL_XOR_BUDGET",
+)
+
+
+def check_panel_docs() -> list[str]:
+    """Panel-tier families + surfaces vs docs/design.md §14."""
+    from noise_ec_tpu.obs.registry import METRICS
+
+    doc_path = REPO / "docs" / "design.md"
+    names = [n for n in METRICS if n.startswith(PANEL_PREFIXES)]
+    if not names:
+        return []
+    if not doc_path.exists():
+        return [f"docs file {doc_path} missing (panel metrics exist)"]
+    text = doc_path.read_text(encoding="utf-8")
+    problems = [
+        f"panel metric {n!r} is not documented in docs/design.md "
+        "(wide-geometry panel kernels section)"
+        for n in names
+        if n not in text
+    ]
+    problems.extend(
+        f"panel surface {tok} is not documented in docs/design.md"
+        for tok in PANEL_DOC_TOKENS
         if tok not in text
     )
     return problems
