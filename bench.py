@@ -318,55 +318,119 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — secondary stat only
         stats["decode_corrupt_error"] = str(exc)[:80]
 
-    # --- host-runtime story: full node round trip on the in-process
-    # loopback peer set (sign -> shard -> proto marshal -> dispatch ->
-    # reassemble -> Ed25519 verify), the reference's actual workload
-    # (main.go:175-198 send side, main.go:52-107 receive side).
+    # --- host-runtime story: full node round trip over REAL TCP sockets
+    # (sign -> shard -> SHARD_BATCH frame -> recv ring -> batched frame
+    # verify -> dispatch -> reassemble -> Ed25519 verify), driving the
+    # wire hot loop (docs/design.md §15) the way production traffic
+    # does: several senders with a pipelined in-flight window feeding
+    # one receiver node. Pre-§15 this block timed a 2-node loopback
+    # (1809.3 msgs/s at r05 with OpenSSL crypto; 143.5 on the pure-
+    # Python dev box) with per-call blocking sends — the multi-sender
+    # windowed shape is what the batch-verify and sendmsg coalescing
+    # tiers exist to serve, so the stat drives them.
     try:
-        from noise_ec_tpu.host.plugin import ShardPlugin
-        from noise_ec_tpu.host.transport import LoopbackHub, LoopbackNetwork, format_address
+        import threading as _threading
 
-        # numpy codec backend: this stat isolates the HOST runtime overhead
-        # (signing, proto, mempool, dispatch). Small single messages over
-        # the axon tunnel are RTT-bound (~5 msg/s at 64 KiB), which says
-        # nothing about either the host code or the kernels — the device
-        # throughput stats above cover the codec.
-        hub = LoopbackHub()
-        recv_count = [0]
-        nodes = []
-        for i in range(2):
-            node = LoopbackNetwork(hub, format_address("tcp", "localhost", 3000 + i))
-            node.add_plugin(ShardPlugin(
-                backend="numpy",
-                on_message=lambda m, s: recv_count.__setitem__(0, recv_count[0] + 1),
-            ))
-            nodes.append(node)
-        # Distinct payloads: identical bytes share a file signature and the
-        # receiver's replay protection would (correctly) drop the repeats.
-        base = rng.integers(0, 256, size=64 << 10).astype(np.uint8)  # 64 KiB
-        n_msgs = 20
-        payloads = []
-        for i in range(n_msgs + 1):
+        from noise_ec_tpu.host.plugin import ShardPlugin
+        from noise_ec_tpu.host.transport import TCPNetwork
+
+        # numpy codec backend: this stat isolates the HOST runtime
+        # (signing, proto, ring parse, batched verify, dispatch); the
+        # device throughput stats above cover the codec.
+        n_senders = 4
+        n_msgs = 24  # per sender
+        payload_bytes = 64 << 10
+        delivered = []
+        done = _threading.Event()
+        recv_kwargs = {}
+        # recv_shards exists from ISSUE 11 on; the getattr guard lets the
+        # same bench file measure the pre-§15 loop for the trajectory.
+        if "recv_shards" in TCPNetwork.__init__.__code__.co_varnames:
+            recv_kwargs["recv_shards"] = 2
+        recv_net = TCPNetwork(host="127.0.0.1", port=0, discovery=False,
+                              **recv_kwargs)
+        recv_net.add_plugin(ShardPlugin(
+            backend="numpy",
+            on_message=lambda m, s: (
+                delivered.append(len(m)),
+                done.set() if len(delivered) >= n_senders * n_msgs else None,
+            ),
+        ))
+        recv_net.listen()
+        senders = []
+        for i in range(n_senders):
+            net = TCPNetwork(host="127.0.0.1", port=0, discovery=False)
+            net.add_plugin(ShardPlugin(backend="numpy"))
+            net.listen()
+            net.bootstrap([recv_net.id.address])
+            senders.append(net)
+        deadline = time.time() + 30
+        while time.time() < deadline and len(recv_net.peers) < n_senders:
+            time.sleep(0.01)
+        if len(recv_net.peers) < n_senders:
+            raise SmokeMismatch(
+                f"roundtrip bench: {len(recv_net.peers)}/{n_senders} "
+                f"senders registered ({list(recv_net.errors)[:2]})"
+            )
+        base = rng.integers(0, 256, size=payload_bytes).astype(np.uint8)
+
+        def _payload(sender_i: int, msg_i: int) -> bytes:
+            # Distinct payloads: identical bytes share a file signature
+            # and the receiver's replay protection would (correctly)
+            # drop the repeats.
             b = base.copy()
-            b[:8] = np.frombuffer(i.to_bytes(8, "little"), dtype=np.uint8)
-            payloads.append(bytes(b))
-        send = nodes[0].plugins[0]
-        send.shard_and_broadcast(nodes[0], payloads[0])  # warm (jit, pools)
+            b[:8] = np.frombuffer(
+                (sender_i << 32 | msg_i).to_bytes(8, "little"), np.uint8
+            )
+            return bytes(b)
+
+        def _send(sender_i: int, count: int, first: int) -> None:
+            plugin = senders[sender_i].plugins[0]
+            for m in range(count):
+                # Pipelined window: broadcasts return once frames are
+                # posted (coalesce + flush ride the connection's loop),
+                # so each sender keeps its peer's window full instead of
+                # blocking per message; wait_writable is the bound.
+                plugin.shard_and_broadcast(
+                    senders[sender_i], _payload(sender_i, first + m)
+                )
+
+        # Warm (jit, codec caches, key tables, frame path) — one message
+        # per sender, delivered before timing starts.
+        for i in range(n_senders):
+            _send(i, 1, 0)
+        deadline = time.time() + 30
+        while time.time() < deadline and len(delivered) < n_senders:
+            time.sleep(0.01)
+        delivered.clear()
+        done.clear()
         t0 = time.perf_counter()
-        for p in payloads[1:]:
-            send.shard_and_broadcast(nodes[0], p)
-        t_host = (time.perf_counter() - t0) / n_msgs
-        if recv_count[0] != n_msgs + 1:
+        threads = [
+            _threading.Thread(target=_send, args=(i, n_msgs, 1))
+            for i in range(n_senders)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        done.wait(timeout=120)
+        t_host = time.perf_counter() - t0
+        if len(delivered) != n_senders * n_msgs:
             # Deterministic correctness failure: fail the bench run like
             # the kernel smokes (not a stat, not retried).
-            raise SmokeMismatch(f"host roundtrip lost messages: {recv_count}")
-        payload = payloads[0]
-        stats["host_node_roundtrip_msgs_per_s"] = round(1.0 / t_host, 1)
-        stats["host_node_roundtrip_mb_per_s"] = round(len(payload) / t_host / 1e6, 1)
+            raise SmokeMismatch(
+                f"host roundtrip lost messages: {len(delivered)}/"
+                f"{n_senders * n_msgs}"
+            )
+        total = n_senders * n_msgs
+        stats["host_node_roundtrip_msgs_per_s"] = round(total / t_host, 1)
+        stats["host_node_roundtrip_mb_per_s"] = round(
+            total * payload_bytes / t_host / 1e6, 1
+        )
         # Tail latency from the receive path's own e2e histogram
-        # (noise_ec_e2e_latency_seconds{outcome="ok"}): the loopback
-        # deliveries above are this process's only ok-outcome events, so
-        # the p99 here is the round trip's tail, not just its mean.
+        # (noise_ec_e2e_latency_seconds{outcome="ok"}): the deliveries
+        # above are this process's only ok-outcome events, so the p99
+        # here is the round trip's tail, not just its mean.
         from noise_ec_tpu.obs.registry import default_registry
 
         e2e_hist = default_registry().histogram(
@@ -376,13 +440,42 @@ def main() -> None:
             stats["host_node_roundtrip_p99_ms"] = round(
                 e2e_hist.p99 * 1e3, 3
             )
+        # Wire hot-loop amortization evidence (docs/design.md §15): how
+        # many frames shared one Ed25519 batch verify, and how many
+        # frames shared one send syscall, over this process's run.
+        try:
+            vb = default_registry().histogram(
+                "noise_ec_wire_verify_batch_size"
+            ).labels()
+            if vb.count:
+                stats["wire_verify_batch_size_p50"] = round(vb.p50, 2)
+            fs = default_registry().histogram(
+                "noise_ec_wire_frames_per_syscall"
+            ).labels()
+            if fs.count:
+                stats["wire_frames_per_syscall"] = round(
+                    fs.sum / fs.count, 2
+                )
+        except KeyError:
+            pass  # pre-§15 registry (trajectory replays)
+        for net in senders:
+            net.close()
+        recv_net.close()
 
         # --- large-object streaming: one 64 MiB object node-to-node as
         # 4 MiB erasure-coded chunks (sign once -> chunked encode ->
         # per-shard wire messages -> per-chunk reassembly -> one verify),
         # the round-3 end-to-end fast path. Two backends: the host-only
         # tier (numpy plugin + native C++ shim encode) and, on TPU, the
-        # device codec through the pipelined StreamingEncoder.
+        # device codec through the pipelined StreamingEncoder. In-process
+        # loopback (not TCP): this stat isolates the sign/encode/
+        # reassemble pipeline; the TCP loop above owns the socket story.
+        from noise_ec_tpu.host.transport import (
+            LoopbackHub,
+            LoopbackNetwork,
+            format_address,
+        )
+
         big = bytes(rng.integers(0, 256, size=64 << 20, dtype=np.uint8))
         for backend in ("numpy",) + (("device",) if on_tpu else ()):
             got = []

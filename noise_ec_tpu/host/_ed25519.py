@@ -11,16 +11,37 @@ leaks timing — so crypto.py logs a warning once when this backend is
 active; production deployments install ``cryptography``
 (requirements-test.txt).
 
-Performance: ~2.5 ms per scalar multiplication on a current x86 core
-(sign ≈ 3 ms, verify ≈ 6 ms) — ample for tests and REPL traffic, ~100x
-off OpenSSL for bulk streams.
+Performance: the generic double-and-add costs ~2.5 ms per scalar
+multiplication on a current x86 core (sign ≈ 3 ms, verify ≈ 6 ms). The
+wire hot loop (docs/design.md §15) cannot live with that, so three
+amortizations sit on top of the same field arithmetic:
+
+- a windowed fixed-base table for ``B`` (built once, lazily): a base
+  mult becomes ~32 table adds, which is what every sign and half of
+  every verify pays;
+- per-public-key power tables behind a bounded LRU
+  (:func:`_verify_key`): a node verifies a small stable peer set, so
+  the 256 doublings of ``k*A`` are paid once per key, not per frame;
+- :func:`verify_batch` — true Ed25519 batch verification: one random
+  linear combination ``(Σ zᵢSᵢ)·B == Σ zᵢRᵢ + Σ (zᵢkᵢ)·A`` checked
+  with a shared-doubling multi-scalar multiplication, so a cohort of
+  frames shares one pass of doublings (and, for the common one-sender
+  cohort, ONE table mult of ``A``). A failing batch falls back to
+  per-item verification, so the accept set is exactly the per-item
+  accept set: one bad signature never poisons its cohort, and the
+  only divergence is a 2^-128 false batch accept (standard RLC bound).
+
+Still NOT constant-time either way — production installs
+``cryptography``.
 """
 
 from __future__ import annotations
 
 import hashlib
+import os
+import threading
 
-__all__ = ["public_from_seed", "sign", "verify"]
+__all__ = ["public_from_seed", "sign", "verify", "verify_batch"]
 
 _p = 2**255 - 19
 _L = 2**252 + 27742317777372353535851937790883648493
@@ -108,6 +129,162 @@ def _hash_to_scalar(*parts: bytes) -> int:
     return int.from_bytes(h.digest(), "little") % _L
 
 
+def _points_equal(P, Q) -> bool:
+    """Projective equality x1/z1 == x2/z2 ∧ y1/z1 == y2/z2 — two cross
+    multiplications instead of the two field inversions a compressed
+    compare pays."""
+    X1, Y1, Z1, _ = P
+    X2, Y2, Z2, _ = Q
+    return (
+        (X1 * Z2 - X2 * Z1) % _p == 0 and (Y1 * Z2 - Y2 * Z1) % _p == 0
+    )
+
+
+# ------------------------------------------------------- table scalar mult
+#
+# A windowed table for point P holds T[j][v] = (v << (w*j)) * P for every
+# w-bit window j and digit v in 1..2^w-1, so P*s is one add per nonzero
+# window digit — no doublings at mult time. Build cost is ~one generic
+# scalar mult, so a table pays for itself on its second use.
+
+_SCALAR_BITS = 256  # S < L < 2^253, but clamped scalars set bit 254
+
+
+def _window_table(P, w: int):
+    rows = []
+    base = P  # (1 << (w * j)) * P
+    span = (1 << w) - 1
+    for _ in range((_SCALAR_BITS + w - 1) // w):
+        row = [None] * (span + 1)
+        acc = base
+        row[1] = acc
+        for v in range(2, span + 1):
+            acc = _add(acc, base)
+            row[v] = acc
+        rows.append(row)
+        for _ in range(w):
+            base = _add(base, base)
+    return rows
+
+
+def _mult_table(rows, w: int, s: int):
+    acc = _ZERO
+    mask = (1 << w) - 1
+    j = 0
+    while s:
+        v = s & mask
+        if v:
+            acc = _add(acc, rows[j][v])
+        s >>= w
+        j += 1
+    return acc
+
+
+_B_W = 8  # 32 windows; a base mult is <= 32 adds
+_B_TABLE = None
+_table_lock = threading.Lock()
+
+
+def _base_table():
+    global _B_TABLE
+    if _B_TABLE is None:
+        with _table_lock:
+            if _B_TABLE is None:
+                _B_TABLE = _window_table(_B, _B_W)
+    return _B_TABLE
+
+
+def _mult_base(s: int):
+    return _mult_table(_base_table(), _B_W, s)
+
+
+class VerifyKey:
+    """Decompressed public key with lazily built, tiered mult tables.
+
+    Tier 0 (first use): generic double-and-add — a key seen once, e.g.
+    fleet-scale identity churn, pays nothing extra. Tier 1 (second
+    use): ``pows[i] = 2^i * A`` (build ≈ one mult), making ``k*A``
+    ~128 adds with zero doublings. Tier 2 (a hot peer,
+    ``_W4_AFTER_USES``): a 4-bit window table — one add per nonzero
+    window digit, ~63 adds per mult — amortized across the thousands of
+    verifies a stable peer sends."""
+
+    __slots__ = ("point", "_pows", "_w4", "_uses")
+
+    _W4_AFTER_USES = 16
+
+    def __init__(self, point):
+        self.point = point
+        self._pows = None
+        self._w4 = None
+        self._uses = 0
+
+    def mult(self, s: int):
+        self._uses += 1
+        if self._w4 is not None:
+            return _mult_table(self._w4, 4, s)
+        if self._pows is None:
+            if self._uses < 2:
+                return _mult(self.point, s)
+            pows = []
+            P = self.point
+            for _ in range(_SCALAR_BITS):
+                pows.append(P)
+                P = _add(P, P)
+            self._pows = pows
+        if self._uses >= self._W4_AFTER_USES:
+            self._w4 = _window_table(self.point, 4)
+            return _mult_table(self._w4, 4, s)
+        acc = _ZERO
+        i = 0
+        pows = self._pows
+        while s:
+            if s & 1:
+                acc = _add(acc, pows[i])
+            s >>= 1
+            i += 1
+        return acc
+
+
+# Parsed-key LRU: a node talks to a bounded peer set; hostile identity
+# churn past the cap falls back to table-less keys (correct, slower).
+_VERIFY_KEYS: dict[bytes, VerifyKey] = {}
+_VERIFY_KEYS_MAX = 128
+
+
+def _verify_key(public_key: bytes):
+    """VerifyKey for ``public_key`` via the LRU, or None if the bytes do
+    not decode to a curve point."""
+    with _table_lock:
+        vk = _VERIFY_KEYS.get(public_key)
+    if vk is not None:
+        return vk
+    A = _decompress(public_key)
+    if A is None:
+        return None
+    vk = VerifyKey(A)
+    with _table_lock:
+        if len(_VERIFY_KEYS) >= _VERIFY_KEYS_MAX:
+            _VERIFY_KEYS.pop(next(iter(_VERIFY_KEYS)))
+        _VERIFY_KEYS[public_key] = vk
+    return vk
+
+
+def _msm(pairs):
+    """Σ sᵢ·Pᵢ by interleaved double-and-add: ONE shared run of
+    doublings for the whole set (the batch-verify amortization)."""
+    if not pairs:
+        return _ZERO
+    top = max(s.bit_length() for _, s in pairs)
+    acc = _ZERO
+    for bit in range(top - 1, -1, -1):
+        acc = _add(acc, acc)
+        for P, s in pairs:
+            if (s >> bit) & 1:
+                acc = _add(acc, P)
+    return acc
+
+
 class SigningKey:
     """Expanded signing key: the per-seed work (SHA-512 expansion plus
     the public-key scalar mult) done once, so a cached key signs with a
@@ -123,11 +300,11 @@ class SigningKey:
         h = hashlib.sha512(seed).digest()
         self._a = _clamp(h)
         self._prefix = h[32:]
-        self.public_key = _compress(_mult(_B, self._a))
+        self.public_key = _compress(_mult_base(self._a))
 
     def sign(self, message: bytes) -> bytes:
         r = _hash_to_scalar(self._prefix, message)
-        R = _compress(_mult(_B, r))
+        R = _compress(_mult_base(r))
         S = (r + _hash_to_scalar(R, self.public_key, message) * self._a) % _L
         return R + S.to_bytes(32, "little")
 
@@ -143,13 +320,79 @@ def sign(seed: bytes, message: bytes) -> bytes:
 def verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
     if len(public_key) != 32 or len(signature) != 64:
         return False
-    A = _decompress(public_key)
+    vk = _verify_key(bytes(public_key))
     R = _decompress(signature[:32])
-    if A is None or R is None:
+    if vk is None or R is None:
         return False
     S = int.from_bytes(signature[32:], "little")
     if S >= _L:
         return False  # malleability check, RFC 8032 §5.1.7
     k = _hash_to_scalar(signature[:32], public_key, message)
-    # S*B == R + k*A, compared in compressed form (projective equality).
-    return _compress(_mult(_B, S)) == _compress(_add(R, _mult(A, k)))
+    # S*B == R + k*A (projective equality — same accept set as the
+    # compressed compare, minus its two field inversions).
+    return _points_equal(_mult_base(S), _add(R, vk.mult(k)))
+
+
+def verify_batch(items) -> list[bool]:
+    """Verify ``[(public_key, message, signature), ...]`` as one batch.
+
+    Returns per-item verdicts identical to ``[verify(*it) for it in
+    items]`` (up to the 2^-128 RLC bound): structurally bad items are
+    rejected up front, the rest ride one random-linear-combination
+    check, and a failing batch fans back to per-item verification so a
+    single bad signature costs only its cohort's fast path, never its
+    cohort's verdicts. Terms sharing a public key collapse into one
+    scalar mult — the per-sender drain shape of the wire hot loop, where
+    a whole cohort usually carries ONE key."""
+    items = list(items)
+    ok = [False] * len(items)
+    parsed = []  # (index, vk, R, S, k, pk_bytes)
+    for i, (public_key, message, signature) in enumerate(items):
+        if len(public_key) != 32 or len(signature) != 64:
+            continue
+        pk = bytes(public_key)
+        vk = _verify_key(pk)
+        R = _decompress(signature[:32])
+        if vk is None or R is None:
+            continue
+        S = int.from_bytes(signature[32:], "little")
+        if S >= _L:
+            continue
+        k = _hash_to_scalar(signature[:32], pk, message)
+        parsed.append((i, vk, R, S, k, pk))
+    if not parsed:
+        return ok
+    if len(parsed) == 1:
+        i, vk, R, S, k, _ = parsed[0]
+        ok[i] = _points_equal(_mult_base(S), _add(R, vk.mult(k)))
+        return ok
+    # Random 128-bit coefficients: an adversary who cannot predict z
+    # passes the combined equation with probability 2^-128 unless every
+    # term holds individually.
+    rnd = os.urandom(16 * len(parsed))
+    z = [
+        int.from_bytes(rnd[16 * j : 16 * (j + 1)], "little") | 1
+        for j in range(len(parsed))
+    ]
+    s_sum = 0
+    a_coeff: dict[bytes, list] = {}  # pk -> [vk, scalar] (shared-key collapse)
+    r_pairs = []
+    for (i, vk, R, S, k, pk), zi in zip(parsed, z):
+        s_sum = (s_sum + zi * S) % _L
+        ent = a_coeff.get(pk)
+        if ent is None:
+            a_coeff[pk] = [vk, zi * k % _L]
+        else:
+            ent[1] = (ent[1] + zi * k) % _L
+        r_pairs.append((R, zi))
+    rhs = _msm(r_pairs)
+    for vk, c in a_coeff.values():
+        rhs = _add(rhs, vk.mult(c))
+    if _points_equal(_mult_base(s_sum), rhs):
+        for i, _vk, _R, _S, _k, _pk in parsed:
+            ok[i] = True
+        return ok
+    # Fan back: isolate the bad item(s) without changing any verdict.
+    for i, vk, R, S, k, _pk in parsed:
+        ok[i] = _points_equal(_mult_base(S), _add(R, vk.mult(k)))
+    return ok

@@ -61,6 +61,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("-peers", default="", help="comma-separated peer addresses")
     p.add_argument(
+        "-recv-shards",
+        type=int,
+        default=1,
+        metavar="N",
+        help="SO_REUSEPORT acceptor shards on the listen port (one "
+        "event-loop thread each, kernel-balanced, all feeding the one "
+        "shared dispatcher — docs/design.md §15); tcp only, default 1",
+    )
+    p.add_argument(
         "-backend",
         default="device",
         choices=["device", "numpy"],
@@ -251,7 +260,8 @@ def main(argv: list[str] | None = None) -> int:
     log.info("public key: %s", keys.public_key_hex())
 
     net = TCPNetwork(
-        host=args.host, port=args.port, keys=keys, protocol=args.protocol
+        host=args.host, port=args.port, keys=keys, protocol=args.protocol,
+        recv_shards=args.recv_shards,
     )
 
     def on_message(message: bytes, sender: PeerID) -> None:

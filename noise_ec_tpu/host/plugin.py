@@ -464,8 +464,16 @@ class ShardPlugin:
             key=trace_key(shards[0].file_signature),
             shards=len(shards),
         ):
-            for shard in shards:
-                network.broadcast(shard)
+            # One cohort call: the TCP transport coalesces the whole
+            # broadcast into a single SHARD_BATCH frame per peer flush
+            # (one signature, one verify, one sendmsg — design.md §15);
+            # transports without the hook keep per-shard semantics.
+            many = getattr(network, "broadcast_many", None)
+            if many is not None:
+                many(shards)
+            else:
+                for shard in shards:
+                    network.broadcast(shard)
         self.counters.add("shards_out", len(shards))
         self.counters.add("bytes_out", sum(len(s.shard_data) for s in shards))
         return shards
@@ -736,12 +744,13 @@ class ShardPlugin:
         # Transports without the hook — the loopback fake — are
         # unbuffered. The non-busy check is one short lock + int reads.
         waiter = getattr(network, "wait_writable", None)
+        many = getattr(network, "broadcast_many", None)
         with span("broadcast", key=trace_key(file_signature), chunks=count):
             for index, shares in self._encode_chunk_stream(chunks, k, n, B):
+                chunk_shards = []
+                chunk_bytes_ = 0
                 for s in shares:
-                    if waiter is not None:
-                        waiter(headroom=len(s.data) + 4096)
-                    shard = Shard(
+                    chunk_shards.append(Shard(
                         file_signature=file_signature,
                         shard_data=s.data,
                         shard_number=s.number,
@@ -750,10 +759,23 @@ class ShardPlugin:
                         stream_chunk_index=index,
                         stream_chunk_count=count,
                         stream_object_bytes=length,
-                    )
-                    network.broadcast(shard)
-                    shards_out += 1
-                    bytes_out += len(s.data)
+                    ))
+                    chunk_bytes_ += len(s.data)
+                if many is not None:
+                    # Whole-chunk cohort: one SHARD_BATCH frame per peer
+                    # flush. Backpressure waits once per chunk with the
+                    # chunk's own burst as headroom — the same guarantee
+                    # the per-share wait gave, at batch granularity.
+                    if waiter is not None:
+                        waiter(headroom=chunk_bytes_ + 4096 * len(shares))
+                    many(chunk_shards)
+                else:
+                    for shard in chunk_shards:
+                        if waiter is not None:
+                            waiter(headroom=len(shard.shard_data) + 4096)
+                        network.broadcast(shard)
+                shards_out += len(chunk_shards)
+                bytes_out += chunk_bytes_
         self.counters.add("stream_chunks_out", count)
         self.counters.add("shards_out", shards_out)
         self.counters.add("bytes_out", bytes_out)

@@ -17,6 +17,28 @@ implementations here share that contract:
 
 Both deliver messages to plugins through :class:`Ctx`, the slice of
 noise's ``PluginContext`` the reference uses (main.go:53-87).
+
+The TCP wire hot loop (docs/design.md §15) is built for six-figure
+msgs/s on the same three rules as the device data path (§12): re-use
+every buffer, move fewer bytes, amortize every dispatch —
+
+- **recv**: each connection is an :class:`asyncio.BufferedProtocol`
+  whose ``recv_into`` target is a per-connection :class:`_FrameRing`;
+  frames parse IN PLACE as memoryview slices (the ``_to_sym`` no-copy
+  discipline extended to the wire marshal) and the payload is copied
+  exactly once, into the ``Shard`` fields;
+- **verify**: frame signatures are not checked on the loop thread; the
+  digest is streamed from the ring views and the (key, digest, sig)
+  triple rides a per-sender verify queue whose drain batches cohorts
+  through ``crypto.verify_batch`` (per-item fan-back on batch failure)
+  on the dispatch pool;
+- **send**: a broadcast's shards coalesce into one ``SHARD_BATCH``
+  frame (one signature per cohort), frames queue as scatter-gather
+  buffer lists, and a peer flush is one ``sendmsg`` iovec syscall;
+- **scale**: ``recv_shards`` > 1 opens SO_REUSEPORT acceptor shards
+  (one event loop thread each, kernel-balanced) all feeding the ONE
+  shared :class:`_SerialDispatcher`, so per-peer DRR fairness and
+  per-sender ordering hold no matter which shard owns the socket.
 """
 
 from __future__ import annotations
@@ -24,6 +46,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import socket as _socket
 import struct
 import threading
 import time
@@ -99,14 +122,14 @@ class _TransportMetrics:
             )
         return pair
 
-    def record_in(self, peer: str, nbytes: int) -> None:
+    def record_in(self, peer: str, nbytes: int, count: int = 1) -> None:
         c, b = self._pair(self._in, self._shards_in, self._bytes_in, peer)
-        c.add(1)
+        c.add(count)
         b.add(nbytes)
 
-    def record_out(self, peer: str, nbytes: int) -> None:
+    def record_out(self, peer: str, nbytes: int, count: int = 1) -> None:
         c, b = self._pair(self._out, self._shards_out, self._bytes_out, peer)
-        c.add(1)
+        c.add(count)
         b.add(nbytes)
 
     def error(self, kind: str) -> None:
@@ -125,6 +148,287 @@ def transport_metrics() -> _TransportMetrics:
     if _transport_metrics is None:
         _transport_metrics = _TransportMetrics()
     return _transport_metrics
+
+
+class _WireMetrics:
+    """Cached children of the ``noise_ec_wire_*`` hot-loop families
+    (docs/design.md §15): batch-verify amortization, ring occupancy,
+    send-side syscall coalescing."""
+
+    def __init__(self):
+        reg = default_registry()
+        self._batch_size = reg.histogram(
+            "noise_ec_wire_verify_batch_size"
+        ).labels()
+        self._ok = reg.counter(
+            "noise_ec_wire_verified_frames_total"
+        ).labels(outcome="ok")
+        self._bad = reg.counter(
+            "noise_ec_wire_verified_frames_total"
+        ).labels(outcome="bad")
+        self._fallbacks = reg.counter(
+            "noise_ec_wire_verify_fallbacks_total"
+        ).labels()
+        self._per_syscall = reg.histogram(
+            "noise_ec_wire_frames_per_syscall"
+        ).labels()
+        self._saved = reg.counter(
+            "noise_ec_wire_syscalls_saved_total"
+        ).labels()
+        self._per_fill = reg.histogram(
+            "noise_ec_wire_frames_per_fill"
+        ).labels()
+        self._ring = reg.histogram("noise_ec_wire_ring_bytes").labels()
+        self._shards_per_frame = reg.histogram(
+            "noise_ec_wire_shards_per_frame"
+        ).labels()
+        self._recv_shards = reg.gauge("noise_ec_wire_recv_shards").labels()
+
+    def verify_batch(self, size: int, ok: int, fell_back: bool) -> None:
+        self._batch_size.observe(size)
+        if ok:
+            self._ok.add(ok)
+        if size - ok:
+            self._bad.add(size - ok)
+        if fell_back:
+            self._fallbacks.add(1)
+
+    def flush(self, frames: int, syscalls: int = 1) -> None:
+        self._per_syscall.observe(frames)
+        if frames > syscalls:
+            self._saved.add(frames - syscalls)
+
+    def fill(self, frames: int, ring_pending: int) -> None:
+        self._per_fill.observe(frames)
+        self._ring.observe(ring_pending)
+
+    def batch_out(self, shards: int) -> None:
+        self._shards_per_frame.observe(shards)
+
+    def set_recv_shards(self, n: int) -> None:
+        self._recv_shards.set(n)
+
+
+_wire_metrics: Optional[_WireMetrics] = None
+
+
+def wire_metrics() -> _WireMetrics:
+    global _wire_metrics
+    if _wire_metrics is None:
+        _wire_metrics = _WireMetrics()
+    return _wire_metrics
+
+
+class _FrameRing:
+    """Per-connection receive ring: ``recv_into`` lands bytes in the
+    tail, complete length-prefixed frames parse IN PLACE as memoryview
+    slices of the ring. The views are only valid until the next
+    :meth:`writable` call (compaction may slide the unread region), so
+    the frame consumer materializes what it keeps — which on the shard
+    path is exactly one copy, into the ``Shard`` fields.
+    """
+
+    __slots__ = ("buf", "rpos", "wpos")
+
+    MIN_RECV = 64 << 10  # smallest recv_into window we offer the kernel
+
+    def __init__(self, capacity: int = 256 << 10):
+        self.buf = bytearray(capacity)
+        self.rpos = 0
+        self.wpos = 0
+
+    def pending(self) -> int:
+        """Bytes received but not yet parsed (a straddling frame)."""
+        return self.wpos - self.rpos
+
+    def writable(self, sizehint: int = 0) -> memoryview:
+        """The writable tail as a memoryview ≥ max(sizehint, MIN_RECV)
+        bytes, compacting (or re-allocating, for an over-ring frame)
+        first when the tail ran out. Never called with live frame
+        views — the parse loop consumes them before the next fill."""
+        need = max(self.MIN_RECV, sizehint)
+        if len(self.buf) - self.wpos < need:
+            pend = self.wpos - self.rpos
+            if len(self.buf) - pend >= need and self.rpos:
+                # Slide the unread tail to the front (amortized: each
+                # byte moves at most once per ring traversal).
+                self.buf[:pend] = self.buf[self.rpos : self.wpos]
+            else:
+                # A single frame larger than the ring: move to a fresh,
+                # bigger buffer (a plain resize would fault on any
+                # still-exported view of the old one).
+                cap = max(len(self.buf) * 2, pend + need)
+                new = bytearray(cap)
+                new[:pend] = self.buf[self.rpos : self.wpos]
+                self.buf = new
+            self.rpos, self.wpos = 0, pend
+        return memoryview(self.buf)[self.wpos :]
+
+    def feed(self, nbytes: int) -> None:
+        self.wpos += nbytes
+
+    def feed_bytes(self, data: bytes) -> None:
+        """Copy-in fill for transports without a recv_into surface
+        (the KCP reader)."""
+        view = self.writable(len(data))
+        view[: len(data)] = data
+        view.release()
+        self.wpos += len(data)
+
+    def frames(self, max_frame: int):
+        """Yield every complete frame body as a memoryview; leaves a
+        partial frame (straddling the next fill) in place. Raises
+        WireError on an over-cap length prefix."""
+        mv = memoryview(self.buf)
+        try:
+            while self.wpos - self.rpos >= 4:
+                (ln,) = struct.unpack_from("<I", self.buf, self.rpos)
+                if ln > max_frame:
+                    raise WireError(f"frame length {ln} exceeds cap")
+                end = self.rpos + 4 + ln
+                if end > self.wpos:
+                    return
+                frame = mv[self.rpos + 4 : end]
+                self.rpos = end
+                yield frame
+            if self.rpos == self.wpos:
+                self.rpos = self.wpos = 0
+        finally:
+            mv.release()
+
+
+class _WireConn(asyncio.BufferedProtocol):
+    """One TCP connection of the wire hot loop.
+
+    Reader half: an ``asyncio.BufferedProtocol`` — the event loop
+    ``recv_into``s straight into this connection's :class:`_FrameRing`
+    (no intermediate bytes objects) and every complete frame is handed
+    to ``TCPNetwork._on_frame`` as an in-place memoryview.
+
+    Writer half: the StreamWriter-shaped facade the rest of the
+    transport already speaks (the ``KcpWriter`` duck type): ``write`` /
+    ``drain`` / ``close`` / ``transport.get_write_buffer_size``, plus
+    ``vectored_socket`` — the raw socket the flush path hands scatter-
+    gather frame lists to ``sendmsg`` when the transport buffer is
+    empty (one syscall per peer flush).
+    """
+
+    def __init__(self, net: "TCPNetwork", conn: "_Conn"):
+        self.net = net
+        self.conn = conn
+        self.ring = _FrameRing()
+        self.transport = None
+        self._wire_loop: Optional[asyncio.AbstractEventLoop] = None
+        self._sock = None
+        self._paused = False
+        self._drain_waiters: list[asyncio.Future] = []
+
+    # -- protocol callbacks (owning loop thread only) --
+
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+        self._wire_loop = asyncio.get_running_loop()
+        sock = transport.get_extra_info("socket")
+        # asyncio hands out a TransportSocket facade that deprecates
+        # sendmsg; the flush path needs the real socket underneath.
+        self._sock = getattr(sock, "_sock", sock)
+
+    def get_buffer(self, sizehint: int) -> memoryview:
+        return self.ring.writable(sizehint if sizehint > 0 else 0)
+
+    def buffer_updated(self, nbytes: int) -> None:
+        self.ring.feed(nbytes)
+        try:
+            count = 0
+            for frame in self.ring.frames(_MAX_FRAME):
+                count += 1
+                self.net._on_frame(frame, self, self.conn)
+            if count:
+                wire_metrics().fill(count, self.ring.pending())
+        except WireError as exc:
+            transport_metrics().error("wire")
+            self.net._record_error(exc)
+            self.transport.close()
+        except Exception as exc:  # noqa: BLE001 — isolate the loop
+            self.net._record_error(exc)
+            self.transport.close()
+
+    def eof_received(self) -> bool:
+        return False  # close on peer FIN, like the stream read loop
+
+    def connection_lost(self, exc) -> None:
+        for fut in self._drain_waiters:
+            if not fut.done():
+                fut.set_result(None)
+        self._drain_waiters.clear()
+        self.net._drop_writer(self)
+
+    def pause_writing(self) -> None:
+        self._paused = True
+
+    def resume_writing(self) -> None:
+        self._paused = False
+        for fut in self._drain_waiters:
+            if not fut.done():
+                fut.set_result(None)
+        self._drain_waiters.clear()
+
+    # -- StreamWriter facade --
+
+    @property
+    def vectored_socket(self):
+        """Raw socket for scatter-gather sendmsg flushes, or None when
+        the transport buffer is non-empty / paused / closing (then the
+        flush must ride the ordered transport buffer instead)."""
+        if (
+            self._sock is None
+            or self._paused
+            or self.transport is None
+            or self.transport.is_closing()
+            or self.transport.get_write_buffer_size() > 0
+        ):
+            return None
+        return self._sock
+
+    def write(self, data) -> None:
+        self.transport.write(data)
+
+    def writelines(self, bufs) -> None:
+        self.transport.writelines(bufs)
+
+    async def drain(self) -> None:
+        if self.transport is None or self.transport.is_closing():
+            raise ConnectionResetError("connection lost")
+        if not self._paused:
+            return
+        fut = self._wire_loop.create_future()
+        self._drain_waiters.append(fut)
+        await fut
+
+    def close(self) -> None:
+        t = self.transport
+        if t is None:
+            return
+        loop = self._wire_loop
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if loop is None or running is loop:
+            t.close()
+        else:
+            # transports are not thread-safe; route cross-thread closes
+            # (network.close(), _drop_writer from a dispatch worker)
+            # through the owning loop.
+            loop.call_soon_threadsafe(t.close)
+
+    def is_closing(self) -> bool:
+        return self.transport is None or self.transport.is_closing()
+
+    def get_extra_info(self, name, default=None):
+        if self.transport is None:
+            return default
+        return self.transport.get_extra_info(name, default)
 
 
 class Ctx:
@@ -292,6 +596,15 @@ class LoopbackNetwork:
             wire = msg.marshal()
         self.hub.fan_out(self, wire)
 
+    def broadcast_many(self, msgs) -> None:
+        """Cohort broadcast, one delivery per shard: the loopback keeps
+        per-shard fan-out so the fault injector's per-delivery model
+        (drop/duplicate/corrupt/reorder one SHARD at a time) is
+        unchanged; only the TCP transport coalesces cohorts into
+        SHARD_BATCH frames."""
+        for msg in msgs:
+            self.broadcast(msg)
+
     def deliver(self, wire_bytes: bytes, sender: PeerID) -> None:
         """Hub-side delivery: decode and dispatch to every plugin in
         registration order. Decode/dispatch errors are recorded, not
@@ -331,8 +644,16 @@ _OP_HELLO_REPLY = 3  # acceptor -> dialer: payload = dialer_nonce ‖ acceptor_n
 _OP_HELLO_ACK = 4    # dialer -> acceptor: payload = acceptor_nonce
 _OP_SHARD = 2        # payload = marshaled Shard
 _OP_PEERS = 5        # payload = u32 count | count x (u32 len | addr utf-8)
+# One broadcast's shard cohort in ONE signed frame (docs/design.md §15):
+# payload = u32 count | count x (u32 len | marshaled Shard). One Ed25519
+# sign on the send side and one (batched) verify on the receive side
+# cover the whole cohort, where _OP_SHARD paid one of each per shard.
+_OP_SHARD_BATCH = 6
 _MAX_FRAME = 64 << 20
 _NONCE_LEN = 32
+# Keep one SHARD_BATCH frame's coalescing win without queueing a
+# multi-second head-of-line blob behind it: cohorts above this split.
+_MAX_BATCH_FRAME = 8 << 20
 
 
 def _sign_preimage(opcode: int, addr: bytes, payload: bytes) -> bytes:
@@ -345,6 +666,48 @@ def _sign_preimage(opcode: int, addr: bytes, payload: bytes) -> bytes:
             payload,
         ]
     )
+
+
+def _encode_shard_batch_parts(msgs) -> list:
+    """SHARD_BATCH payload as scatter-gather parts: each shard's
+    ``marshal_parts`` buffers ride through unjoined, so the dominant
+    ``shard_data`` is never copied on the send path."""
+    parts = [struct.pack("<I", len(msgs))]
+    for m in msgs:
+        head, data, tail = m.marshal_parts()
+        parts.append(
+            struct.pack("<I", len(head) + len(data) + len(tail))
+        )
+        if head:
+            parts.append(head)
+        if data:
+            parts.append(data)
+        if tail:
+            parts.append(tail)
+    return parts
+
+
+def _decode_shard_batch(payload) -> list[Shard]:
+    """Parse a SHARD_BATCH payload (bytes or an in-place ring view)."""
+    if len(payload) < 4:
+        raise WireError("truncated shard batch")
+    (count,) = struct.unpack_from("<I", payload, 0)
+    if count > 4096:
+        raise WireError(f"shard batch count {count} exceeds cap")
+    pos = 4
+    out = []
+    for _ in range(count):
+        if pos + 4 > len(payload):
+            raise WireError("truncated shard batch")
+        (ln,) = struct.unpack_from("<I", payload, pos)
+        pos += 4
+        if pos + ln > len(payload):
+            raise WireError("truncated shard batch")
+        out.append(Shard.unmarshal(payload[pos : pos + ln]))
+        pos += ln
+    if pos != len(payload):
+        raise WireError("trailing bytes in shard batch")
+    return out
 
 
 def _encode_peer_list(addresses: list[str]) -> bytes:
@@ -608,6 +971,7 @@ class TCPNetwork:
         max_discovered_peers: int = 64,
         discovery_interval: float = 2.0,
         reconnect: bool = True,
+        recv_shards: int = 1,
     ):
         """Tuning knobs default to the reference's builder options
         (/root/reference/main.go:27-33): connection timeout 60s, recv/send
@@ -641,6 +1005,14 @@ class TCPNetwork:
         dialed triggers supervised re-dial with exponential backoff +
         full jitter, gated by a per-peer circuit breaker fed by dial
         failures and write-timeout disconnects.
+
+        ``recv_shards`` > 1 opens that many SO_REUSEPORT acceptor shards
+        on the listen port — one extra event-loop thread per shard, the
+        kernel balancing inbound connections across them — all feeding
+        the ONE shared dispatcher, so a single Python loop thread stops
+        being the receive ceiling while per-sender ordering and DRR
+        fairness are untouched (docs/design.md §15). TCP only; clamped
+        to 1 where SO_REUSEPORT is unavailable.
         """
         if protocol not in ("tcp", "kcp"):
             raise ValueError(
@@ -687,8 +1059,26 @@ class TCPNetwork:
             max_workers=4, max_queue=recv_window,
             on_error=self._record_error,
         )
-        # Write coalescing state — touched only on the event-loop thread.
+        # Deferred frame verification (docs/design.md §15): the loop
+        # thread parses and digests; cohorts drain through verify_batch
+        # on the dispatch pool, keyed (and ordered) per sender.
+        self._verify_q: dict[bytes, deque] = {}
+        self._verify_scheduled: set[bytes] = set()
+        self._verify_lock = threading.Lock()
+        # SO_REUSEPORT acceptor shards (extra loops started by listen()).
+        if recv_shards > 1 and (
+            protocol != "tcp" or not hasattr(_socket, "SO_REUSEPORT")
+        ):
+            recv_shards = 1
+        self.recv_shards = max(1, int(recv_shards))
+        self._shard_loops: list[tuple[asyncio.AbstractEventLoop,
+                                      threading.Thread]] = []
+        self._shard_servers: list[asyncio.AbstractServer] = []
+        # Write coalescing state. Each writer's entries are only touched
+        # on that writer's OWNING loop thread (per-connection with
+        # recv_shards > 1); distinct keys make the dicts safe to share.
         self._pending: dict[asyncio.StreamWriter, list[bytes]] = {}
+        self._pending_frames: dict[asyncio.StreamWriter, int] = {}
         self._pending_bytes: dict[asyncio.StreamWriter, int] = {}
         # Bytes posted cross-thread (broadcast -> call_soon queue) but not
         # yet seen by _enqueue_frame; guarded by self._lock. Part of the
@@ -734,10 +1124,42 @@ class TCPNetwork:
             format_address(self.protocol, self.host, self.port),
             self.keys.public_key,
         )
+        # SO_REUSEPORT acceptor shards: the main server bound the
+        # (possibly ephemeral) port with the flag set, so shard sockets
+        # can join it and the kernel hashes inbound connections across
+        # the whole group. Each shard is one extra daemon loop thread
+        # accepting + parsing + digesting; everything downstream (verify
+        # drains, plugin dispatch) already runs on the shared pool.
+        wire_metrics().set_recv_shards(self.recv_shards)
+        for i in range(1, self.recv_shards):
+            loop = asyncio.new_event_loop()
+            t = threading.Thread(
+                target=loop.run_forever, daemon=True,
+                name=f"noise-ec-recv-{i}",
+            )
+            t.start()
+            self._shard_loops.append((loop, t))
+            fut = asyncio.run_coroutine_threadsafe(
+                self._start_shard_server(), loop
+            )
+            self._shard_servers.append(fut.result(timeout=10))
         if self.discovery and self.discovery_interval > 0:
             def _start_gossip():
                 self._gossip_task = self._loop.create_task(self._gossip_loop())
             self._loop.call_soon_threadsafe(_start_gossip)
+
+    async def _start_shard_server(self):
+        loop = asyncio.get_running_loop()
+        return await loop.create_server(
+            lambda: _WireConn(self, _Conn()), self.host, self.port,
+            reuse_port=True,
+        )
+
+    def _writer_loop(self, writer) -> asyncio.AbstractEventLoop:
+        """The event loop that owns ``writer``'s transport (shard conns
+        live on their acceptor shard's loop; everything else on the
+        main loop)."""
+        return getattr(writer, "_wire_loop", None) or self._loop
 
     async def _gossip_loop(self) -> None:
         """Periodic full-peer-list re-gossip (see ``discovery_interval``).
@@ -768,7 +1190,12 @@ class TCPNetwork:
             from noise_ec_tpu.host.kcp import start_kcp_server
 
             return await start_kcp_server(self._handle_conn, self.host, self.port)
-        return await asyncio.start_server(self._handle_conn, self.host, self.port)
+        # TCP accepts ride the BufferedProtocol recv_into path, not
+        # StreamReader (docs/design.md §15).
+        return await self._loop.create_server(
+            lambda: _WireConn(self, _Conn()), self.host, self.port,
+            reuse_port=True if self.recv_shards > 1 else None,
+        )
 
     def bootstrap(self, peer_addresses: list[str]) -> None:
         """Dial out to peers (net.Bootstrap, main.go:171-173). Blocks until
@@ -795,16 +1222,26 @@ class TCPNetwork:
             if self._gossip_task is not None:
                 self._gossip_task.cancel()
                 self._gossip_task = None
-            for h in self._flush_handles.values():
-                h.cancel()
-            self._flush_handles.clear()
             for w in list(self._pending):
-                self._flush_writer(w)  # best-effort final flush
+                # Best-effort final flush, on each writer's owning loop
+                # (flush touches per-writer coalesce state, which is
+                # loop-affine under recv_shards > 1).
+                loop = self._writer_loop(w)
+                if loop is self._loop:
+                    self._flush_writer(w)
+                else:
+                    loop.call_soon_threadsafe(self._flush_writer, w)
             for peer in list(self.peers.values()):
-                peer.writer.close()
+                peer.writer.close()  # _WireConn.close is thread-safe
 
         if self._thread.is_alive():
             asyncio.run_coroutine_threadsafe(_shutdown(), self._loop).result(timeout=5)
+            for (loop, _), server in zip(self._shard_loops,
+                                         self._shard_servers):
+                loop.call_soon_threadsafe(server.close)
+            for loop, thread in self._shard_loops:
+                loop.call_soon_threadsafe(loop.stop)
+                thread.join(timeout=5)
             self._loop.call_soon_threadsafe(self._loop.stop)
             self._thread.join(timeout=5)
         self._dispatch.shutdown(wait=True)
@@ -830,42 +1267,75 @@ class TCPNetwork:
 
     # --------------------------------------------------------------- wire
 
-    def _frame(self, opcode: int, payload: bytes) -> bytes:
+    def _frame_parts(self, opcode: int, payload_parts) -> tuple[list, int]:
+        """One signed frame as scatter-gather buffer parts.
+
+        ``b"".join(parts)`` is byte-identical to ``_frame(opcode,
+        join(payload_parts))`` (Ed25519 is deterministic and the
+        signing hash streams the parts), but the payload buffers —
+        shard_data above all — are never copied into a joined frame:
+        they travel as iovecs down to the ``sendmsg`` flush. Returns
+        (parts, total frame bytes)."""
         addr = self.id.address.encode()
-        sig = self.keys.sign(
-            self._sig, self._hash, _sign_preimage(opcode, addr, payload)
+        plen = sum(len(p) for p in payload_parts)
+        pre_head = (
+            bytes([opcode]) + struct.pack("<I", len(addr)) + addr
         )
-        body = b"".join(
-            [
-                bytes([opcode]),
-                struct.pack("<I", len(addr)),
-                addr,
-                self.keys.public_key,
-                struct.pack("<I", len(payload)),
-                payload,
-                sig,
-            ]
+        plen_b = struct.pack("<I", plen)
+        sig = self.keys.sign_parts(
+            self._sig, self._hash, (pre_head, plen_b, *payload_parts)
         )
-        return struct.pack("<I", len(body)) + body
+        body_len = len(pre_head) + 32 + 4 + plen + 64
+        head = (
+            struct.pack("<I", body_len)
+            + pre_head
+            + self.keys.public_key
+            + plen_b
+        )
+        parts = [head]
+        parts.extend(p for p in payload_parts if len(p))
+        parts.append(sig)
+        return parts, 4 + body_len
+
+    def _frame(self, opcode: int, payload: bytes) -> bytes:
+        parts, _ = self._frame_parts(opcode, (payload,))
+        return b"".join(parts)
 
     @staticmethod
-    def _parse_frame(body: bytes) -> tuple[int, PeerID, bytes, bytes]:
-        """Returns (opcode, sender_pid, payload, signature)."""
+    def _parse_frame_fields(body) -> tuple[int, bytes, bytes, object, bytes]:
+        """Parse a frame body (bytes or an in-place ring memoryview) to
+        (opcode, addr utf-8 bytes, pubkey, payload, signature). The
+        payload keeps the caller's buffer type — a view stays a view —
+        so the shard path can digest + unmarshal without a whole-frame
+        copy; everything else is materialized (it is tiny)."""
         pos = 0
+        if len(body) < 5:
+            raise WireError("truncated frame")
         opcode = body[pos]; pos += 1
         (alen,) = struct.unpack_from("<I", body, pos); pos += 4
-        addr = body[pos : pos + alen].decode(); pos += alen
-        pubkey = body[pos : pos + 32]; pos += 32
+        addr = bytes(body[pos : pos + alen]); pos += alen
+        pubkey = bytes(body[pos : pos + 32]); pos += 32
+        if pos + 4 > len(body):
+            raise WireError("truncated frame")
         (plen,) = struct.unpack_from("<I", body, pos); pos += 4
         payload = body[pos : pos + plen]; pos += plen
-        sig = body[pos : pos + 64]
-        if len(pubkey) != 32 or len(payload) != plen or len(sig) != 64:
+        sig = bytes(body[pos : pos + 64])
+        if len(addr) != alen or len(pubkey) != 32 or len(payload) != plen \
+                or len(sig) != 64:
             raise WireError("truncated frame")
         if pos + 64 != len(body):
             # No unauthenticated trailing bytes: the signature must be the
             # last 64 bytes of the body, exactly.
             raise WireError("trailing bytes after frame signature")
-        return opcode, PeerID.create(addr, pubkey), payload, sig
+        return opcode, addr, pubkey, payload, sig
+
+    @staticmethod
+    def _parse_frame(body: bytes) -> tuple[int, PeerID, bytes, bytes]:
+        """Returns (opcode, sender_pid, payload, signature)."""
+        opcode, addr, pubkey, payload, sig = TCPNetwork._parse_frame_fields(
+            body
+        )
+        return opcode, PeerID.create(addr.decode(), pubkey), bytes(payload), sig
 
     # ------------------------------------------------------------ dataflow
 
@@ -876,12 +1346,55 @@ class TCPNetwork:
         within ``write_flush_latency`` batch into one socket write (noise's
         WriteFlushLatency semantics)."""
         with span("wire_encode", key=trace_key(msg.file_signature)):
-            frame = self._frame(_OP_SHARD, msg.marshal())
+            parts, nbytes = self._frame_parts(
+                _OP_SHARD, msg.marshal_parts()
+            )
+        self._post_frame(parts, nbytes, shards=1)
+
+    def broadcast_many(self, msgs) -> None:
+        """Broadcast a cohort of shards — one encode call's output, a
+        stream chunk's shares — as SHARD_BATCH frames: the whole cohort
+        costs ONE Ed25519 sign here and one (batched) verify per
+        receiver, and its buffers ride one sendmsg flush per peer
+        (docs/design.md §15). Order within the cohort is preserved;
+        semantics per shard are exactly ``broadcast``'s."""
+        msgs = list(msgs)
+        if not msgs:
+            return
+        if len(msgs) == 1:
+            self.broadcast(msgs[0])
+            return
+        # Split oversized cohorts so one frame never exceeds the batch
+        # cap (the receive ring handles them either way, but a multi-
+        # tens-of-MiB frame is a head-of-line blob for the peer).
+        start = 0
+        while start < len(msgs):
+            group = []
+            group_bytes = 0
+            while start < len(msgs) and (
+                not group or group_bytes + msgs[start].size() <= _MAX_BATCH_FRAME
+            ):
+                group_bytes += msgs[start].size() + 4
+                group.append(msgs[start])
+                start += 1
+            if len(group) == 1:
+                self.broadcast(group[0])
+                continue
+            with span("wire_encode", key=trace_key(group[0].file_signature)):
+                parts, nbytes = self._frame_parts(
+                    _OP_SHARD_BATCH, _encode_shard_batch_parts(group)
+                )
+            wire_metrics().batch_out(len(group))
+            self._post_frame(parts, nbytes, shards=len(group))
+
+    def _post_frame(self, parts: list, nbytes: int, shards: int) -> None:
+        """Hand one built frame (scatter-gather parts) to every peer's
+        owning loop for coalescing + flush."""
         metrics = transport_metrics()
         with self._lock:
             writers = [p.writer for p in self.peers.values()]
             for p in self.peers.values():
-                metrics.record_out(p.pid.address, len(frame))
+                metrics.record_out(p.pid.address, nbytes, count=shards)
             # Count the bytes as posted BEFORE handing them to the loop
             # thread: a frame sitting in call_soon_threadsafe's queue is
             # visible to neither the kernel buffer nor the coalesce
@@ -891,10 +1404,12 @@ class TCPNetwork:
             # waiting on a loaded single-core host).
             for w in writers:
                 self._posted_bytes[w] = (
-                    self._posted_bytes.get(w, 0) + len(frame)
+                    self._posted_bytes.get(w, 0) + nbytes
                 )
         for w in writers:
-            self._loop.call_soon_threadsafe(self._enqueue_frame, w, frame)
+            self._writer_loop(w).call_soon_threadsafe(
+                self._enqueue_frames, w, parts, 1, nbytes
+            )
 
     def send_to(self, public_key: bytes, msg: Shard) -> bool:
         """Send one signed shard frame to a single registered peer
@@ -907,13 +1422,15 @@ class TCPNetwork:
                 return False
             writer = peer.writer
             address = peer.pid.address
-        frame = self._frame(_OP_SHARD, msg.marshal())
-        transport_metrics().record_out(address, len(frame))
+        parts, nbytes = self._frame_parts(_OP_SHARD, msg.marshal_parts())
+        transport_metrics().record_out(address, nbytes)
         with self._lock:
             self._posted_bytes[writer] = (
-                self._posted_bytes.get(writer, 0) + len(frame)
+                self._posted_bytes.get(writer, 0) + nbytes
             )
-        self._loop.call_soon_threadsafe(self._enqueue_frame, writer, frame)
+        self._writer_loop(writer).call_soon_threadsafe(
+            self._enqueue_frames, writer, parts, 1, nbytes
+        )
         return True
 
     def wait_writable(
@@ -940,8 +1457,11 @@ class TCPNetwork:
         caller proceeds — a genuinely stalled peer is then the hard
         cap's and write_timeout's job to drop.
         """
-        if threading.get_ident() == self._thread.ident:
-            # Called on the event-loop thread: the drain this would wait
+        ident = threading.get_ident()
+        if ident == self._thread.ident or any(
+            ident == t.ident for _, t in self._shard_loops
+        ):
+            # Called on an event-loop thread: the drain this would wait
             # for runs ON this thread, so blocking here deadlocks until
             # the timeout with zero progress. No current caller does this
             # (the stream emitter runs on the producer's thread); the
@@ -978,10 +1498,15 @@ class TCPNetwork:
 
     # -- write path (event-loop thread only) --
 
-    def _enqueue_frame(self, writer: asyncio.StreamWriter, frame: bytes) -> None:
-        """Coalesce ``frame`` into the peer's pending batch; flush when the
-        batch reaches ``write_buffer_size`` bytes or ``send_window`` frames,
-        otherwise after ``write_flush_latency``."""
+    def _enqueue_frames(
+        self, writer: asyncio.StreamWriter, parts: list, nframes: int,
+        nbytes: int,
+    ) -> None:
+        """Coalesce one frame's scatter-gather ``parts`` into the peer's
+        pending buffer list; flush when the batch reaches
+        ``write_buffer_size`` bytes or ``send_window`` frames, otherwise
+        after ``write_flush_latency``. Runs on the writer's owning
+        loop."""
         if writer.transport.get_write_buffer_size() > self.MAX_PEER_WRITE_BUFFER:
             self._drop_writer(writer)  # also clears _posted_bytes
             self._record_error(
@@ -989,39 +1514,46 @@ class TCPNetwork:
             )
             return
         pend = self._pending.setdefault(writer, [])
-        pend.append(frame)
-        total = self._pending_bytes.get(writer, 0) + len(frame)
+        pend.extend(parts)
+        frames = self._pending_frames.get(writer, 0) + nframes
+        self._pending_frames[writer] = frames
+        total = self._pending_bytes.get(writer, 0) + nbytes
         self._pending_bytes[writer] = total
         with self._lock:
             # Decrement the cross-thread posted counter only AFTER the
             # bytes are visible in the coalesce batch: the backpressure
             # waiter must always see in-flight bytes counted SOMEWHERE
             # (posted -> pending -> transport buffer, in that order).
-            left = self._posted_bytes.get(writer, 0) - len(frame)
+            left = self._posted_bytes.get(writer, 0) - nbytes
             if left > 0:
                 self._posted_bytes[writer] = left
             else:
                 self._posted_bytes.pop(writer, None)
-        if total >= self.write_buffer_size or len(pend) >= self.send_window:
+        if total >= self.write_buffer_size or frames >= self.send_window:
             self._flush_writer(writer)
         elif writer not in self._flush_handles:
-            self._flush_handles[writer] = self._loop.call_later(
-                self.write_flush_latency, self._flush_writer, writer
-            )
+            self._flush_handles[writer] = self._writer_loop(
+                writer
+            ).call_later(self.write_flush_latency, self._flush_writer, writer)
+
+    # sendmsg iovec budget per syscall: Linux UIO_MAXIOV is 1024; stay
+    # under it and let oversized batches fall back to the joined write.
+    _SENDMSG_MAX_BUFS = 512
 
     def _flush_writer(self, writer: asyncio.StreamWriter) -> None:
         handle = self._flush_handles.pop(writer, None)
         if handle is not None:
             handle.cancel()
         pend = self._pending.pop(writer, None)
+        nframes = self._pending_frames.pop(writer, 0)
         if not pend:
             self._pending_bytes.pop(writer, None)
             return
         try:
-            # _pending_bytes is cleared only after write() lands the batch
-            # in the transport buffer, so the backpressure waiter never
-            # sees the bytes vanish from both counters at once.
-            writer.write(b"".join(pend))
+            # _pending_bytes is cleared only after the batch lands in the
+            # socket or the transport buffer, so the backpressure waiter
+            # never sees the bytes vanish from both counters at once.
+            self._write_vectored(writer, pend, nframes)
         except Exception as exc:  # noqa: BLE001
             self._record_error(exc)
             return
@@ -1032,9 +1564,44 @@ class TCPNetwork:
         # a single drain waiter).
         if writer not in self._draining:
             self._draining.add(writer)
-            task = self._loop.create_task(self._drain_writer(writer))
+            loop = self._writer_loop(writer)
+            task = loop.create_task(self._drain_writer(writer))
             self._tasks.add(task)
             task.add_done_callback(self._tasks.discard)
+
+    def _write_vectored(self, writer, bufs: list, nframes: int) -> None:
+        """Flush a coalesced buffer list: ONE ``sendmsg`` iovec syscall
+        when the transport buffer is empty (the steady state — the
+        kernel buffer drains between flushes), the ordered transport
+        buffer otherwise. Frames-per-syscall and syscalls-saved feed
+        the ``noise_ec_wire_*`` families either way (a joined
+        transport write is still one syscall's worth of coalescing)."""
+        sock = getattr(writer, "vectored_socket", None)
+        if sock is not None and len(bufs) > 1 and len(bufs) <= self._SENDMSG_MAX_BUFS:
+            total = sum(len(b) for b in bufs)
+            try:
+                sent = sock.sendmsg(bufs)
+            except (BlockingIOError, InterruptedError):
+                sent = 0
+            except OSError as exc:
+                self._record_error(exc)
+                self._drop_writer(writer)
+                return
+            if sent < total:
+                # Kernel buffer filled mid-iovec: hand the tail to the
+                # transport buffer (which backpressures + drains).
+                rest = []
+                for b in bufs:
+                    if sent >= len(b):
+                        sent -= len(b)
+                        continue
+                    rest.append(b[sent:] if sent else b)
+                    sent = 0
+                writer.transport.writelines(rest)
+            wire_metrics().flush(nframes, syscalls=1)
+            return
+        writer.write(b"".join(bufs))
+        wire_metrics().flush(nframes, syscalls=1)
 
     async def _drain_writer(self, writer: asyncio.StreamWriter) -> None:
         try:
@@ -1054,7 +1621,24 @@ class TCPNetwork:
             self._draining.discard(writer)
 
     def _write_safe(self, writer: asyncio.StreamWriter, frame: bytes) -> None:
-        """Immediate (uncoalesced) write — handshake/control frames."""
+        """Immediate (uncoalesced) write — handshake/control frames.
+
+        Cross-loop callers (gossip / register announcing to a peer whose
+        connection lives on another acceptor shard) are routed to the
+        writer's owning loop; writers without one (the KCP facade, unit-
+        test fakes) write inline, exactly as before."""
+        loop = getattr(writer, "_wire_loop", None)
+        if loop is not None:
+            try:
+                running = asyncio.get_running_loop()
+            except RuntimeError:
+                running = None
+            if running is not loop:
+                loop.call_soon_threadsafe(self._write_safe_here, writer, frame)
+                return
+        self._write_safe_here(writer, frame)
+
+    def _write_safe_here(self, writer, frame: bytes) -> None:
         if writer.transport.get_write_buffer_size() > self.MAX_PEER_WRITE_BUFFER:
             # A stalled reader must not grow sender memory without bound.
             self._drop_writer(writer)
@@ -1083,6 +1667,7 @@ class TCPNetwork:
         if handle is not None:
             handle.cancel()
         self._pending.pop(writer, None)
+        self._pending_frames.pop(writer, None)
         self._pending_bytes.pop(writer, None)
         with self._lock:
             self._posted_bytes.pop(writer, None)
@@ -1107,32 +1692,48 @@ class TCPNetwork:
                 return
         self._dialing.add(address)
         host, port = self._split(address)
-        if address.startswith("kcp://") or (
+        conn = _Conn(is_dialer=True, dial_address=address)
+        is_kcp = address.startswith("kcp://") or (
             "://" not in address and self.protocol == "kcp"
-        ):
-            from noise_ec_tpu.host.kcp import open_kcp_connection as opener
-        else:
-            opener = asyncio.open_connection
+        )
         try:
-            # (For kcp the opener returns without any network round trip;
-            # the real unreachable-peer bound is conn.registered.wait
-            # below.)
-            reader, writer = await asyncio.wait_for(
-                opener(host, port), timeout=self.connection_timeout
-            )
+            if is_kcp:
+                from noise_ec_tpu.host.kcp import open_kcp_connection
+
+                # (The kcp opener returns without any network round trip;
+                # the real unreachable-peer bound is conn.registered.wait
+                # below.)
+                reader, writer = await asyncio.wait_for(
+                    open_kcp_connection(host, port),
+                    timeout=self.connection_timeout,
+                )
+            else:
+                # TCP dials ride the same BufferedProtocol recv_into
+                # path as accepted connections; the protocol IS the
+                # writer facade.
+                loop = asyncio.get_running_loop()
+                _transport, writer = await asyncio.wait_for(
+                    loop.create_connection(
+                        lambda: _WireConn(self, conn), host, port
+                    ),
+                    timeout=self.connection_timeout,
+                )
+                reader = None
         except Exception:
             # Refund the dedup slot: a failed dial (bootstrap races the
             # peer's startup, say) must not block discovery from ever
             # dialing this address again.
             self._dialing.discard(address)
             raise
-        conn = _Conn(is_dialer=True, dial_address=address)
         try:
             t_hello = time.perf_counter()
             writer.write(self._frame(_OP_HELLO, conn.nonce))
-            task = asyncio.create_task(self._read_loop(reader, writer, conn))
-            self._tasks.add(task)
-            task.add_done_callback(self._tasks.discard)
+            if reader is not None:
+                task = asyncio.create_task(
+                    self._read_loop(reader, writer, conn)
+                )
+                self._tasks.add(task)
+                task.add_done_callback(self._tasks.discard)
             # Block until the HELLO_REPLY echoes our nonce and the peer is
             # registered; tear the connection down on timeout so a silent
             # acceptor does not leak a socket per bootstrap attempt.
@@ -1179,20 +1780,29 @@ class TCPNetwork:
         # The dialer initiates; we answer its HELLO from the read loop.
         await self._read_loop(reader, writer, _Conn())
 
+    # Bulk read size for transports without a recv_into surface (KCP):
+    # one await + one ring fill per chunk instead of two per frame.
+    READ_CHUNK = 256 << 10
+
     async def _read_loop(
         self,
         reader: asyncio.StreamReader,
         writer: asyncio.StreamWriter,
         conn: _Conn,
     ) -> None:
+        ring = _FrameRing()
         try:
             while True:
-                hdr = await reader.readexactly(4)
-                (ln,) = struct.unpack("<I", hdr)
-                if ln > _MAX_FRAME:
-                    raise WireError(f"frame length {ln} exceeds cap")
-                body = await reader.readexactly(ln)
-                self._on_frame(body, writer, conn)
+                data = await reader.read(self.READ_CHUNK)
+                if not data:
+                    break
+                ring.feed_bytes(data)
+                count = 0
+                for frame in ring.frames(_MAX_FRAME):
+                    count += 1
+                    self._on_frame(frame, writer, conn)
+                if count:
+                    wire_metrics().fill(count, ring.pending())
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         except Exception as exc:  # noqa: BLE001
@@ -1271,20 +1881,70 @@ class TCPNetwork:
                 self._write_safe(p.writer, announce)
 
     def _on_frame(
-        self, body: bytes, writer: asyncio.StreamWriter, conn: _Conn
+        self, body, writer: asyncio.StreamWriter, conn: _Conn
     ) -> None:
+        """One parsed frame off the wire. ``body`` may be an in-place
+        ring memoryview — anything kept past this call is materialized
+        here. Runs on the connection's owning loop thread; the shard
+        path defers its Ed25519 work to the batched verify stage so the
+        loop thread never pays per-frame crypto (docs/design.md §15)."""
         metrics = transport_metrics()
         try:
-            opcode, pid, payload, sig = self._parse_frame(body)
+            opcode, addr_b, pubkey, payload, sig = self._parse_frame_fields(
+                body
+            )
+            addr = addr_b.decode()
         except (WireError, IndexError, struct.error, UnicodeDecodeError) as exc:
             metrics.error("wire")
             self._record_error(WireError(f"bad frame: {exc}"))
             return
+
+        if opcode in (_OP_SHARD, _OP_SHARD_BATCH):
+            # Only registered connections may deliver shards, and the
+            # frame identity must match the handshake identity — checked
+            # BEFORE any crypto, so an unregistered socket costs a dict
+            # miss, not a verify.
+            peer = conn.peer
+            if peer is None or pubkey != peer.public_key:
+                metrics.error("unregistered")
+                self._record_error(
+                    WireError(f"shard from unregistered connection ({addr})")
+                )
+                return
+            # Reuse the handshake PeerID in the steady state (same key,
+            # same claimed address) instead of re-hashing a node id per
+            # frame; a frame claiming a different address still verifies
+            # against its own claim.
+            pid = peer if addr == peer.address else PeerID.create(addr, pubkey)
+            # Digest on the loop thread while the ring view is alive:
+            # the preimage streams through the hash in parts, so the
+            # payload is never joined into a fresh buffer.
+            digest = self._hash.hash_parts((
+                bytes([opcode]),
+                struct.pack("<I", len(addr_b)),
+                addr_b,
+                struct.pack("<I", len(payload)),
+                payload,
+            ))
+            try:
+                if opcode == _OP_SHARD:
+                    msgs = [Shard.unmarshal(payload)]
+                else:
+                    msgs = _decode_shard_batch(payload)
+            except WireError as exc:
+                metrics.error("wire")
+                self._record_error(exc)
+                return
+            self._submit_verify(pid, digest, sig, msgs, len(body) + 4)
+            return
+
+        # Control frames (handshake, gossip): rare and loop-affine —
+        # verified inline, exactly as before.
+        payload = bytes(payload)
+        pid = PeerID.create(addr, pubkey)
         if not self._sig.verify(
-            pid.public_key,
-            self._hash.hash_bytes(
-                _sign_preimage(opcode, pid.address.encode(), payload)
-            ),
+            pubkey,
+            self._hash.hash_bytes(_sign_preimage(opcode, addr_b, payload)),
             sig,
         ):
             metrics.error("signature")
@@ -1359,33 +2019,97 @@ class TCPNetwork:
                 self._tasks.add(task)
                 task.add_done_callback(self._tasks.discard)
             return
-        if opcode == _OP_SHARD:
-            # Only registered connections may deliver shards, and the frame
-            # identity must match the handshake identity.
-            if conn.peer is None or pid.public_key != conn.peer.public_key:
-                metrics.error("unregistered")
-                self._record_error(
-                    WireError(f"shard from unregistered connection ({pid.address})")
+    # Frames per verify cohort: matches the dispatcher's DRAIN_BATCH
+    # scale so one drain's inline plugin work stays within the fairness
+    # quantum; the batch-verify curve is flat past ~16 anyway.
+    VERIFY_DRAIN_MAX = 16
+
+    def _submit_verify(
+        self, pid: PeerID, digest: bytes, sig: bytes, msgs: list, nbytes: int
+    ) -> None:
+        """Queue parsed-but-unverified frames for the per-sender batched
+        verify drain. Bounded by ``recv_window`` per sender (the same
+        budget the dispatch queue enforces) — overflow drops the frame
+        and counts it, never blocks the loop thread."""
+        key = pid.public_key
+        schedule = False
+        overflow = False
+        with self._verify_lock:
+            q = self._verify_q.get(key)
+            if q is None:
+                q = self._verify_q[key] = deque()
+            if len(q) >= self.recv_window:
+                overflow = True
+            else:
+                q.append((pid, digest, sig, msgs, nbytes))
+                if key not in self._verify_scheduled:
+                    self._verify_scheduled.add(key)
+                    schedule = True
+        if overflow:
+            transport_metrics().error("overflow")
+            self._record_error(
+                RuntimeError(
+                    f"recv window ({self.recv_window}) overflow from "
+                    f"{pid.address}; shard dropped"
                 )
-                return
-            try:
-                msg = Shard.unmarshal(payload)
-            except WireError as exc:
-                metrics.error("wire")
-                self._record_error(exc)
-                return
-            metrics.record_in(pid.address, len(body) + 4)
-            ctx = Ctx(msg, pid)
-            if not self._dispatch.submit(
-                pid.public_key, self._dispatch_plugins, ctx
+            )
+            return
+        if schedule and not self._dispatch.submit(
+            key, self._drain_verify, key
+        ):
+            with self._verify_lock:
+                self._verify_scheduled.discard(key)
+            transport_metrics().error("overflow")
+            self._record_error(
+                RuntimeError(
+                    f"recv window ({self.recv_window}) overflow from "
+                    f"{pid.address}; shard dropped"
+                )
+            )
+
+    def _drain_verify(self, key: bytes) -> None:
+        """One verify cohort for sender ``key``, on the dispatch pool:
+        up to VERIFY_DRAIN_MAX queued frames verify as ONE batch
+        (``crypto.verify_batch`` — per-item fan-back isolates a bad
+        signature to its own frame), then the survivors' shards dispatch
+        to the plugins in arrival order. Rides the per-sender serialized
+        dispatcher, so ordering and DRR fairness hold unchanged."""
+        with self._verify_lock:
+            q = self._verify_q.get(key)
+            batch = []
+            while q and len(batch) < self.VERIFY_DRAIN_MAX:
+                batch.append(q.popleft())
+            if q:
+                more = True
+            else:
+                more = False
+                self._verify_scheduled.discard(key)
+                self._verify_q.pop(key, None)
+        if batch:
+            metrics = transport_metrics()
+            verdicts = self._sig.verify_batch(
+                [(item[0].public_key, item[1], item[2]) for item in batch]
+            )
+            ok_count = sum(verdicts)
+            wire_metrics().verify_batch(
+                len(batch), ok_count,
+                fell_back=len(batch) > 1 and ok_count < len(batch),
+            )
+            for (pid, _digest, _sig, msgs, nbytes), ok in zip(
+                batch, verdicts
             ):
-                metrics.error("overflow")
-                self._record_error(
-                    RuntimeError(
-                        f"recv window ({self.recv_window}) overflow from "
-                        f"{pid.address}; shard dropped"
+                if not ok:
+                    metrics.error("signature")
+                    self._record_error(
+                        WireError(f"bad frame signature from {pid.address}")
                     )
-                )
+                    continue
+                metrics.record_in(pid.address, nbytes, count=len(msgs))
+                for msg in msgs:
+                    self._dispatch_plugins(Ctx(msg, pid))
+        if more and not self._dispatch.submit(key, self._drain_verify, key):
+            with self._verify_lock:
+                self._verify_scheduled.discard(key)
 
     def _dispatch_plugins(self, ctx: Ctx) -> None:
         metrics = transport_metrics()

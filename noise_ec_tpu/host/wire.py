@@ -416,6 +416,43 @@ class Shard:
             return b"".join((bytes(head), self.shard_data, bytes(out)))
         return bytes(head + out)
 
+    def marshal_parts(self) -> tuple:
+        """``marshal()`` as (head, shard_data, tail) buffer parts whose
+        concatenation is byte-identical to ``marshal()`` — the
+        scatter-gather shape of the wire hot loop (docs/design.md §15):
+        the transport signs the parts with a streaming hash and hands
+        them to ``sendmsg`` as iovecs, so the dominant ``shard_data``
+        buffer is never copied into a joined frame on the send path."""
+        head = bytearray()
+        if self.file_signature:
+            head.append(0x0A)
+            _put_varint(head, len(self.file_signature))
+            head += self.file_signature
+        data = self.shard_data
+        if data:
+            head.append(0x12)
+            _put_varint(head, len(data))
+        out = bytearray()
+        if self.shard_number:
+            out.append(0x18)
+            _put_varint(out, self.shard_number)
+        if self.total_shards:
+            out.append(0x20)
+            _put_varint(out, self.total_shards)
+        if self.minimum_needed_shards:
+            out.append(0x28)
+            _put_varint(out, self.minimum_needed_shards)
+        if self.stream_chunk_index:
+            out.append(0x30)
+            _put_varint(out, self.stream_chunk_index)
+        if self.stream_chunk_count:
+            out.append(0x38)
+            _put_varint(out, self.stream_chunk_count)
+        if self.stream_object_bytes:
+            out.append(0x40)
+            _put_varint(out, self.stream_object_bytes)
+        return (bytes(head), data if data else b"", bytes(out))
+
     def size(self) -> int:
         n = 0
         if self.file_signature:
@@ -439,8 +476,17 @@ class Shard:
         return n
 
     @classmethod
-    def unmarshal(cls, buf: bytes) -> "Shard":
-        buf = bytes(buf)
+    def unmarshal(cls, buf) -> "Shard":
+        """Decode wire bytes into a Shard.
+
+        ``buf`` may be ``bytes``, ``bytearray`` or a ``memoryview`` — the
+        decoder walks it IN PLACE (the ring-buffer receive path hands in
+        views of the recv ring, docs/design.md §15) and materializes each
+        field as its own ``bytes`` exactly once, so the dominant
+        ``shard_data`` payload is copied a single time end to end instead
+        of whole-buffer-then-per-field."""
+        if isinstance(buf, memoryview):
+            buf = buf if buf.contiguous else bytes(buf)
         msg = cls()
         pos = 0
         while pos < len(buf):
@@ -457,7 +503,9 @@ class Shard:
                 ln, pos = _get_varint(buf, pos)
                 if pos + ln > len(buf):
                     raise WireError("unexpected EOF in bytes field")
-                val = buf[pos : pos + ln]
+                # bytes() of a bytes slice is a no-op; of a memoryview
+                # slice it is THE one copy this field ever pays.
+                val = bytes(buf[pos : pos + ln])
                 pos += ln
                 if field_num == 1:
                     msg.file_signature = val

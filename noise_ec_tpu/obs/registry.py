@@ -583,6 +583,59 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "event (kill, restart)",
         ("event",),
     ),
+    # --- wire hot loop (host/transport.py, docs/design.md §15)
+    "noise_ec_wire_verify_batch_size": (
+        "histogram",
+        "Frames per batched Ed25519 verify on the receive drain "
+        "(1 = an idle link paying zero added latency)",
+        (),
+    ),
+    "noise_ec_wire_verified_frames_total": (
+        "counter",
+        "Wire frames through the batched verify stage, labeled by "
+        "outcome (ok, bad)",
+        ("outcome",),
+    ),
+    "noise_ec_wire_verify_fallbacks_total": (
+        "counter",
+        "Verify batches whose combined equation failed and fanned back "
+        "to per-item verification (≈ cohorts containing a bad signature)",
+        (),
+    ),
+    "noise_ec_wire_frames_per_syscall": (
+        "histogram",
+        "Frames coalesced into one send-side socket flush (sendmsg "
+        "iovec or single buffered write)",
+        (),
+    ),
+    "noise_ec_wire_syscalls_saved_total": (
+        "counter",
+        "Send syscalls avoided by coalescing (frames flushed minus "
+        "flush calls)",
+        (),
+    ),
+    "noise_ec_wire_frames_per_fill": (
+        "histogram",
+        "Complete frames parsed in place per recv-ring fill",
+        (),
+    ),
+    "noise_ec_wire_ring_bytes": (
+        "histogram",
+        "Bytes left unparsed in the recv ring after each fill (a frame "
+        "straddling the next fill)",
+        (),
+    ),
+    "noise_ec_wire_shards_per_frame": (
+        "histogram",
+        "Shards carried per SHARD_BATCH frame on the send path (one "
+        "signature amortized over the cohort)",
+        (),
+    ),
+    "noise_ec_wire_recv_shards": (
+        "gauge",
+        "SO_REUSEPORT acceptor shards serving this node's listen port",
+        (),
+    ),
     # --- shard mempool (host/mempool.py)
     "noise_ec_mempool_pools": (
         "gauge",
@@ -613,6 +666,22 @@ _HISTOGRAM_BUCKETS: dict[str, tuple[float, ...]] = {
     ),
     # Payload bytes per device per sharded dispatch.
     "noise_ec_mesh_shard_bytes": SIZE_BUCKETS,
+    # Wire hot loop: small-integer frame/shard counts + ring occupancy.
+    "noise_ec_wire_verify_batch_size": (
+        1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0, 48.0, 64.0,
+    ),
+    "noise_ec_wire_frames_per_syscall": (
+        1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0, 48.0, 64.0,
+        128.0, 256.0,
+    ),
+    "noise_ec_wire_frames_per_fill": (
+        1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0, 48.0, 64.0,
+        128.0, 256.0,
+    ),
+    "noise_ec_wire_shards_per_frame": (
+        1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0, 48.0, 64.0,
+    ),
+    "noise_ec_wire_ring_bytes": SIZE_BUCKETS,
 }
 
 

@@ -17,8 +17,17 @@ import time
 
 import pytest
 
-NODE_START_TIMEOUT = 30.0
-MESSAGE_TIMEOUT = 45.0
+# Timeout ladder. Everything here waits on EVENTS (log lines: listen,
+# registration, delivery), never fixed sleeps, so generous ceilings cost
+# nothing when the fleet is healthy — they only bound how long a genuine
+# hang takes to surface. PR 9 recorded a one-off 45 s timeout in the
+# three-process discovery test under load on the 1-core box: three
+# Python interpreters cold-starting numpy + jax shims behind one core
+# can eat most of the old ladder before gossip even begins, so the
+# introduction/delivery ceiling is now 120 s and node start 60 s.
+NODE_START_TIMEOUT = 60.0
+REGISTRATION_TIMEOUT = 120.0
+MESSAGE_TIMEOUT = 120.0
 
 
 def _free_ports(count: int) -> list[int]:
@@ -138,8 +147,10 @@ def test_three_process_discovery_transitive(nodes):
     """C bootstraps only to B, never to A — yet receives A's broadcast,
     because peer-exchange gossip (the reference's discovery.Plugin,
     main.go:151) introduces A and C to each other. Registration is
-    idempotent and logged, so the test waits for the mutual introduction
-    and then sends ONCE — no retry loop papering over the race."""
+    idempotent and logged, so the test waits on registration EVENTS at
+    every stage — first each bootstrap edge, then the gossip-built
+    A↔C edge — and then sends ONCE; no fixed sleeps, no retry loop
+    papering over the race."""
     pa, pb, pc = _free_ports(3)
     b = nodes(pb)
     b.wait_for("listening for peers", NODE_START_TIMEOUT)
@@ -148,15 +159,25 @@ def test_three_process_discovery_transitive(nodes):
     c = nodes(pc, peers=f"tcp://127.0.0.1:{pb}")
     c.wait_for("listening for peers", NODE_START_TIMEOUT)
 
-    # Gossip introduces the pair; each side logs the registration.
-    a.wait_for(f"registered peer tcp://127.0.0.1:{pc}", MESSAGE_TIMEOUT)
-    c.wait_for(f"registered peer tcp://127.0.0.1:{pa}", MESSAGE_TIMEOUT)
+    # Stage 1: both bootstrap edges are up (B logged each registration).
+    # Waiting here first keeps the later introduction wait from
+    # absorbing slow node cold-starts into its budget.
+    b.wait_for(f"registered peer tcp://127.0.0.1:{pa}", REGISTRATION_TIMEOUT)
+    b.wait_for(f"registered peer tcp://127.0.0.1:{pc}", REGISTRATION_TIMEOUT)
+
+    # Stage 2: gossip introduces the pair; each side logs it.
+    a.wait_for(f"registered peer tcp://127.0.0.1:{pc}", REGISTRATION_TIMEOUT)
+    c.wait_for(f"registered peer tcp://127.0.0.1:{pa}", REGISTRATION_TIMEOUT)
 
     msg = "discovered peers hear this too"
     needle = msg.encode().hex()
     a.send_line(msg)
     got_c = c.wait_for(needle, MESSAGE_TIMEOUT)
-    got_b = b.wait_for(needle, 5.0)
+    # B heard the same broadcast; by the time C has it, B's is at most
+    # one dispatch behind — but under 1-core cold-start load (three
+    # interpreters importing numpy/jax shims at once) "one dispatch"
+    # can still be tens of seconds, so it rides the full ladder too.
+    got_b = b.wait_for(needle, MESSAGE_TIMEOUT)
     assert needle in got_b and needle in got_c
 
 

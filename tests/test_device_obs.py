@@ -457,6 +457,35 @@ def test_bench_gate_cli_on_recorded_rounds():
     ]) == 1  # the reversed diff is a genuine regression
 
 
+def test_bench_gate_wire_rig_bars():
+    """ISSUE-11: the wire hot-loop rig bars (>= 50k msgs/s, roundtrip
+    MB/s within 4x of the large-object host path) bite on rigs with a
+    recorded MULTICHIP round — this repo records one — and pass once
+    the loop clears them; dev-box-shaped numbers are flagged with the
+    ROADMAP pointer."""
+    bg = _bench_gate()
+    assert bg.newest_multichip_devices() > 1  # the recorded rig
+    slow = {
+        "host_node_roundtrip_msgs_per_s": 216.3,
+        "host_node_roundtrip_mb_per_s": 14.2,
+        "host_node_large_object_mb_per_s": 229.8,
+    }
+    problems = bg.wire_rig_check(slow)
+    assert any("50000" in p for p in problems)
+    assert any("4x" in p for p in problems)
+    fast = {
+        "host_node_roundtrip_msgs_per_s": 61000.0,
+        "host_node_roundtrip_mb_per_s": 80.0,
+        "host_node_large_object_mb_per_s": 229.8,
+    }
+    assert bg.wire_rig_check(fast) == []
+    # wire_ stats ride the host tolerance; the info keys carry no
+    # direction (they describe amortization, not a perf contract).
+    assert bg.metric_tolerance("wire_verify_batch_size_p50") == bg.HOST_TOLERANCE
+    assert bg.metric_direction("wire_verify_batch_size_p50") is None
+    assert bg.metric_direction("wire_frames_per_syscall") is None
+
+
 def test_bench_gate_north_star():
     bg = _bench_gate()
     base = {"rs17_3_encode_gbps": 500.0}

@@ -140,3 +140,67 @@ def test_signing_key_cache_thread_safe():
     for t in threads:
         t.join()
     assert not errors, errors
+
+
+# ------------------------------------------------- batched verification
+
+
+def test_verify_batch_matches_per_item_verdicts():
+    """verify_batch's verdict list is exactly [verify(*it) for it in
+    items]: all-good cohorts, mixed keys, and structurally bad items."""
+    pol = Ed25519Policy()
+    kps = [KeyPair.from_seed(bytes([i]) * 32) for i in range(3)]
+    items = []
+    for i in range(9):
+        kp = kps[i % len(kps)]
+        msg = bytes([i]) * 32
+        items.append((kp.public_key, msg, pol.sign(kp.private_key, msg)))
+    # structurally bad entries: wrong key length, non-point key, S >= L
+    items.append((b"\x01" * 31, b"m", b"\x00" * 64))
+    items.append((b"\xff" * 32, b"m", b"\x00" * 64))
+    verdicts = pol.verify_batch(items)
+    assert verdicts == [pol.verify(*it) for it in items]
+    assert verdicts[:9] == [True] * 9
+    assert verdicts[9:] == [False, False]
+
+
+def test_verify_batch_one_bad_signature_fans_back():
+    """One bad signature in a cohort flips ONLY its own verdict: the
+    combined equation fails, the fan-back re-checks per item, and the
+    rest of the cohort still verifies (the wire hot loop's isolation
+    contract, docs/design.md §15)."""
+    pol = Ed25519Policy()
+    kp = KeyPair.from_seed(b"\x07" * 32)
+    items = [
+        (kp.public_key, bytes([i]) * 16, pol.sign(kp.private_key, bytes([i]) * 16))
+        for i in range(8)
+    ]
+    # Corrupt one signature and one message (signature still well-formed).
+    bad_sig = bytearray(items[2][2]); bad_sig[0] ^= 1
+    items[2] = (items[2][0], items[2][1], bytes(bad_sig))
+    items[5] = (items[5][0], b"not the signed message", items[5][2])
+    verdicts = pol.verify_batch(items)
+    assert verdicts == [True, True, False, True, True, False, True, True]
+
+
+def test_verify_batch_empty_and_singleton():
+    pol = Ed25519Policy()
+    assert pol.verify_batch([]) == []
+    kp = KeyPair.from_seed(b"\x09" * 32)
+    sig = pol.sign(kp.private_key, b"solo")
+    assert pol.verify_batch([(kp.public_key, b"solo", sig)]) == [True]
+    assert pol.verify_batch([(kp.public_key, b"other", sig)]) == [False]
+
+
+def test_verify_batch_hot_key_tables_stay_correct():
+    """Tiered per-key tables (generic -> 2^i powers -> 4-bit windows)
+    must never change verdicts: drive one key far past every tier
+    boundary and check positives and negatives throughout."""
+    pol = Ed25519Policy()
+    kp = KeyPair.from_seed(b"\x0b" * 32)
+    good = [(kp.public_key, bytes([i]), pol.sign(kp.private_key, bytes([i])))
+            for i in range(24)]
+    for i, (pk, msg, sig) in enumerate(good):
+        assert pol.verify(pk, msg, sig), i
+        assert not pol.verify(pk, msg + b"x", sig), i
+    assert pol.verify_batch(good) == [True] * len(good)

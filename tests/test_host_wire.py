@@ -212,3 +212,26 @@ def test_json_text_parsers_never_crash_on_fuzz():
                 parse(text)
             except (WireError, json.JSONDecodeError):
                 pass
+
+
+def test_unmarshal_accepts_views_without_whole_buffer_copy():
+    """The §15 receive path hands unmarshal memoryview slices of the
+    recv ring: bytes, bytearray and memoryview inputs must decode
+    identically (fields materialize as their own bytes), including
+    views at a nonzero offset — the shape of a frame parsed in place."""
+    import numpy as np
+
+    rng = np.random.default_rng(0x51AB)
+    for _ in range(20):
+        s = Shard.populate(rng)
+        s.stream_chunk_index = int(rng.integers(0, 5))
+        s.stream_chunk_count = int(rng.integers(0, 5))
+        s.stream_object_bytes = int(rng.integers(0, 1 << 40))
+        wire = s.marshal()
+        padded = b"\xaa" * 7 + wire + b"\x55" * 3
+        view = memoryview(padded)[7 : 7 + len(wire)]
+        for buf in (wire, bytearray(wire), memoryview(wire), view):
+            got = Shard.unmarshal(buf)
+            assert got == s
+            assert isinstance(got.shard_data, bytes)
+            assert isinstance(got.file_signature, bytes)

@@ -82,8 +82,17 @@ HOST_TOLERANCE = 0.35
 # device tolerance like every other slope-timed kernel stat.
 HOST_PREFIXES = (
     "host_node_", "decode_corrupt_", "cpu_shim_", "partition_recovery_",
-    "store_repair_", "object_", "fleet_", "mesh_",
+    "store_repair_", "object_", "fleet_", "mesh_", "wire_",
 )
+
+# The ISSUE-11 wire hot-loop rig bars (ROADMAP transport item): applied
+# by wire_rig_check on fresh runs once the recorded MULTICHIP rounds
+# prove a real rig — the next MULTICHIP round is where the loop must
+# prove ≥ 50k msgs/s and a roundtrip MB/s within 4x of the large-object
+# host path. (Dev boxes without a MULTICHIP record are exempt: the
+# pure-Python Ed25519 fallback caps them far below the bar.)
+WIRE_RIG_MSGS_PER_S = 50_000.0
+WIRE_RIG_MBPS_FACTOR = 4.0
 
 
 def metric_direction(name: str) -> str | None:
@@ -179,6 +188,42 @@ def mesh_rig_check(stats: dict, repo: Path = REPO) -> list[str]:
         f"MULTICHIP rounds show this rig runs a {rig}-device mesh — the "
         "mesh dispatch tier regressed to single-device"
     ]
+
+
+def wire_rig_check(stats: dict, repo: Path = REPO) -> list[str]:
+    """ISSUE-11 acceptance bars for the wire hot loop, on rigs only.
+
+    Like :func:`mesh_rig_check`, this bites on FRESH runs when the
+    recorded MULTICHIP rounds prove the box is a real rig (OpenSSL
+    crypto, multiple cores): ``host_node_roundtrip_msgs_per_s`` must
+    clear 50k and the roundtrip MB/s must land within 4x of the
+    large-object host path — the ROADMAP transport-item bars."""
+    if newest_multichip_devices(repo) <= 1:
+        return []
+    problems = []
+    msgs = stats.get("host_node_roundtrip_msgs_per_s")
+    try:
+        msgs = float(msgs)
+    except (TypeError, ValueError):
+        msgs = None
+    if msgs is not None and msgs < WIRE_RIG_MSGS_PER_S:
+        problems.append(
+            f"host_node_roundtrip_msgs_per_s {msgs} below the wire "
+            f"hot-loop rig bar {WIRE_RIG_MSGS_PER_S:.0f} (ROADMAP "
+            "transport item)"
+        )
+    try:
+        rt = float(stats["host_node_roundtrip_mb_per_s"])
+        big = float(stats["host_node_large_object_mb_per_s"])
+    except (KeyError, TypeError, ValueError):
+        return problems
+    if rt > 0 and big / rt > WIRE_RIG_MBPS_FACTOR:
+        problems.append(
+            f"host_node_roundtrip_mb_per_s {rt} is {big / rt:.1f}x below "
+            f"the large-object host path ({big}); the rig bar is "
+            f"{WIRE_RIG_MBPS_FACTOR:.0f}x"
+        )
+    return problems
 
 
 def north_star_check(stats: dict) -> list[str]:
@@ -424,9 +469,11 @@ def main(argv: list[str] | None = None) -> int:
 
     problems, findings = gate(against, current)
     if not args.current:
-        # Fresh-run-only rig check (recorded rounds before the mesh tier
-        # genuinely carry batch_mesh_devices: 1; replays must stay green).
+        # Fresh-run-only rig checks (recorded rounds before the mesh tier
+        # genuinely carry batch_mesh_devices: 1 and pre-§15 roundtrip
+        # numbers; replays must stay green).
         problems.extend(mesh_rig_check(current))
+        problems.extend(wire_rig_check(current))
     if args.json:
         print(json.dumps(
             {"against": against_name, "findings": findings,

@@ -51,6 +51,12 @@ and cross-checks every referenced name against the declarative registry:
   contract, the manifest magic) must appear in docs/object-service.md
   — that doc owns the API and tenancy semantics those series
   instrument, the same two-home rule the resilience families follow;
+- **wire docs parity**: the wire hot-loop families
+  (``noise_ec_wire_*``) and the loop's surfaces (the recv ring, the
+  batch-verify stage, SHARD_BATCH framing, the sendmsg flush, the
+  ``-recv-shards`` flag) must appear in docs/design.md §15 "Wire hot
+  loop" — that section owns the ring layout, batch-verify policy and
+  REUSEPORT sharding those series instrument;
 - **panel docs parity**: the wide-geometry panel-tier families
   (``noise_ec_kernel_tile_*``) and the tier's surfaces (the panel
   kernel/planner entry points, the packed GF(2^16) layout helpers, the
@@ -171,6 +177,7 @@ def check() -> list[str]:
     problems.extend(check_datapath_docs())
     problems.extend(check_mesh_docs())
     problems.extend(check_panel_docs())
+    problems.extend(check_wire_docs())
     return problems
 
 
@@ -441,6 +448,49 @@ def check_panel_docs() -> list[str]:
     problems.extend(
         f"panel surface {tok} is not documented in docs/design.md"
         for tok in PANEL_DOC_TOKENS
+        if tok not in text
+    )
+    return problems
+
+
+# The wire hot loop (docs/design.md §15 owns the ring layout, the
+# batch-verify policy and the REUSEPORT sharding story the
+# noise_ec_wire_* families instrument): its families must be documented
+# there as well as in the observability registry table, plus the
+# surfaces that exist only as identifiers in the code.
+WIRE_PREFIXES = ("noise_ec_wire_",)
+WIRE_DOC_TOKENS = (
+    "recv_into",
+    "sendmsg",
+    "SO_REUSEPORT",
+    "verify_batch",
+    "SHARD_BATCH",
+    "-recv-shards",
+    "_FrameRing",
+    "broadcast_many",
+)
+
+
+def check_wire_docs() -> list[str]:
+    """Wire hot-loop families + surfaces vs docs/design.md §15."""
+    from noise_ec_tpu.obs.registry import METRICS
+
+    doc_path = REPO / "docs" / "design.md"
+    names = [n for n in METRICS if n.startswith(WIRE_PREFIXES)]
+    if not names:
+        return []
+    if not doc_path.exists():
+        return [f"docs file {doc_path} missing (wire metrics exist)"]
+    text = doc_path.read_text(encoding="utf-8")
+    problems = [
+        f"wire metric {n!r} is not documented in docs/design.md "
+        "(wire hot loop section)"
+        for n in names
+        if n not in text
+    ]
+    problems.extend(
+        f"wire surface {tok} is not documented in docs/design.md"
+        for tok in WIRE_DOC_TOKENS
         if tok not in text
     )
     return problems
