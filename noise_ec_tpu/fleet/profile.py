@@ -25,6 +25,11 @@ Grammar (docs/fleet.md):
 ``rate=R``             per-driver submissions/second pacing (0 = unpaced)
 ``chat=W``             weight of chat-sized broadcasts in the mix
 ``object=W``           weight of object PUT/GET through the service layer
+``get=W``              weight of hot-read GETs: a zipfian-popular object
+                       read back through a random peer's service layer
+                       (exercises the decoded-object cache tiers)
+``zipf_s=S``           zipf exponent of the GET popularity draw
+                       (default 1.1; must be > 1)
 ``repair=W``           weight of repair-storm ops (drop a stored shard,
                        degraded-read it back through the codec)
 ``chat_bytes=B``       chat payload size (padded to a multiple of k)
@@ -59,7 +64,7 @@ _INT_KEYS = (
     "peers", "fanout", "msgs", "senders", "drivers",
     "chat_bytes", "object_bytes", "stripe_bytes", "k", "n", "churn_peers",
 )
-_FLOAT_KEYS = ("chat", "object", "repair", "rate")
+_FLOAT_KEYS = ("chat", "object", "get", "repair", "rate", "zipf_s")
 _CHAOS_PASSTHROUGH = ("churn@", "partition@", "reset@", "kill@")
 
 
@@ -75,6 +80,8 @@ class FleetProfile:
     rate: float = 0.0      # per-driver submissions/s; 0 = unpaced
     chat: float = 1.0
     object: float = 0.0
+    get: float = 0.0
+    zipf_s: float = 1.1
     repair: float = 0.0
     chat_bytes: int = 64
     object_bytes: int = 8192
@@ -146,10 +153,12 @@ class FleetProfile:
             raise ValueError(
                 f"fanout {self.fanout} outside [1, peers-1={self.peers - 1}]"
             )
-        if min(self.chat, self.object, self.repair) < 0:
+        if min(self.chat, self.object, self.get, self.repair) < 0:
             raise ValueError("traffic weights must be non-negative")
-        if self.chat + self.object + self.repair <= 0:
+        if self.chat + self.object + self.get + self.repair <= 0:
             raise ValueError("at least one traffic weight must be positive")
+        if self.zipf_s <= 1.0:
+            raise ValueError(f"zipf_s must be > 1, got {self.zipf_s}")
         if not 1 <= self.k <= self.n <= 256:
             raise ValueError(f"invalid fleet geometry k={self.k} n={self.n}")
         if self.msgs < 1:
@@ -161,14 +170,15 @@ class FleetProfile:
 
     def weights(self) -> dict[str, float]:
         """Normalized traffic-mix weights by kind."""
-        total = self.chat + self.object + self.repair
+        total = self.chat + self.object + self.get + self.repair
         return {
             "chat": self.chat / total,
             "object": self.object / total,
+            "get": self.get / total,
             "repair": self.repair / total,
         }
 
     def needs_stores(self) -> bool:
-        """Object or repair traffic requires per-peer stripe stores and
-        the service layer."""
-        return self.object > 0 or self.repair > 0
+        """Object, GET or repair traffic requires per-peer stripe stores
+        and the service layer."""
+        return self.object > 0 or self.get > 0 or self.repair > 0

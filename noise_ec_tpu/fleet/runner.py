@@ -121,13 +121,21 @@ class FleetPeer:
         # scorer's explicit loss accounting own the loss story here.
         self.plugin.nack_grace_seconds = 0.0
         if self.store is not None:
-            from noise_ec_tpu.service import ObjectStore
+            from noise_ec_tpu.service import DecodedObjectCache, ObjectStore
 
+            # Hot-read (get=) traffic exercises the decoded-stripe
+            # cache tiers; a modest per-peer ceiling keeps a thousand
+            # peers' caches bounded.
+            cache = (
+                DecodedObjectCache(max_bytes=8 << 20)
+                if profile.get > 0 else None
+            )
             self.objects = ObjectStore(
                 self.store, self.plugin, self,
                 stripe_bytes=profile.stripe_bytes,
                 k=profile.k, n=profile.n,
                 slo=self.slo,
+                cache=cache,
                 # A below-k stripe with no repair engine cannot heal;
                 # fail reads fast instead of stalling the scorer.
                 fetch_timeout_seconds=0.2,
@@ -255,6 +263,11 @@ class FleetLab:
         self.errors: deque = deque(maxlen=256)
         self.error_count = 0
         self.last_report: Optional[dict] = None
+        # Put-object ledger for the hot-read (get=) mix: zipfian GETs
+        # draw from what the run has already stored.
+        self._obj_lock = threading.Lock()
+        self._put_objects: list[tuple[str, str, bytes]] = []
+        self.get_results = {"ok": 0, "bad": 0, "missing": 0, "shed": 0}
         self._churn_events: list[tuple[float, str, int]] = []
         self._churn_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -412,6 +425,7 @@ class FleetLab:
         }
         report["errors"] = self.error_count
         report["backpressure_waits"] = _backpressure_waits()
+        report["gets"] = dict(self.get_results)
         self.last_report = report
         return report
 
@@ -426,6 +440,7 @@ class FleetLab:
         cuts = (
             weights["chat"],
             weights["chat"] + weights["object"],
+            weights["chat"] + weights["object"] + weights["get"],
         )
         si = 0
         for _ in range(quota):
@@ -444,6 +459,8 @@ class FleetLab:
                     self.submit_chat(peer, rng)
                 elif roll < cuts[1]:
                     self.submit_object(peer, rng)
+                elif roll < cuts[2]:
+                    self.submit_get(peer, rng)
                 else:
                     self.submit_repair(peer, rng)
             except Exception as exc:  # noqa: BLE001 — one bad submission
@@ -504,11 +521,46 @@ class FleetLab:
                              exc.retry_after)
             return None
         msg_id = self.scorer.begin("object", sender.idx, expected)
-        self.scorer.add_object(
-            msg_id, "fleet", name,
-            hashlib.blake2b(payload, digest_size=16).digest(),
-        )
+        digest = hashlib.blake2b(payload, digest_size=16).digest()
+        self.scorer.add_object(msg_id, "fleet", name, digest)
+        with self._obj_lock:
+            self._put_objects.append(("fleet", name, digest))
         return msg_id
+
+    def submit_get(self, peer: FleetPeer, rng) -> None:
+        """One hot-read GET: a zipfian-popular already-put object read
+        back through ``peer``'s service layer (the decoded-cache tiers;
+        repeated draws of the same hot object hit the peer's cache).
+        Not delivery-scored — the run ledger's byte-digest check owns
+        read correctness, ``get_results`` reports the outcome mix."""
+        if peer.objects is None:
+            self.submit_chat(peer, rng)
+            return
+        with self._obj_lock:
+            objs = list(self._put_objects)
+        if not objs:
+            self.submit_chat(peer, rng)
+            return
+        from noise_ec_tpu.service.objects import ShedError
+
+        # Zipf rank (s > 1) over the put ledger: rank 1 = the hottest.
+        rank = int(rng.zipf(self.profile.zipf_s))
+        tenant, name, digest = objs[(rank - 1) % len(objs)]
+        default_registry().counter(
+            "noise_ec_fleet_messages_total"
+        ).labels(kind="get").add(1)
+        try:
+            data = peer.objects.read(tenant, name)
+        except ShedError as exc:
+            self.get_results["shed"] += 1
+            self.scorer.shed("get", peer.idx, exc.reason, exc.retry_after)
+        except Exception:  # noqa: BLE001 — the object may simply not
+            # have replicated to this peer (bounded-degree overlay);
+            # delivery scoring owns loss accounting, not the GET mix
+            self.get_results["missing"] += 1
+        else:
+            ok = hashlib.blake2b(data, digest_size=16).digest() == digest
+            self.get_results["ok" if ok else "bad"] += 1
 
     def submit_repair(self, sender: FleetPeer, rng) -> None:
         """One repair-storm op: drop a shard from a random stored stripe
@@ -561,7 +613,12 @@ class FleetLab:
                 if receiver.objects is None:
                     continue
                 try:
-                    data = receiver.objects.read(obj["tenant"], obj["name"])
+                    # shed=False: post-run verification must measure
+                    # REPLICATION, not a receiver's late-window load
+                    # verdict refusing the read.
+                    data = receiver.objects.read(
+                        obj["tenant"], obj["name"], shed=False
+                    )
                 except Exception:  # noqa: BLE001 — not delivered
                     continue
                 digest = hashlib.blake2b(data, digest_size=16).digest()

@@ -154,6 +154,17 @@ def build_parser() -> argparse.ArgumentParser:
         "(default). Enables the stripe store automatically",
     )
     p.add_argument(
+        "-object-cache-mb",
+        type=int,
+        default=256,
+        metavar="MB",
+        help="decoded-object cache ceiling for the GET hot path "
+        "(docs/object-service.md Read path): hot reads serve from host "
+        "RAM, warm addresses are advertised to peers on the announce "
+        "loop, and the ceiling shrinks under the device HBM watermark. "
+        "0 disables the cache tier",
+    )
+    p.add_argument(
         "-tenants",
         default="",
         metavar="FILE",
@@ -389,9 +400,17 @@ def main(argv: list[str] | None = None) -> int:
             TenantRegistry.from_file(args.tenants) if args.tenants
             else TenantRegistry()
         )
+        cache = None
+        if args.object_cache_mb > 0:
+            from noise_ec_tpu.service import DecodedObjectCache
+
+            cache = DecodedObjectCache(
+                max_bytes=args.object_cache_mb << 20
+            )
         objects = ObjectStore(
             store, plugin, net,
             tenants=tenants, engine=engine, slo=default_slo(),
+            cache=cache,
         )
         # The object API rides a StatsServer, so PORT serves /objects
         # alongside /metrics and /healthz (the route table,
@@ -406,6 +425,10 @@ def main(argv: list[str] | None = None) -> int:
             ),
         )
         ObjectAPI(objects).mount(object_server)
+        # Warm-peer routing: advertise this node's warm addresses on the
+        # announce loop so peers can serve hot reads from each other's
+        # decoded caches before touching shards.
+        objects.enable_peer_routing(object_server.url)
         log.info("object service on %s/objects (%d tenants configured)",
                  object_server.url, len(tenants.names()))
 

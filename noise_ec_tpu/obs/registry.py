@@ -413,7 +413,9 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
     ),
     "noise_ec_object_gets_total": (
         "counter",
-        "Object/range reads, labeled by result (ok, degraded = at least "
+        "Object/range reads, labeled by result (ok, hit = every stripe "
+        "served from the decoded cache, coalesced = at least one stripe "
+        "rode another request's in-flight decode, degraded = at least "
         "one stripe reconstructed, unavailable = below k and anti-entropy "
         "timed out, error)",
         ("result",),
@@ -437,9 +439,10 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
     ),
     "noise_ec_object_shed_total": (
         "counter",
-        "PUTs shed by load control before any encode (503 + Retry-After), "
-        "labeled by reason (slo = health verdict degraded, hbm = device "
-        "memory watermark breached)",
+        "PUTs (before any encode) and cold-cache GETs (before any "
+        "decode) shed by load control with 503 + Retry-After, labeled "
+        "by reason (slo = health verdict degraded, hbm = device memory "
+        "watermark breached); warm-cache GETs are never shed",
         ("reason",),
     ),
     "noise_ec_object_manifests": (
@@ -451,6 +454,40 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "gauge",
         "Logical bytes stored per tenant (quota accounting view)",
         ("tenant",),
+    ),
+    "noise_ec_object_cache_hits_total": (
+        "counter",
+        "Decoded-stripe cache lookups served from host RAM on the GET "
+        "hot path (service/cache.py)",
+        (),
+    ),
+    "noise_ec_object_cache_misses_total": (
+        "counter",
+        "Decoded-stripe cache lookups that missed and fell to the "
+        "peer/decode tiers",
+        (),
+    ),
+    "noise_ec_object_cache_evictions_total": (
+        "counter",
+        "Decoded-stripe cache entries dropped, labeled by reason (lru = "
+        "capacity ceiling, pressure = HBM-watermark shrink, invalidate = "
+        "address/stripe invalidation on DELETE/overwrite)",
+        ("reason",),
+    ),
+    "noise_ec_object_cache_bytes": (
+        "gauge",
+        "Decoded stripe bytes resident in the object cache(s), read at "
+        "collect time",
+        (),
+    ),
+    "noise_ec_object_read_route_total": (
+        "counter",
+        "Underlying stripe fetches on the GET path by serving tier "
+        "(cache = local decoded cache, peer = a warm peer's /objects "
+        "endpoint, decode = local shards — join or degraded "
+        "reconstruct); coalesced followers of one in-flight fetch do "
+        "not double-count",
+        ("route",),
     ),
     "noise_ec_object_put_seconds": (
         "histogram",
@@ -482,7 +519,8 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "counter",
         "Why each coalesced batch flushed, labeled by reason (solo = "
         "idle dispatcher, immediate; linger = latency budget expired; "
-        "full = max_batch reached; bulk = explicit pre-formed batch)",
+        "full = max_batch reached; bulk = explicit pre-formed batch; "
+        "shared = single-flight result broadcast, submit_shared)",
         ("reason",),
     ),
     "noise_ec_device_buffer_pool_hits_total": (
@@ -556,8 +594,9 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
     ),
     "noise_ec_fleet_messages_total": (
         "counter",
-        "Fleet traffic submissions admitted for broadcast, labeled by "
-        "kind (chat, object, repair)",
+        "Fleet traffic submissions admitted, labeled by kind (chat, "
+        "object, repair, get = a zipfian hot read through a peer's "
+        "service layer)",
         ("kind",),
     ),
     "noise_ec_fleet_deliveries_total": (

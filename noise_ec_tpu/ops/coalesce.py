@@ -26,7 +26,11 @@ Flush policy (admission and batching share one queue):
 - a full bucket (``max_batch``) flushes at once;
 - explicit batches (:meth:`submit_many` — the repair engine's group
   dispatch) merge into any open bucket for their key and flush without
-  linger: they already ARE a batch.
+  linger: they already ARE a batch;
+- idempotent reads (:meth:`submit_shared` — the object service's
+  per-(address, stripe) decoded-stripe fetch) ride a SINGLE-FLIGHT
+  tier: same-key callers share one in-flight call's result (followers
+  join even mid-call), flushed as ``reason="shared"``.
 
 The batch function runs on the leader's thread; an exception propagates
 to every member (each caller then applies its own fallback — e.g. the
@@ -69,6 +73,16 @@ class _Bucket:
         self.closed = False
 
 
+class _Flight:
+    __slots__ = ("done", "result", "error", "members")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.members = 1
+
+
 class CoalescingDispatcher:
     """Batches concurrent same-key requests into single dispatches
     (module docstring). One process-wide instance fronts every codec
@@ -83,6 +97,7 @@ class CoalescingDispatcher:
         self.hot_window_seconds = hot_window_seconds
         self._lock = threading.Lock()
         self._buckets: dict = {}
+        self._flights: dict = {}  # single-flight tier (submit_shared)
         self._inflight = 0  # batch dispatches currently running
         self._last_submit_t = 0.0
         self._last_submit_thread: Optional[int] = None
@@ -95,7 +110,7 @@ class CoalescingDispatcher:
             reason: reg.counter(
                 "noise_ec_coalesce_flush_reason_total"
             ).labels(reason=reason)
-            for reason in ("solo", "linger", "full", "bulk")
+            for reason in ("solo", "linger", "full", "bulk", "shared")
         }
 
     # ------------------------------------------------------------- submit
@@ -134,6 +149,54 @@ class CoalescingDispatcher:
             return self._await(bucket, idx)
         self._lead(bucket, linger=self._linger_budget() if hot else 0.0)
         return self._result(bucket, idx)
+
+    def submit_shared(self, key, fn: Callable[[], object]):
+        """Single-flight tier: concurrent same-``key`` callers share ONE
+        ``fn()`` call and all receive its result. Unlike :meth:`submit`,
+        followers may join while the call is already RUNNING — the
+        result is *broadcast*, not batched — which is the shape of
+        idempotent reads: the object service routes each cold
+        ``(address, stripe)`` decode through here, so a zipfian stampede
+        on a cold object costs exactly one dispatch
+        (docs/object-service.md "Read path").
+
+        Returns ``(result, shared)`` — ``shared`` is True when this
+        caller rode another caller's in-flight call. An exception from
+        ``fn`` propagates to every member. Flights record the coalesce
+        metrics under ``flush_reason="shared"`` (one batch-size
+        observation per member, same contract as batched flushes)."""
+        with self._lock:
+            flight = self._flights.get(key)
+            if flight is not None:
+                flight.members += 1
+                follower = True
+            else:
+                flight = self._flights[key] = _Flight()
+                follower = False
+        if follower:
+            if not flight.done.wait(_FOLLOWER_TIMEOUT_S):
+                raise RuntimeError(
+                    "shared dispatch never completed (leader lost)"
+                )
+            if flight.error is not None:
+                raise flight.error
+            return flight.result, True
+        try:
+            flight.result = fn()
+        except BaseException as exc:  # noqa: BLE001 — fan the error out
+            flight.error = exc
+        finally:
+            with self._lock:
+                del self._flights[key]
+                members = flight.members
+            self._batches.add(1)
+            self._flush_children["shared"].add(1)
+            for _ in range(members):
+                self._size_hist.observe(members)
+            flight.done.set()
+        if flight.error is not None:
+            raise flight.error
+        return flight.result, False
 
     def submit_many(self, key, batch_fn: Callable[[list], list],
                     payloads: Sequence) -> list:

@@ -19,6 +19,11 @@ Wiring: ``host/cli.py`` exposes ``-object-port`` / ``-tenants``.
 See docs/object-service.md.
 """
 
+from noise_ec_tpu.service.cache import (
+    WARMSET_MAGIC,
+    DecodedObjectCache,
+    PeerCacheDirectory,
+)
 from noise_ec_tpu.service.http import ObjectAPI
 from noise_ec_tpu.service.objects import (
     MANIFEST_MAGIC,
@@ -35,9 +40,12 @@ from noise_ec_tpu.service.tenants import (
 )
 
 __all__ = [
+    "DecodedObjectCache",
     "MANIFEST_MAGIC",
     "ObjectAPI",
     "ObjectStore",
+    "PeerCacheDirectory",
+    "WARMSET_MAGIC",
     "ObjectUnavailableError",
     "QuotaExceededError",
     "ShedError",

@@ -1,0 +1,395 @@
+"""Tiered decoded-object cache and the warm-peer directory.
+
+The GET hot path (docs/object-service.md "Read path") used to decode
+every read from shards — the same hot object a thousand times over — so
+read throughput was pinned to codec + fetch speed. This module is the
+amortizing tier:
+
+- :class:`DecodedObjectCache` — a bounded host-RAM LRU of decoded
+  stripe payloads keyed by ``(content address, stripe index)``.
+  Per-stripe granularity means range-GETs hit without materializing
+  whole objects. The content address is the manifest address (a
+  blake2b-128 of ``tenant\\0name\\0content``), so **invalidation is
+  free**: an overwrite-PUT mints a new address and the object layer
+  simply evicts the old one (:meth:`evict_address`); nothing cached
+  under an address can ever be stale. Size is bounded two ways: the
+  configured ``max_bytes`` ceiling (LRU eviction, ``reason="lru"``),
+  and a **pressure watermark** — while the PR-5 HBM gauges
+  (:func:`~noise_ec_tpu.obs.device.hbm_snapshot`) report device memory
+  above ``hbm_watermark`` of its limit, the effective ceiling shrinks
+  to ``low_fraction * max_bytes`` (``reason="pressure"``), so the host
+  cache yields RAM exactly when the node is already memory-stressed.
+
+- :class:`PeerCacheDirectory` — which peers hold which addresses warm.
+  Fed by warm-set adverts (:data:`WARMSET_MAGIC` objects piggybacked on
+  the repair engine's announce loop — docs/object-service.md), each
+  entry maps an HTTP endpoint to its advertised address set with a TTL.
+  A per-endpoint :class:`~noise_ec_tpu.resilience.breakers.
+  CircuitBreaker` guards the routing decision: a dead cache peer opens
+  its breaker and the read degrades to the local decode path instead of
+  stalling on timeouts.
+
+Metrics: ``noise_ec_object_cache_{hits,misses,evictions,bytes}`` and
+(recorded by the object layer) ``noise_ec_object_read_route_total``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import weakref
+from collections import OrderedDict
+from typing import Callable, Iterable, Optional
+
+from noise_ec_tpu.obs.registry import default_registry
+
+__all__ = [
+    "DecodedObjectCache",
+    "PeerCacheDirectory",
+    "WARMSET_MAGIC",
+    "parse_warmset",
+    "warmset_blob",
+]
+
+# Wire/stored prefix of a warm-set advert object; versioned like the
+# manifest magic so future advert schemas can coexist on one fleet.
+WARMSET_MAGIC = b"noise-ec-warmset/1\n"
+
+
+def warmset_blob(endpoint: str, addresses: Iterable[str]) -> bytes:
+    """One warm-set advert payload: which addresses ``endpoint`` can
+    serve from its decoded cache. ``t`` (wall time) makes consecutive
+    adverts distinct objects — identical payloads would sign to the
+    identical stripe key and peers would absorb them as duplicates
+    without refreshing their directory TTL."""
+    return WARMSET_MAGIC + json.dumps({
+        "version": 1,
+        "endpoint": endpoint,
+        "addresses": list(addresses),
+        "t": time.time(),
+    }).encode()
+
+
+def parse_warmset(data: bytes) -> Optional[dict]:
+    """The advert document, or None when malformed (adverts arrive from
+    peers; a bad one is dropped, never raised)."""
+    if not data.startswith(WARMSET_MAGIC):
+        return None
+    try:
+        doc = json.loads(data[len(WARMSET_MAGIC):].decode().rstrip("\n"))
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(doc, dict) or doc.get("version") != 1:
+        return None
+    endpoint = doc.get("endpoint")
+    addresses = doc.get("addresses")
+    if not isinstance(endpoint, str) or not endpoint.startswith("http"):
+        return None
+    if not isinstance(addresses, list) or not all(
+        isinstance(a, str) for a in addresses
+    ):
+        return None
+    return doc
+
+
+class _CacheMetrics:
+    _registered = False
+    _instances: "weakref.WeakSet[DecodedObjectCache]" = weakref.WeakSet()
+
+    def __init__(self):
+        reg = default_registry()
+        self.hits = reg.counter("noise_ec_object_cache_hits_total").labels()
+        self.misses = reg.counter(
+            "noise_ec_object_cache_misses_total"
+        ).labels()
+        self._evictions = reg.counter(
+            "noise_ec_object_cache_evictions_total"
+        )
+        cls = _CacheMetrics
+        if not cls._registered:
+            cls._registered = True
+            reg.gauge("noise_ec_object_cache_bytes").set_callback(
+                lambda: sum(c.bytes_used for c in list(cls._instances))
+            )
+
+    def evicted(self, reason: str, count: int) -> None:
+        if count:
+            self._evictions.labels(reason=reason).add(count)
+
+
+class DecodedObjectCache:
+    """Bounded LRU of decoded stripe payloads (module docstring).
+
+    Entries are the *logical* (unpadded) stripe bytes, so a cached
+    stripe serves any sub-range by slicing. ``stripe_key`` (the store
+    key of the backing stripe) is tracked per entry so a store-level
+    eviction invalidates the cached copy through the store's delete
+    listener (:meth:`evict_stripe`)."""
+
+    def __init__(
+        self,
+        max_bytes: int = 256 << 20,
+        *,
+        low_fraction: float = 0.5,
+        hbm_watermark: float = 0.85,
+        pressure_interval_seconds: float = 1.0,
+    ):
+        if max_bytes < 1:
+            raise ValueError(f"max_bytes must be >= 1, got {max_bytes}")
+        if not 0.0 < low_fraction <= 1.0:
+            raise ValueError(f"low_fraction outside (0, 1]: {low_fraction}")
+        self.max_bytes = max_bytes
+        self.low_fraction = low_fraction
+        self.hbm_watermark = hbm_watermark
+        self.pressure_interval_seconds = pressure_interval_seconds
+        # A single entry may not monopolize the cache: stripes larger
+        # than a quarter of the ceiling are served but never cached.
+        self.entry_cap = max(1, max_bytes // 4)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple[str, int], bytes]" = OrderedDict()
+        self._by_addr: dict[str, set[int]] = {}
+        self._by_stripe: dict[str, tuple[str, int]] = {}
+        self._stripe_of: dict[tuple[str, int], str] = {}
+        self.bytes_used = 0
+        self._pressured = False
+        self._last_pressure_check = 0.0
+        # Injectable for tests; the default reads the PR-5 device gauges.
+        from noise_ec_tpu.obs.device import hbm_snapshot
+
+        self._hbm = hbm_snapshot
+        self._metrics = _CacheMetrics()
+        _CacheMetrics._instances.add(self)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # -------------------------------------------------------------- reads
+
+    def get(self, address: str, idx: int) -> Optional[bytes]:
+        """The cached stripe payload (bumping LRU recency) or None;
+        records the hit/miss counters — one call per logical lookup."""
+        with self._lock:
+            blob = self._entries.get((address, idx))
+            if blob is not None:
+                self._entries.move_to_end((address, idx))
+        if blob is None:
+            self._metrics.misses.add(1)
+        else:
+            self._metrics.hits.add(1)
+        return blob
+
+    def peek(self, address: str, idx: int) -> Optional[bytes]:
+        """Like :meth:`get` but with no recency bump and no counters —
+        for re-checks inside an in-flight fetch (the logical request
+        already recorded its miss)."""
+        with self._lock:
+            return self._entries.get((address, idx))
+
+    def contains(self, address: str, idx: int) -> bool:
+        with self._lock:
+            return (address, idx) in self._entries
+
+    def addresses(self, limit: int = 256) -> list[str]:
+        """Warm addresses, most recently used first — the node's
+        warm-set advert payload."""
+        out: list[str] = []
+        seen: set[str] = set()
+        with self._lock:
+            for addr, _ in reversed(self._entries):
+                if addr not in seen:
+                    seen.add(addr)
+                    out.append(addr)
+                    if len(out) >= limit:
+                        break
+        return out
+
+    # ------------------------------------------------------------- writes
+
+    def put(
+        self, address: str, idx: int, blob: bytes,
+        stripe_key: Optional[str] = None,
+    ) -> bool:
+        """Insert one decoded stripe payload (write-through from PUT and
+        from GET decode results). Returns False when the entry is over
+        the per-entry cap and was not cached."""
+        blob = bytes(blob)
+        if len(blob) > self.entry_cap:
+            return False
+        limit = self._effective_max()
+        with self._lock:
+            key = (address, idx)
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.bytes_used -= len(old)
+            self._entries[key] = blob
+            self.bytes_used += len(blob)
+            self._by_addr.setdefault(address, set()).add(idx)
+            if stripe_key is not None:
+                self._by_stripe[stripe_key] = key
+                self._stripe_of[key] = stripe_key
+            lru = self._shrink_locked(self.max_bytes)
+            pressured = self._shrink_locked(limit)
+        self._metrics.evicted("lru", lru)
+        self._metrics.evicted("pressure", pressured)
+        return True
+
+    def evict_address(self, address: str) -> int:
+        """Drop every cached stripe of one content address (DELETE /
+        overwrite-PUT invalidation — the address IS the content, so this
+        is the whole consistency story). Returns entries dropped."""
+        with self._lock:
+            idxs = self._by_addr.pop(address, None)
+            if not idxs:
+                return 0
+            count = 0
+            for idx in idxs:
+                if self._drop_locked((address, idx)):
+                    count += 1
+        self._metrics.evicted("invalidate", count)
+        return count
+
+    def evict_stripe(self, stripe_key: str) -> bool:
+        """Drop the entry backed by one store stripe key (the store's
+        delete-listener hook: a stripe evicted under an address must not
+        keep serving from RAM)."""
+        with self._lock:
+            key = self._by_stripe.get(stripe_key)
+            dropped = key is not None and self._drop_locked(key)
+        if dropped:
+            self._metrics.evicted("invalidate", 1)
+        return dropped
+
+    def clear(self) -> int:
+        """Invalidate everything (tests, bench cold-start segments)."""
+        with self._lock:
+            count = len(self._entries)
+            self._entries.clear()
+            self._by_addr.clear()
+            self._by_stripe.clear()
+            self._stripe_of.clear()
+            self.bytes_used = 0
+        self._metrics.evicted("invalidate", count)
+        return count
+
+    # ----------------------------------------------------------- internal
+
+    def _drop_locked(self, key: tuple[str, int]) -> bool:
+        blob = self._entries.pop(key, None)
+        if blob is None:
+            return False
+        self.bytes_used -= len(blob)
+        address, idx = key
+        idxs = self._by_addr.get(address)
+        if idxs is not None:
+            idxs.discard(idx)
+            if not idxs:
+                self._by_addr.pop(address, None)
+        skey = self._stripe_of.pop(key, None)
+        if skey is not None:
+            self._by_stripe.pop(skey, None)
+        return True
+
+    def _shrink_locked(self, limit: int) -> int:
+        count = 0
+        while self.bytes_used > limit and self._entries:
+            key = next(iter(self._entries))  # LRU head
+            self._drop_locked(key)
+            count += 1
+        return count
+
+    def _effective_max(self) -> int:
+        """The live ceiling: ``max_bytes``, shrunk to ``low_fraction``
+        of it while device memory sits above the watermark. The gauge
+        read is rate-limited — the hot path must not pay a device scan
+        per insert."""
+        now = time.monotonic()
+        with self._lock:
+            fresh = (
+                now - self._last_pressure_check
+                < self.pressure_interval_seconds
+            )
+            if fresh:
+                pressured = self._pressured
+        if not fresh:
+            pressured = False
+            try:
+                hbm = self._hbm()
+                limit = hbm.get("limit_bytes") or 0
+                used = hbm.get("bytes_in_use", hbm.get("live_bytes", 0))
+                pressured = bool(limit) and used >= self.hbm_watermark * limit
+            except Exception:  # noqa: BLE001 — telemetry must not break puts
+                pressured = False
+            with self._lock:
+                self._pressured = pressured
+                self._last_pressure_check = now
+        if pressured:
+            return max(1, int(self.max_bytes * self.low_fraction))
+        return self.max_bytes
+
+
+class PeerCacheDirectory:
+    """Warm-address directory over peer adverts (module docstring).
+
+    ``observe`` ingests one advert; ``peers_for`` answers "who can serve
+    this address from RAM right now" — fresh (within TTL) entries only,
+    most recently advertised first. Breakers are per endpoint and owned
+    here so the routing layer's failure handling has one home."""
+
+    def __init__(
+        self,
+        ttl_seconds: float = 90.0,
+        max_endpoints: int = 256,
+        breaker_factory: Optional[Callable[[], object]] = None,
+    ):
+        self.ttl_seconds = ttl_seconds
+        self.max_endpoints = max_endpoints
+        self._lock = threading.Lock()
+        # endpoint -> (frozenset(addresses), observed_at)
+        self._peers: "OrderedDict[str, tuple[frozenset, float]]" = (
+            OrderedDict()
+        )
+        self._breakers: dict[str, object] = {}
+        if breaker_factory is None:
+            from noise_ec_tpu.resilience.breakers import CircuitBreaker
+
+            def breaker_factory():
+                return CircuitBreaker(
+                    failure_threshold=2, reset_timeout=2.0,
+                    max_reset_timeout=30.0,
+                )
+        self._breaker_factory = breaker_factory
+
+    def observe(self, endpoint: str, addresses: Iterable[str]) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._peers.pop(endpoint, None)
+            self._peers[endpoint] = (frozenset(addresses), now)
+            while len(self._peers) > self.max_endpoints:
+                stale, _ = self._peers.popitem(last=False)
+                self._breakers.pop(stale, None)
+
+    def forget(self, endpoint: str) -> None:
+        with self._lock:
+            self._peers.pop(endpoint, None)
+            self._breakers.pop(endpoint, None)
+
+    def peers_for(self, address: str) -> list[str]:
+        cutoff = time.monotonic() - self.ttl_seconds
+        with self._lock:
+            return [
+                ep for ep, (addrs, t) in reversed(self._peers.items())
+                if t >= cutoff and address in addrs
+            ]
+
+    def endpoints(self) -> list[str]:
+        with self._lock:
+            return list(self._peers)
+
+    def breaker(self, endpoint: str):
+        with self._lock:
+            br = self._breakers.get(endpoint)
+            if br is None:
+                br = self._breakers[endpoint] = self._breaker_factory()
+            return br

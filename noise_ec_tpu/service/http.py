@@ -13,10 +13,15 @@ dispatch chain edits needed. Endpoints (docs/object-service.md):
   refused before any stripe is encoded.
 - ``GET /objects/<tenant>/<name>`` — the object bytes; honors
   ``Range: bytes=a-b`` / ``bytes=a-`` / ``bytes=-n`` with 206 +
-  ``Content-Range`` (416 when unsatisfiable). Served degraded from any
-  k-of-n shards; a stripe below k waits on the anti-entropy fetch and
-  503s if peers cannot heal it in time. ``ETag`` is the object's
-  content address.
+  ``Content-Range`` (416 when unsatisfiable). Served through the tiered
+  read path (decoded cache → local join → warm peer → degraded decode,
+  docs/object-service.md "Read path"); a stripe below k waits on the
+  anti-entropy fetch and 503s if peers cannot heal it in time. ``ETag``
+  is the object's content address. A request carrying
+  ``X-NoiseEC-Route: direct`` (a warm-peer fetch from another node) is
+  served from local tiers only — peer routing never recurses. When the
+  node is degraded (SLO/HBM), a GET that cannot be served entirely from
+  the warm cache sheds **503 + Retry-After** like a PUT.
 - ``DELETE /objects/<tenant>/<name>`` — 204; local delete (see
   service/objects.py on replica semantics).
 - ``GET /objects/<tenant>`` — cursored LIST
@@ -141,9 +146,12 @@ class ObjectAPI:
                     {"Content-Range": f"bytes */{size}"},
                 )
             start, length, ranged = parsed
+        # A warm-peer fetch from another node: serve local tiers only,
+        # so peer routing is a single hop by construction.
+        direct = req["headers"].get("X-NoiseEC-Route") == "direct"
         try:
             doc, total, chunks = self.objects.get_range(
-                tenant, name, start, length
+                tenant, name, start, length, peer_route=not direct
             )
             # Pull the first chunk EAGERLY: stripe-unavailable is by far
             # the likeliest failure and must surface as a status code,
@@ -152,6 +160,12 @@ class ObjectAPI:
                 first = next(chunks)
             except StopIteration:
                 first = b""
+        except ShedError as exc:
+            return _json(
+                503,
+                {"error": str(exc), "shed": exc.reason},
+                {"Retry-After": f"{exc.retry_after:g}"},
+            )
         except ObjectUnavailableError as exc:
             return _json(503, {"error": str(exc)},
                          {"Retry-After": "2"})

@@ -43,6 +43,26 @@ stripe-level integrity is already anchored per-stripe by the Ed25519
 signature each stripe carries). Re-putting a name replaces it
 (last-write-wins per node); DELETE is local — replicas converge by
 operator policy, not tombstones (v1 scope, documented).
+
+The GET hot path is TIERED (docs/object-service.md "Read path"): each
+stripe of a request is served from the cheapest surviving copy —
+
+1. the local decoded-stripe cache (service/cache.py; content-addressed,
+   so invalidation is the address change itself),
+2. the local k-data-shard join when every data slot is trusted (a
+   memcpy, cheaper than any network hop),
+3. a warm peer's ``/objects`` endpoint (the peer advertised the address
+   in its warm set; a per-peer breaker degrades a dead cache peer to
+   the next tier),
+4. the local degraded reconstruct / anti-entropy fetch (the pre-cache
+   path, unchanged).
+
+Cache misses ride the PR-8 coalescer's single-flight tier
+(``submit_shared``): concurrent readers of one cold (address, stripe)
+share ONE fetch, so a zipfian stampede costs one dispatch. Admission:
+a degraded node (SLO verdict / HBM watermark) serves its warm cache
+but SHEDS reads that would enqueue new decode work — the same 503 +
+Retry-After contract PUTs already have.
 """
 
 from __future__ import annotations
@@ -59,6 +79,14 @@ from typing import Iterable, Iterator, Optional
 from noise_ec_tpu.obs.device import hbm_snapshot
 from noise_ec_tpu.obs.registry import default_registry
 from noise_ec_tpu.obs.trace import trace_key
+from noise_ec_tpu.ops.coalesce import coalescer
+from noise_ec_tpu.service.cache import (
+    WARMSET_MAGIC,
+    DecodedObjectCache,
+    PeerCacheDirectory,
+    parse_warmset,
+    warmset_blob,
+)
 from noise_ec_tpu.service.tenants import (
     QuotaExceededError,
     TenantRegistry,
@@ -134,6 +162,12 @@ class _ObjectMetrics:
         self.get_seconds = reg.histogram(
             "noise_ec_object_get_seconds"
         ).labels()
+        self.routes = {
+            route: reg.counter(
+                "noise_ec_object_read_route_total"
+            ).labels(route=route)
+            for route in ("cache", "peer", "decode")
+        }
         cls = _ObjectMetrics
         if not cls._registered:
             cls._registered = True
@@ -188,6 +222,8 @@ class ObjectStore:
         fetch_timeout_seconds: float = 8.0,
         retry_after_seconds: float = 2.0,
         max_object_bytes: int = 1 << 30,
+        cache: Optional[DecodedObjectCache] = None,
+        peer_timeout_seconds: float = 2.0,
     ):
         if plugin.store is not store:
             raise ValueError(
@@ -215,9 +251,24 @@ class ObjectStore:
         self._index: dict[tuple[str, str], str] = {}  # (tenant, name) -> addr
         self._usage: dict[str, list] = {}  # tenant -> [bytes, objects]
         self._known: set[str] = set()  # addresses counted into usage
+        # Tiered read path (module docstring): decoded-stripe cache,
+        # warm-peer directory, and the advert bookkeeping (one stored
+        # advert stripe per peer endpoint — the newest replaces the
+        # previous so adverts never accumulate in the store).
+        self.cache = cache
+        self.peer_timeout_seconds = peer_timeout_seconds
+        self.directory = PeerCacheDirectory()
+        self.advertise_url: Optional[str] = None
+        self._advert_stripes: dict[str, str] = {}
+        # PUT write-through stays bounded: objects bigger than this do
+        # not pin their whole stripe set into the cache at once.
+        self._write_through_cap = (
+            cache.max_bytes // 4 if cache is not None else 0
+        )
         self._metrics = _ObjectMetrics()
         _ObjectMetrics._instances.add(self)
         store.add_put_listener(self._on_store_put)
+        store.add_delete_listener(self._on_store_evict)
         self._reindex()
 
     # --------------------------------------------------------- admission
@@ -244,6 +295,54 @@ class ObjectStore:
         with self._lock:
             used = self._usage.get(tenant, [0, 0])
             return {"bytes": used[0], "objects": used[1]}
+
+    # ----------------------------------------------------- cache routing
+
+    def enable_peer_routing(self, url: str) -> None:
+        """Advertise this node's warm addresses and accept warm-peer
+        routing. ``url`` is the HTTP endpoint serving this node's
+        ``/objects`` tree (the StatsServer the API is mounted on); the
+        warm-set advert piggybacks on the repair engine's announce loop
+        (``RepairEngine.add_announce_hook``)."""
+        self.advertise_url = url.rstrip("/")
+        if self.engine is not None and self.cache is not None:
+            self.engine.add_announce_hook(self._announce_warm)
+
+    def _announce_warm(self) -> None:
+        """Broadcast one warm-set advert (the announce-loop piggyback).
+        Rides the ordinary signed-object path, so every peer's store
+        put-listener absorbs it exactly like a manifest."""
+        if self.cache is None or self.advertise_url is None:
+            return
+        addresses = self.cache.addresses(limit=256)
+        if not addresses:
+            return
+        blob = warmset_blob(self.advertise_url, addresses)
+        k, n = self.default_k, self.default_n
+        blob += b"\n" * ((-len(blob)) % k)
+        self.plugin.shard_and_broadcast(self.network, blob, geometry=(k, n))
+
+    def _absorb_warmset(self, key: str, data: bytes) -> None:
+        doc = parse_warmset(data)
+        if doc is None:
+            log.warning("ignoring malformed warm-set advert in stripe %s",
+                        key)
+            return
+        endpoint = doc["endpoint"].rstrip("/")
+        prev = self._advert_stripes.get(endpoint)
+        self._advert_stripes[endpoint] = key
+        if prev is not None and prev != key:
+            # One stored advert stripe per peer: adverts refresh every
+            # announce interval and would otherwise accumulate forever.
+            self.store.evict(prev)
+        if endpoint != self.advertise_url:
+            self.directory.observe(endpoint, doc["addresses"])
+
+    def _on_store_evict(self, key: str) -> None:
+        """Store delete listener: a stripe evicted out from under an
+        address must not keep serving from RAM."""
+        if self.cache is not None:
+            self.cache.evict_stripe(key)
 
     # -------------------------------------------------------------- puts
 
@@ -300,6 +399,15 @@ class ObjectStore:
             tenant.name.encode() + b"\0" + name.encode() + b"\0"
         )
         stripe_keys: list[str] = []
+        # Write-through warmth: the PUT just produced decoded-equivalent
+        # bytes, so small-enough objects land in the cache on the way in
+        # (the address is only known once the whole body hashed, so the
+        # logical stripe payloads are held until then — bounded by the
+        # write-through cap, O(stripe) memory otherwise).
+        warm: Optional[list[tuple[str, bytes]]] = (
+            [] if self.cache is not None
+            and size <= self._write_through_cap else None
+        )
         buf = bytearray()
         total = 0
 
@@ -309,6 +417,8 @@ class ObjectStore:
                 self.network, payload + bytes(pad), geometry=(k, n)
             )
             stripe_keys.append(trace_key(shards[0].file_signature))
+            if warm is not None:
+                warm.append((stripe_keys[-1], payload))
 
         for chunk in chunks:
             if not chunk:
@@ -350,6 +460,13 @@ class ObjectStore:
         # path every replica runs, so origin and peers converge through
         # one absorb implementation.
         self.plugin.shard_and_broadcast(self.network, blob, geometry=(k, n))
+        if warm is not None:
+            # After the manifest broadcast: an overwrite-PUT's manifest
+            # absorb just evicted the REPLACED address, so the new
+            # entries can never be invalidated by their own put.
+            for idx, (skey, payload) in enumerate(warm):
+                self.cache.put(doc["address"], idx, payload,
+                               stripe_key=skey)
         if tenant.replicas > 1 and self.engine is not None:
             with self._lock:
                 manifest_stripe = self._manifest_stripe_locked(doc["address"])
@@ -370,7 +487,11 @@ class ObjectStore:
     def _on_store_put(self, key: str, data: bytes, meta) -> None:
         """Store put listener: recognize manifest objects (local puts
         AND signature-verified replicas arriving through the plugin) and
-        index them. Never raises (the store logs and continues)."""
+        index them; recognize warm-set adverts and feed the peer-cache
+        directory. Never raises (the store logs and continues)."""
+        if data.startswith(WARMSET_MAGIC):
+            self._absorb_warmset(key, data)
+            return
         if not data.startswith(MANIFEST_MAGIC):
             return
         try:
@@ -466,36 +587,72 @@ class ObjectStore:
     def get_range(
         self, tenant: str, name: str,
         start: int = 0, length: Optional[int] = None,
+        *, shed: bool = True, peer_route: bool = True,
     ) -> tuple[dict, int, Iterator[bytes]]:
         """Resolve and stream one byte range: ``(manifest, range_length,
-        chunk iterator)``. The range maps onto the minimal stripe set;
-        each stripe is served degraded from any k trusted shards, and a
-        stripe below k waits (bounded) on the anti-entropy fetch. The
-        metrics for the read land when the iterator is exhausted."""
+        chunk iterator)``. The range maps onto the minimal stripe set
+        and each stripe is served from the cheapest surviving copy —
+        decoded cache, local join, warm peer, degraded decode (module
+        docstring; misses are single-flighted so concurrent readers of
+        one cold stripe share a fetch). ``shed=False`` bypasses read
+        admission (internal verification reads); ``peer_route=False``
+        pins the read to local tiers (a peer serving a direct fetch
+        must not hop again). The metrics for the read land when the
+        iterator is exhausted."""
         doc = self.resolve(tenant, name)
+        address = doc["address"]
         size = int(doc["size"])
         capacity = int(doc["stripe_bytes"])
         if start < 0 or start > size:
             raise ValueError(f"range start {start} outside [0, {size}]")
         end = size if length is None else min(size, start + max(0, length))
         total = max(0, end - start)
+        i0, i1 = start // capacity, -(-end // capacity)
+        # Read admission (the PUT shed contract extended to reads): a
+        # degraded node still serves its warm cache — those reads cost
+        # RAM only — but refuses to enqueue NEW decode work. The cache
+        # coverage check runs first so the hot path never pays the
+        # verdict/HBM probe.
+        if shed and not self._fully_cached(address, i0, i1):
+            reason = self.shed_reason()
+            if reason is not None:
+                self._metrics.shed(reason)
+                raise ShedError(reason, self.retry_after_seconds)
+        # Per-request read state: served/cached stripe counts for the
+        # result label, shared/degraded flags, and the lazily taken
+        # one-lock store snapshot of the request's stripe set.
+        state: dict = {
+            "served": 0, "cached": 0, "degraded": False, "shared": False,
+            "snaps": None,
+        }
 
         def chunks() -> Iterator[bytes]:
             t0 = time.monotonic()
             sent = 0
             result = "ok"
             try:
-                for i in range(start // capacity, -(-end // capacity)):
-                    key = doc["stripes"][i]
-                    blob, degraded = self._read_stripe(key)
-                    if degraded:
-                        result = "degraded"
+                for i in range(i0, i1):
+                    blob = self._read_stripe_tiered(
+                        doc, i, i1, state, peer_route
+                    )
                     logical = min(capacity, size - i * capacity)
                     lo = max(0, start - i * capacity)
                     hi = min(logical, end - i * capacity)
-                    piece = bytes(memoryview(blob)[:logical][lo:hi])
+                    if lo == 0 and hi == logical == len(blob):
+                        piece = blob  # whole-stripe serve: no copy
+                    else:
+                        piece = bytes(memoryview(blob)[:logical][lo:hi])
                     sent += len(piece)
                     yield piece
+                if state["shared"]:
+                    # The request rode another request's in-flight
+                    # fetch; any degraded work was the leader's (which
+                    # records it on its own request).
+                    result = "coalesced"
+                elif state["degraded"]:
+                    result = "degraded"
+                elif state["served"] and state["cached"] == state["served"]:
+                    result = "hit"
             except ObjectUnavailableError:
                 result = "unavailable"
                 raise
@@ -509,10 +666,168 @@ class ObjectStore:
 
         return doc, total, chunks()
 
-    def read(self, tenant: str, name: str) -> bytes:
+    def read(
+        self, tenant: str, name: str,
+        *, shed: bool = True, peer_route: bool = True,
+    ) -> bytes:
         """Whole-object convenience read (tests, small objects)."""
-        _, _, chunks = self.get_range(tenant, name)
+        _, _, chunks = self.get_range(
+            tenant, name, shed=shed, peer_route=peer_route
+        )
         return b"".join(chunks)
+
+    def _fully_cached(self, address: str, i0: int, i1: int) -> bool:
+        if self.cache is None:
+            return False
+        return all(self.cache.contains(address, i) for i in range(i0, i1))
+
+    def _cache_store(
+        self, address: str, i: int, blob: bytes, stripe_key: str
+    ) -> None:
+        if self.cache is not None:
+            self.cache.put(address, i, blob, stripe_key=stripe_key)
+
+    def _read_stripe_tiered(
+        self, doc: dict, i: int, i1: int, state: dict, peer_route: bool
+    ) -> bytes:
+        """One stripe's logical payload through the tier order. The miss
+        path rides the coalescer's single-flight tier keyed by
+        (address, stripe index): a concurrent stampede on a cold stripe
+        runs ONE fetch and broadcasts the bytes."""
+        address = doc["address"]
+        state["served"] += 1
+        blob = (
+            self.cache.get(address, i) if self.cache is not None else None
+        )
+        if blob is not None:
+            state["cached"] += 1
+            self._metrics.routes["cache"].add(1)
+            return blob
+
+        def fetch() -> tuple[bytes, str, bool]:
+            if self.cache is not None:
+                hit = self.cache.peek(address, i)
+                if hit is not None:
+                    # Landed by another flight between this request's
+                    # miss and its flight turn.
+                    self._metrics.routes["cache"].add(1)
+                    return hit, "cache", False
+            return self._fetch_stripe(doc, i, i1, state, peer_route)
+
+        (blob, route, degraded), shared = coalescer().submit_shared(
+            ("objget", address, i), fetch
+        )
+        if route == "cache":
+            state["cached"] += 1
+        if shared:
+            state["shared"] = True
+        if degraded:
+            state["degraded"] = True
+        return blob
+
+    def _fetch_stripe(
+        self, doc: dict, i: int, i1: int, state: dict, peer_route: bool
+    ) -> tuple[bytes, str, bool]:
+        """The single-flight leader's miss path: local join when every
+        data slot is trusted (a memcpy — the cheapest surviving copy
+        after RAM), then a warm peer, then the degraded decode /
+        anti-entropy tier. Returns ``(logical bytes, route, degraded)``
+        and write-through-populates the cache on every success."""
+        address = doc["address"]
+        key = doc["stripes"][i]
+        size = int(doc["size"])
+        capacity = int(doc["stripe_bytes"])
+        logical = min(capacity, size - i * capacity)
+        # ONE store-lock acquisition snapshots the request's remaining
+        # stripe set (the per-stripe lock fix): the join fast path and
+        # the degraded classification both work from it.
+        if state["snaps"] is None:
+            state["snaps"] = self.store.snapshot_many(doc["stripes"][i:i1])
+        snap = state["snaps"].get(key)
+        if snap is not None:
+            meta, shards, unverified = snap
+            if all(
+                shards[j] is not None and j not in unverified
+                for j in range(meta.k)
+            ):
+                blob = b"".join(
+                    shards[: meta.k]
+                )[: meta.object_len][:logical]
+                self._cache_store(address, i, blob, key)
+                self._metrics.routes["decode"].add(1)
+                return blob, "decode", False
+        if peer_route:
+            blob = self._peer_fetch(doc, i, logical)
+            if blob is not None:
+                self._cache_store(address, i, blob, key)
+                self._metrics.routes["peer"].add(1)
+                return blob, "peer", False
+        padded, degraded = self._read_stripe(key)
+        blob = (
+            padded if len(padded) == logical
+            else bytes(memoryview(padded)[:logical])
+        )
+        self._cache_store(address, i, blob, key)
+        self._metrics.routes["decode"].add(1)
+        return blob, "decode", degraded
+
+    def _peer_fetch(
+        self, doc: dict, i: int, logical: int
+    ) -> Optional[bytes]:
+        """Try each warm peer advertising the address (directory order:
+        freshest advert first), behind its breaker; returns the stripe's
+        logical bytes or None when no peer could serve. The ETag check
+        pins the peer to the SAME content address, so an overwrite
+        landing on the peer mid-read can never mix versions — the
+        byte-identity contract across routes."""
+        address = doc["address"]
+        peers = self.directory.peers_for(address)
+        if not peers:
+            return None
+        from urllib.parse import quote
+        from urllib.request import Request, urlopen
+
+        capacity = int(doc["stripe_bytes"])
+        lo = i * capacity
+        path = (
+            f"/objects/{quote(doc['tenant'], safe='')}"
+            f"/{quote(doc['name'], safe='')}"
+        )
+        for endpoint in peers:
+            if endpoint == self.advertise_url:
+                continue
+            breaker = self.directory.breaker(endpoint)
+            if not breaker.allow():
+                continue
+            req = Request(endpoint + path, headers={
+                "Range": f"bytes={lo}-{lo + logical - 1}",
+                # One hop only: the serving peer reads local tiers.
+                "X-NoiseEC-Route": "direct",
+            })
+            try:
+                with urlopen(
+                    req, timeout=self.peer_timeout_seconds
+                ) as resp:
+                    etag = (resp.headers.get("ETag") or "").strip('"')
+                    if etag != address:
+                        raise ValueError(
+                            f"peer serves address {etag!r}, "
+                            f"wanted {address!r}"
+                        )
+                    blob = resp.read(logical + 1)
+                if len(blob) != logical:
+                    raise ValueError(
+                        f"peer served {len(blob)} bytes, wanted {logical}"
+                    )
+            except Exception as exc:  # noqa: BLE001 — a dead cache peer
+                # degrades to the decode tier, never breaks the read
+                breaker.record_failure()
+                log.debug("warm-peer fetch from %s failed: %s",
+                          endpoint, exc)
+                continue
+            breaker.record_success()
+            return blob
+        return None
 
     def _read_stripe(self, key: str) -> tuple[bytes, bool]:
         """One stripe's (padded) bytes + whether the read was degraded
@@ -597,6 +912,12 @@ class ObjectStore:
         self._metrics.delete(tenant)
 
     def _drop_address(self, addr: str) -> None:
+        # Invalidation-by-address: DELETE and overwrite-PUT both land
+        # here (locally AND on every replica through the manifest absorb
+        # path), and the cache key IS the address — one eviction call is
+        # the whole coherence story.
+        if self.cache is not None:
+            self.cache.evict_address(addr)
         doc = self.store.get_manifest(addr)
         if doc is None:
             return

@@ -159,6 +159,11 @@ class RepairEngine:
         # (or lost) them keep getting re-offered one shard per interval
         # and NACK-pull the rest (docs/object-service.md).
         self._pinned: set[str] = set()
+        # Announce piggybacks: zero-arg callables run after each
+        # announce round — the object service's warm-set advert (which
+        # peers hold which addresses decoded-warm, service/cache.py)
+        # rides the same interval instead of growing its own timer.
+        self._announce_hooks: list = []
         self._last_fetch: OrderedDict[str, float] = OrderedDict()
         self._last_respond: OrderedDict[str, float] = OrderedDict()
         self._fecs: dict[tuple[int, int, str], object] = {}
@@ -207,6 +212,13 @@ class RepairEngine:
     def pinned_keys(self) -> list[str]:
         with self._lock:
             return sorted(self._pinned)
+
+    def add_announce_hook(self, fn) -> None:
+        """Register a zero-arg callable to run after each announce round
+        (piggyback surface: the object service broadcasts its warm-set
+        advert here — docs/object-service.md "Read path"). Exceptions
+        are logged, never raised."""
+        self._announce_hooks.append(fn)
 
     def on_remote_interest(self, key: str) -> None:
         """A peer is moving shards of a stripe we hold (called from the
@@ -624,6 +636,12 @@ class RepairEngine:
             announced += 1
         if announced:
             self.metrics.announces.add(announced)
+        for fn in list(self._announce_hooks):
+            try:
+                fn()
+            except Exception as exc:  # noqa: BLE001 — a piggyback must
+                # not break the announce loop
+                log.warning("announce hook failed: %s", exc)
         return announced
 
     def _respond(self, key: str) -> None:

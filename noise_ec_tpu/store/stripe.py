@@ -170,6 +170,10 @@ class StripeStore:
         # through this hook (a verified receive lands here via the
         # plugin before any listener sees it).
         self._put_listeners: list[Callable] = []
+        # Delete listeners: called (key) after a stripe is evicted — the
+        # object service's decoded cache drops the RAM copy of a stripe
+        # the store no longer backs.
+        self._delete_listeners: list[Callable] = []
         self._codecs: dict[tuple[int, int, str], ReedSolomon] = {}
         self._codec_lock = threading.Lock()
         self.shard_bytes = 0
@@ -203,6 +207,12 @@ class StripeStore:
         :meth:`put_object` (outside the store lock; exceptions are logged,
         never raised — a listener must not break the put path)."""
         self._put_listeners.append(fn)
+
+    def add_delete_listener(self, fn: Callable) -> None:
+        """Register ``fn(key)`` to run after every successful
+        :meth:`evict` (outside the store lock; exceptions are logged,
+        never raised — same contract as the put listeners)."""
+        self._delete_listeners.append(fn)
 
     # ------------------------------------------------------------ writes
 
@@ -337,6 +347,12 @@ class StripeStore:
             )
         if self.store_dir:
             self._rmtree_stripe(key)
+        for fn in list(self._delete_listeners):
+            try:
+                fn(key)
+            except Exception as exc:  # noqa: BLE001 — advisory hook only
+                log.warning("store delete listener failed for %s: %s",
+                            key, exc)
         return True
 
     def _replace_locked(self, key: str, stripe: _Stripe) -> None:
@@ -489,6 +505,25 @@ class StripeStore:
             if stripe is None:
                 raise UnknownStripeError(key)
             return stripe.meta, list(stripe.shards), set(stripe.unverified)
+
+    def snapshot_many(
+        self, keys: Iterable[str]
+    ) -> dict[str, tuple[StripeMeta, list, set]]:
+        """:meth:`snapshot` for a whole key set under ONE lock
+        acquisition — the object service's GET path snapshots the
+        stripes of a request at once instead of re-taking the store
+        lock per stripe. Keys not held are simply absent from the
+        result (the caller's per-stripe miss path handles them)."""
+        out: dict[str, tuple[StripeMeta, list, set]] = {}
+        with self._lock:
+            for key in keys:
+                stripe = self._stripes.get(key)
+                if stripe is not None:
+                    out[key] = (
+                        stripe.meta, list(stripe.shards),
+                        set(stripe.unverified),
+                    )
+        return out
 
     def read(self, key: str) -> bytes:
         """Serve the object byte-identically from whatever trusted shards

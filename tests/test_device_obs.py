@@ -486,6 +486,29 @@ def test_bench_gate_wire_rig_bars():
     assert bg.metric_direction("wire_frames_per_syscall") is None
 
 
+def test_bench_gate_cache_hot_bars():
+    """ISSUE-12: the tiered read-path bars — hot cached GETs >= 10x the
+    degraded decode path at >= 90% hit rate — flag a cache that stopped
+    amortizing, pass a healthy run, and skip rounds without the keys
+    (recorded rounds predate the cache)."""
+    bg = _bench_gate()
+    healthy = {
+        "object_get_hot_mb_per_s": 112000.0,
+        "object_get_degraded_mb_per_s": 860.0,
+        "object_get_hit_rate": 0.99,
+    }
+    assert bg.cache_hot_check(healthy) == []
+    slow = dict(healthy, object_get_hot_mb_per_s=4000.0)
+    assert any("10x" in p for p in bg.cache_hot_check(slow))
+    cold = dict(healthy, object_get_hit_rate=0.4)
+    assert any("hit_rate" in p for p in bg.cache_hot_check(cold))
+    assert bg.cache_hot_check({"object_put_mb_per_s": 50.0}) == []
+    # The hot stat rides host tolerance; the hit rate carries no
+    # direction (cache_hot_check owns its bar).
+    assert bg.metric_tolerance("object_get_hot_mb_per_s") == bg.HOST_TOLERANCE
+    assert bg.metric_direction("object_get_hit_rate") is None
+
+
 def test_bench_gate_north_star():
     bg = _bench_gate()
     base = {"rs17_3_encode_gbps": 500.0}

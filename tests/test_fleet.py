@@ -76,6 +76,10 @@ def test_fleet_profile_parse_grammar():
     assert abs(w["chat"] - 0.7) < 1e-9
     assert p.needs_stores()
     assert not FleetProfile.parse("peers=8,chat=1").needs_stores()
+    # The hot-read GET mix (zipfian popularity over already-put objects).
+    g = FleetProfile.parse("peers=8,chat=0.2,object=0.3,get=0.5,zipf_s=1.3")
+    assert g.get == 0.5 and g.zipf_s == 1.3 and g.needs_stores()
+    assert abs(g.weights()["get"] - 0.5) < 1e-9
     for bad in (
         "peers=1",              # fleet needs >= 2
         "fanout=0",             # no neighbors
@@ -85,10 +89,35 @@ def test_fleet_profile_parse_grammar():
         "frobnicate=1",
         "msgs",                 # not key=value
         "k=6,n=4",              # inverted geometry
+        "get=0.5,zipf_s=1.0",   # zipf exponent must be > 1
     ):
         with pytest.raises(ValueError):
             FleetProfile.parse(bad)
     assert set(NAMED_CHAOS) >= {"clean", "lossy", "flaky", "storm"}
+
+
+def test_fleet_zipfian_get_mix_rides_the_cache_tiers():
+    """The hot-read mix: objects put through the service layer are read
+    back zipfian-popular through peers' object services — repeated hot
+    draws hit the decoded cache, outcomes land in the report's ``gets``
+    block, and nothing is scored lost by reading."""
+    hits_before = counter_total("noise_ec_object_cache_hits_total")
+    lab = FleetLab(
+        FleetProfile.parse(
+            "peers=8,fanout=3,msgs=120,chat=0.1,object=0.3,get=0.6,"
+            "object_bytes=4096,stripe_bytes=4096"
+        ),
+        seed=5,
+    )
+    try:
+        report = lab.run()
+    finally:
+        lab.close()
+    gets = report["gets"]
+    assert gets["ok"] > 0, gets
+    assert gets["bad"] == 0, gets  # byte-digest identity on every read
+    assert counter_total("noise_ec_object_cache_hits_total") > hits_before
+    assert report["delivery"]["rate"] == 1.0  # GET mix never costs delivery
 
 
 # -------------------------------------------- backpressure in isolation

@@ -1,0 +1,478 @@
+"""Tiered read-path tests (ISSUE 12, docs/object-service.md "Read
+path"): decoded-stripe cache hits and write-through, LRU/watermark
+bounded memory, invalidation by address on DELETE and overwrite-PUT
+across peers, single-flight stampede coalescing, warm-peer routing with
+a per-peer breaker, cold-cache shed admission, and the one-lock-per-
+request store snapshot."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from noise_ec_tpu.host.plugin import ShardPlugin
+from noise_ec_tpu.host.transport import (
+    LoopbackHub,
+    LoopbackNetwork,
+    format_address,
+)
+from noise_ec_tpu.obs.health import SLOEvaluator
+from noise_ec_tpu.obs.registry import Registry, default_registry
+from noise_ec_tpu.obs.server import StatsServer
+from noise_ec_tpu.ops.coalesce import CoalescingDispatcher
+from noise_ec_tpu.service import (
+    DecodedObjectCache,
+    ObjectAPI,
+    ObjectStore,
+    ShedError,
+)
+from noise_ec_tpu.service.objects import ObjectUnavailableError
+from noise_ec_tpu.store import RepairEngine, StripeStore
+
+
+def counter_value(name: str, **labels) -> float:
+    return default_registry().counter(name).labels(**labels).value
+
+
+def make_node(
+    hub, port, *, cache=None, slo=None, engine=True, stripe_bytes=8 << 10,
+):
+    """One loopback node: store + plugin (+ optional engine) + service."""
+    node = LoopbackNetwork(hub, format_address("tcp", "localhost", port))
+    store = StripeStore()
+    eng = (
+        RepairEngine(store, network=node, linger_seconds=0.0)
+        if engine else None
+    )
+    plugin = ShardPlugin(backend="numpy", store=store)
+    node.add_plugin(plugin)
+    objects = ObjectStore(
+        store, plugin, node, engine=eng, slo=slo, cache=cache,
+        stripe_bytes=stripe_bytes, k=4, n=6, fetch_timeout_seconds=0.5,
+        peer_timeout_seconds=1.0,
+    )
+    return objects
+
+
+def payload_bytes(seed: int, size: int) -> bytes:
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+
+
+# ------------------------------------------------------------ cache tiers
+
+
+def test_write_through_hit_routes_and_byte_identity():
+    """PUT write-through warms the cache; a warm read is result="hit"
+    through the cache route, a cold read decodes, and both serve
+    byte-identical content — the cross-route identity contract."""
+    cache = DecodedObjectCache(max_bytes=64 << 20)
+    objects = make_node(LoopbackHub(), 4100, cache=cache)
+    payload = payload_bytes(7, 50_000)
+    doc = objects.put("acme", "x.bin", payload)
+    n_stripes = len(doc["stripes"])
+    assert n_stripes > 1
+    assert len(cache) == n_stripes  # write-through, per-stripe entries
+    assert cache.bytes_used == len(payload)
+
+    hit0 = counter_value("noise_ec_object_gets_total", result="hit")
+    route_cache0 = counter_value(
+        "noise_ec_object_read_route_total", route="cache"
+    )
+    warm = objects.read("acme", "x.bin")
+    assert warm == payload
+    assert counter_value(
+        "noise_ec_object_gets_total", result="hit"
+    ) == hit0 + 1
+    assert counter_value(
+        "noise_ec_object_read_route_total", route="cache"
+    ) == route_cache0 + n_stripes
+
+    cache.clear()
+    route_decode0 = counter_value(
+        "noise_ec_object_read_route_total", route="decode"
+    )
+    cold = objects.read("acme", "x.bin")
+    assert cold == payload  # byte-identical across routes
+    assert counter_value(
+        "noise_ec_object_read_route_total", route="decode"
+    ) == route_decode0 + n_stripes
+    # The cold read write-through-repopulated the cache.
+    assert objects.read("acme", "x.bin") == payload
+    assert counter_value(
+        "noise_ec_object_gets_total", result="hit"
+    ) == hit0 + 2
+
+    # Range-GETs hit per stripe without whole-object materialization.
+    _, total, chunks = objects.get_range("acme", "x.bin", 100, 9_000)
+    assert b"".join(chunks) == payload[100:9_100] and total == 9_000
+
+
+def test_bounded_memory_lru_order_watermark_and_gauges():
+    """Fill past the ceiling: evictions run in LRU order, the bytes
+    gauge tracks residency, and the HBM pressure watermark shrinks the
+    effective ceiling (reason="pressure")."""
+    cache = DecodedObjectCache(
+        max_bytes=10_000, low_fraction=0.5,
+        pressure_interval_seconds=0.0,
+    )
+    hbm = {"limit_bytes": 0, "bytes_in_use": 0}
+    cache._hbm = lambda: hbm  # injectable gauge source
+
+    lru0 = counter_value(
+        "noise_ec_object_cache_evictions_total", reason="lru"
+    )
+    for i in range(4):
+        assert cache.put(f"addr{i}", 0, bytes(2_400))
+    assert cache.bytes_used == 9_600
+    cache.get("addr0", 0)  # bump addr0 to MRU
+    assert cache.put("addr4", 0, bytes(2_400))
+    # addr1 (LRU head after the addr0 bump) was evicted, addr0 kept.
+    assert not cache.contains("addr1", 0)
+    assert cache.contains("addr0", 0) and cache.contains("addr4", 0)
+    assert counter_value(
+        "noise_ec_object_cache_evictions_total", reason="lru"
+    ) == lru0 + 1
+    gauge = default_registry().gauge("noise_ec_object_cache_bytes")
+    assert gauge.labels().read() >= cache.bytes_used > 0
+
+    # Device pressure: the ceiling shrinks to low_fraction * max_bytes
+    # and the next insert sheds LRU entries down to it.
+    hbm.update({"limit_bytes": 100, "bytes_in_use": 90})
+    pressure0 = counter_value(
+        "noise_ec_object_cache_evictions_total", reason="pressure"
+    )
+    assert cache.put("addr5", 0, bytes(2_400))
+    assert cache.bytes_used <= 5_000
+    assert counter_value(
+        "noise_ec_object_cache_evictions_total", reason="pressure"
+    ) > pressure0
+    assert cache.contains("addr5", 0)  # the fresh insert survives
+
+    # Entry cap: one giant blob may not monopolize the cache.
+    assert not cache.put("huge", 0, bytes(4_000))  # > max_bytes // 4
+
+
+def test_invalidation_delete_and_overwrite_across_peers():
+    """Overwrite-PUT evicts every cached stripe of the OLD address on
+    the origin AND on peers that held it warm (the manifest-absorb
+    listener is the hook); DELETE evicts locally. Reads after the
+    overwrite serve the new bytes everywhere — a stale cache hit is
+    structurally impossible because the cache key IS the content
+    address."""
+    hub = LoopbackHub()
+    a_cache = DecodedObjectCache(max_bytes=32 << 20)
+    b_cache = DecodedObjectCache(max_bytes=32 << 20)
+    a = make_node(hub, 4200, cache=a_cache)
+    b = make_node(hub, 4201, cache=b_cache)
+    old = payload_bytes(11, 40_000)
+    new = payload_bytes(12, 30_000)
+
+    doc_old = a.put("acme", "doc.bin", old)
+    addr_old = doc_old["address"]
+    # Replication is synchronous on the loopback hub; warm B's cache.
+    assert b.read("acme", "doc.bin") == old
+    assert addr_old in a_cache.addresses()
+    assert addr_old in b_cache.addresses()
+
+    inval0 = counter_value(
+        "noise_ec_object_cache_evictions_total", reason="invalidate"
+    )
+    doc_new = a.put("acme", "doc.bin", new)
+    assert doc_new["address"] != addr_old
+    # The old address is cold on BOTH nodes; reads serve the new bytes.
+    assert addr_old not in a_cache.addresses()
+    assert addr_old not in b_cache.addresses()
+    assert counter_value(
+        "noise_ec_object_cache_evictions_total", reason="invalidate"
+    ) > inval0
+    assert a.read("acme", "doc.bin") == new
+    assert b.read("acme", "doc.bin") == new
+
+    # DELETE drops the new address locally (fleet-wide deletion stays
+    # operator policy — v1 scope, docs/object-service.md).
+    a.delete("acme", "doc.bin")
+    assert doc_new["address"] not in a_cache.addresses()
+
+    # Store-level stripe eviction invalidates the RAM copy through the
+    # delete-listener hook.
+    assert b.read("acme", "doc.bin") == new
+    key = doc_new["stripes"][0]
+    assert b.store.evict(key)
+    assert not b_cache.contains(doc_new["address"], 0)
+
+
+# ----------------------------------------------------------- coalescing
+
+
+def test_stampede_coalesces_to_one_decode():
+    """A concurrent stampede on one cold (address, stripe) costs ONE
+    underlying decode: the single-flight tier broadcasts the leader's
+    bytes, followers record result="coalesced", and the route counter
+    moves by exactly the stripe count."""
+    cache = DecodedObjectCache(max_bytes=32 << 20)
+    objects = make_node(LoopbackHub(), 4300, cache=cache)
+    payload = payload_bytes(21, 6_000)  # single stripe
+    doc = objects.put("acme", "hot.bin", payload)
+    assert len(doc["stripes"]) == 1
+    # Drop a data shard so the miss path reaches the degraded decode
+    # (past the join fast path), then make the decode slow enough that
+    # the stampede overlaps.
+    objects.store.drop_shard(doc["stripes"][0], 0)
+    cache.clear()
+
+    calls = []
+    barrier = threading.Barrier(6)
+    real_read = objects.store.read
+
+    def slow_read(key):
+        calls.append(key)
+        time.sleep(0.15)
+        return real_read(key)
+
+    objects.store.read = slow_read
+    route_decode0 = counter_value(
+        "noise_ec_object_read_route_total", route="decode"
+    )
+    coalesced0 = counter_value(
+        "noise_ec_object_gets_total", result="coalesced"
+    )
+    shared0 = counter_value(
+        "noise_ec_coalesce_flush_reason_total", reason="shared"
+    )
+    outs = [None] * 6
+
+    def reader(i):
+        barrier.wait()
+        outs[i] = objects.read("acme", "hot.bin")
+
+    threads = [
+        threading.Thread(target=reader, args=(i,)) for i in range(6)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(o == payload for o in outs)
+    assert len(calls) == 1  # ONE decode for 6 concurrent readers
+    assert counter_value(
+        "noise_ec_object_read_route_total", route="decode"
+    ) == route_decode0 + 1
+    assert counter_value(
+        "noise_ec_object_gets_total", result="coalesced"
+    ) > coalesced0
+    assert counter_value(
+        "noise_ec_coalesce_flush_reason_total", reason="shared"
+    ) > shared0
+
+
+def test_submit_shared_fans_errors_and_results():
+    """Unit pin for the single-flight tier: followers share the result,
+    and a leader exception propagates to every member."""
+    d = CoalescingDispatcher()
+    gate = threading.Event()
+    ran = []
+
+    def slow_ok():
+        ran.append(1)
+        gate.wait(2.0)
+        return "bytes"
+
+    results = []
+    t = threading.Thread(
+        target=lambda: results.append(d.submit_shared("k", slow_ok))
+    )
+    t.start()
+    while not ran:
+        time.sleep(0.001)
+    follower = threading.Thread(
+        target=lambda: results.append(d.submit_shared("k", slow_ok))
+    )
+    follower.start()
+    time.sleep(0.02)
+    gate.set()
+    t.join()
+    follower.join()
+    assert len(ran) == 1  # fn ran once
+    assert sorted(results) == [("bytes", False), ("bytes", True)]
+
+    with pytest.raises(ValueError):
+        d.submit_shared("err", lambda: (_ for _ in ()).throw(
+            ValueError("boom")
+        ))
+
+
+# ---------------------------------------------------------- peer routing
+
+
+def test_warm_peer_routing_breaker_and_advert_gc():
+    """B resolves a stripe it cannot serve locally from A's warm cache
+    over /objects (advertised on the announce loop), byte-identical;
+    when A's endpoint dies, the per-peer breaker opens and B degrades
+    to its local path. Consecutive adverts keep ONE stored advert
+    stripe per endpoint."""
+    hub = LoopbackHub()
+    a_cache = DecodedObjectCache(max_bytes=32 << 20)
+    b_cache = DecodedObjectCache(max_bytes=32 << 20)
+    a = make_node(hub, 4400, cache=a_cache)
+    b = make_node(hub, 4401, cache=b_cache, engine=False)
+    payload = payload_bytes(31, 40_000)
+    doc = a.put("acme", "warm.bin", payload)
+
+    srv = StatsServer(registry=Registry())
+    ObjectAPI(a).mount(srv)
+    a.enable_peer_routing(srv.url)
+    try:
+        # Two announce rounds: B learns A's warm set, and the second
+        # advert replaces the first's stored stripe (no accumulation).
+        a.engine.announce_once()
+        first_advert = dict(b._advert_stripes)
+        time.sleep(0.01)
+        a.engine.announce_once()
+        assert list(b._advert_stripes) == [srv.url]
+        old_stripe = first_advert[srv.url]
+        if old_stripe != b._advert_stripes[srv.url]:
+            assert old_stripe not in b.store.keys()
+        assert srv.url in b.directory.endpoints()
+        assert doc["address"] in b_cache.addresses() or True  # B warm later
+
+        # B cannot serve locally: every stripe below k, no engine.
+        for key in set(doc["stripes"]):
+            for num in range(3):
+                b.store.drop_shard(key, num)
+        b_cache.clear()
+        route_peer0 = counter_value(
+            "noise_ec_object_read_route_total", route="peer"
+        )
+        got = b.read("acme", "warm.bin")
+        assert got == payload  # byte-identical through the peer route
+        assert counter_value(
+            "noise_ec_object_read_route_total", route="peer"
+        ) == route_peer0 + len(doc["stripes"])
+        # The peer fetch write-through-warmed B: the next read hits RAM.
+        assert b.read("acme", "warm.bin") == payload
+        assert b_cache.addresses()
+    finally:
+        srv.close()
+
+    # Dead cache peer: fetches fail, the breaker opens after its
+    # threshold, and the read degrades to the local path (below k with
+    # no engine -> unavailable) instead of hanging.
+    b_cache.clear()
+    breaker = b.directory.breaker(srv.url)
+    for _ in range(2):
+        with pytest.raises(ObjectUnavailableError):
+            b.read("acme", "warm.bin")
+    assert breaker.state() == "open"
+    t0 = time.monotonic()
+    with pytest.raises(ObjectUnavailableError):
+        b.read("acme", "warm.bin")
+    assert time.monotonic() - t0 < 0.5  # breaker short-circuits the peer
+
+
+# ------------------------------------------------------- read admission
+
+
+def test_cold_cache_get_storm_sheds_and_never_decodes():
+    """The deflake guard: under a degraded SLO verdict a cold-cache GET
+    storm sheds every request with Retry-After (503 over HTTP) and
+    enqueues ZERO decodes — while warm-cache reads keep serving."""
+    slo = SLOEvaluator(window_seconds=60.0, min_events=1)
+    cache = DecodedObjectCache(max_bytes=32 << 20)
+    objects = make_node(LoopbackHub(), 4500, cache=cache, slo=slo)
+    payload = payload_bytes(41, 30_000)
+    objects.put("acme", "cold.bin", payload)
+
+    for _ in range(10):
+        slo.record("corrupt", 0.0)
+    assert not slo.verdict()["healthy"]
+
+    # Warm-cache reads are never shed: the PUT write-through covers the
+    # whole object, so the degraded node still serves it from RAM.
+    assert objects.read("acme", "cold.bin") == payload
+
+    cache.clear()
+    calls = []
+    real_read = objects.store.read
+    objects.store.read = lambda key: (calls.append(key), real_read(key))[1]
+    shed0 = counter_value("noise_ec_object_shed_total", reason="slo")
+    route_decode0 = counter_value(
+        "noise_ec_object_read_route_total", route="decode"
+    )
+    errors = []
+
+    def storm():
+        try:
+            objects.read("acme", "cold.bin")
+        except ShedError as exc:
+            errors.append(exc)
+
+    threads = [threading.Thread(target=storm) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(errors) == 6  # every request shed...
+    assert all(e.reason == "slo" and e.retry_after > 0 for e in errors)
+    assert calls == []  # ...and nothing decoded
+    assert counter_value(
+        "noise_ec_object_read_route_total", route="decode"
+    ) == route_decode0
+    assert counter_value(
+        "noise_ec_object_shed_total", reason="slo"
+    ) == shed0 + 6
+
+    # Over HTTP: 503 + Retry-After, same contract as PUT shed.
+    from urllib.error import HTTPError
+    from urllib.request import urlopen
+
+    srv = StatsServer(registry=Registry())
+    ObjectAPI(objects).mount(srv)
+    try:
+        with pytest.raises(HTTPError) as exc:
+            urlopen(f"{srv.url}/objects/acme/cold.bin", timeout=10)
+        assert exc.value.code == 503
+        assert float(exc.value.headers["Retry-After"]) > 0
+    finally:
+        srv.close()
+
+    # Recovery re-admits (and re-warms) the read path.
+    slo.reset()
+    assert objects.read("acme", "cold.bin") == payload
+
+
+# -------------------------------------------------- store lock satellite
+
+
+def test_get_takes_one_store_snapshot_per_request():
+    """The GET hot path snapshots the request's whole stripe set under
+    ONE store-lock acquisition (StripeStore.snapshot_many) instead of
+    re-locking per stripe; the healthy path never calls the per-stripe
+    status/read/snapshot entries."""
+    objects = make_node(LoopbackHub(), 4600, cache=None)
+    payload = payload_bytes(51, 60_000)
+    doc = objects.put("acme", "big.bin", payload)
+    assert len(doc["stripes"]) >= 4
+
+    store = objects.store
+    counts = {"many": 0, "single": 0}
+    real_many = store.snapshot_many
+
+    def counting_many(keys):
+        counts["many"] += 1
+        return real_many(keys)
+
+    def counting_single(*a, **kw):
+        counts["single"] += 1
+        raise AssertionError("per-stripe store entry on the hot path")
+
+    store.snapshot_many = counting_many
+    store.snapshot = counting_single
+    store.status = counting_single
+    store.read = counting_single
+    try:
+        assert objects.read("acme", "big.bin") == payload
+    finally:
+        del store.snapshot_many, store.snapshot, store.status, store.read
+    assert counts == {"many": 1, "single": 0}

@@ -25,7 +25,10 @@ north-star bar) — but until this tool nothing *noticed* when
   the recorded ``MULTICHIP_r*.json`` rounds prove the rig runs an
   N-device mesh (:func:`mesh_rig_check` — the ISSUE-9 guard; the
   ``batch_mesh_*`` sweep keys themselves ride the tight device
-  tolerance, the host-staged ``mesh_*`` stats the load-tail one).
+  tolerance, the host-staged ``mesh_*`` stats the load-tail one);
+- on fresh runs, holds the tiered read path to its bars
+  (:func:`cache_hot_check` — the ISSUE-12 guard: hot cached GETs >= 10x
+  the degraded decode path at >= 90% hit rate).
 
 Modes:
 
@@ -83,7 +86,18 @@ HOST_TOLERANCE = 0.35
 HOST_PREFIXES = (
     "host_node_", "decode_corrupt_", "cpu_shim_", "partition_recovery_",
     "store_repair_", "object_", "fleet_", "mesh_", "wire_",
+    # Redundant with "object_" but explicit: the hot-read cache stat is
+    # a host-path number (RAM-tier serve through the Python service
+    # layer) and must never accidentally land under device tolerance.
+    "object_get_hot",
 )
+
+# The ISSUE-12 hot-read acceptance bars (cache_hot_check, fresh runs):
+# the cache tier must serve hot GETs >= 10x the degraded decode path at
+# >= 90% hit rate under the zipfian mix — below either bar the cache is
+# not amortizing and the read path regressed to codec speed.
+CACHE_HOT_FACTOR = 10.0
+CACHE_HOT_HIT_RATE = 0.90
 
 # The ISSUE-11 wire hot-loop rig bars (ROADMAP transport item): applied
 # by wire_rig_check on fresh runs once the recorded MULTICHIP rounds
@@ -222,6 +236,35 @@ def wire_rig_check(stats: dict, repo: Path = REPO) -> list[str]:
             f"host_node_roundtrip_mb_per_s {rt} is {big / rt:.1f}x below "
             f"the large-object host path ({big}); the rig bar is "
             f"{WIRE_RIG_MBPS_FACTOR:.0f}x"
+        )
+    return problems
+
+
+def cache_hot_check(stats: dict) -> list[str]:
+    """ISSUE-12 acceptance bars for the tiered read path, fresh runs
+    only (recorded rounds before the decoded-object cache genuinely
+    lack the keys — and a replay must stay green)."""
+    try:
+        hot = float(stats["object_get_hot_mb_per_s"])
+        degraded = float(stats["object_get_degraded_mb_per_s"])
+    except (KeyError, TypeError, ValueError):
+        return []
+    problems = []
+    if degraded > 0 and hot < CACHE_HOT_FACTOR * degraded:
+        problems.append(
+            f"object_get_hot_mb_per_s {hot} is only {hot / degraded:.1f}x "
+            f"the degraded decode path ({degraded}); the cache-tier bar "
+            f"is {CACHE_HOT_FACTOR:.0f}x (docs/object-service.md)"
+        )
+    try:
+        rate = float(stats["object_get_hit_rate"])
+    except (KeyError, TypeError, ValueError):
+        return problems
+    if rate < CACHE_HOT_HIT_RATE:
+        problems.append(
+            f"object_get_hit_rate {rate} below the {CACHE_HOT_HIT_RATE} "
+            "bar under the zipfian GET mix — the hot-read number is not "
+            "being served by the cache tier"
         )
     return problems
 
@@ -474,6 +517,7 @@ def main(argv: list[str] | None = None) -> int:
         # numbers; replays must stay green).
         problems.extend(mesh_rig_check(current))
         problems.extend(wire_rig_check(current))
+        problems.extend(cache_hot_check(current))
     if args.json:
         print(json.dumps(
             {"against": against_name, "findings": findings,

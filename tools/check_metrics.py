@@ -51,6 +51,14 @@ and cross-checks every referenced name against the declarative registry:
   contract, the manifest magic) must appear in docs/object-service.md
   — that doc owns the API and tenancy semantics those series
   instrument, the same two-home rule the resilience families follow;
+- **cache docs parity**: the tiered read path's surfaces (the decoded
+  cache class, the warm-set magic, the single-flight coalescer entry,
+  the direct-route header, the cache CLI flag and the hot-read bench
+  keys) must appear in docs/object-service.md's "Read path" section —
+  that section owns the tier order, invalidation-by-address argument
+  and watermark policy the ``noise_ec_object_cache_*`` /
+  ``noise_ec_object_read_route_total`` families instrument (the
+  families themselves ride the object-docs check's prefix walk);
 - **wire docs parity**: the wire hot-loop families
   (``noise_ec_wire_*``) and the loop's surfaces (the recv ring, the
   batch-verify stage, SHARD_BATCH framing, the sendmsg flush, the
@@ -173,6 +181,7 @@ def check() -> list[str]:
     problems.extend(check_resilience_docs())
     problems.extend(check_device_docs())
     problems.extend(check_object_docs())
+    problems.extend(check_cache_docs())
     problems.extend(check_fleet_docs())
     problems.extend(check_datapath_docs())
     problems.extend(check_mesh_docs())
@@ -280,6 +289,36 @@ def check_object_docs() -> list[str]:
     return problems
 
 
+# The tiered read path's operator surfaces (docs/object-service.md
+# "Read path" owns the tier order, the invalidation-by-address argument
+# and the watermark policy): they exist only as identifiers/strings in
+# the code, so the METRICS prefix walk cannot see them drift.
+CACHE_DOC_TOKENS = (
+    "Read path",
+    "DecodedObjectCache",
+    "noise-ec-warmset/1",
+    "submit_shared",
+    "X-NoiseEC-Route",
+    "-object-cache-mb",
+    "object_get_hot_mb_per_s",
+    "object_get_hit_rate",
+)
+
+
+def check_cache_docs() -> list[str]:
+    """Read-path surfaces vs docs/object-service.md (module docstring)."""
+    doc_path = REPO / "docs" / "object-service.md"
+    if not doc_path.exists():
+        return [f"docs file {doc_path} missing"]
+    text = doc_path.read_text(encoding="utf-8")
+    return [
+        f"read-path surface {tok} is not documented in "
+        "docs/object-service.md (Read path section)"
+        for tok in CACHE_DOC_TOKENS
+        if tok not in text
+    ]
+
+
 # The fleet lab's metric families plus the backpressure family it
 # exposed as missing (docs/fleet.md owns the grammar, scoring semantics
 # and the device-to-transport backpressure chain those series
@@ -339,6 +378,7 @@ DATAPATH_DOC_TOKENS = (
     "donate_argnums",
     "copy_to_host_async",
     "submit_many",
+    "submit_shared",
     "matmul_stripes_many",
 )
 
