@@ -581,6 +581,128 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — secondary stat only
         stats["store_repair_error"] = str(exc)[:80]
 
+    # --- LRC repair storm: shard-fetch amplification at equal storage
+    # overhead (docs/lrc.md). Same single-loss storm run twice — once on
+    # RS(40,16) (n=56) and once on LRC(40, 8 local, 8 global) (n=56) —
+    # through scrub -> repair engine; repair_fetch_amplification is
+    # (LRC shards read per heal) / (RS shards read per heal) off the
+    # engine's noise_ec_store_repair_shards_read_total counters. The
+    # ISSUE-13 bar (>= 5x fewer fetches, i.e. <= 0.2) gates fresh runs
+    # in tools/bench_gate.py (lrc_repair_check); counts are exact, so
+    # the stat is deterministic round over round (0.125 here: a local
+    # heal reads its 5-member group cell instead of the full k=40).
+    try:
+        from noise_ec_tpu.obs.registry import default_registry as _lreg
+        from noise_ec_tpu.store import (
+            RepairEngine as _LRE,
+            Scrubber as _LSC,
+            StripeStore as _LSS,
+        )
+
+        k_l, g_l, n_l = 40, 8, 56
+        B_l, shard_l = 8, 8 << 10
+        reads_fam = _lreg().counter(
+            "noise_ec_store_repair_shards_read_total"
+        )
+        per_heal = {}
+        for code_label, code_str in (("rs", "rs"), ("lrc", f"lrc:{g_l}")):
+            store_l = _LSS(backend="numpy")
+            eng_l = _LRE(store_l, linger_seconds=0.0, max_batch=2 * B_l)
+            scr_l = _LSC(store_l, eng_l, interval_seconds=3600.0)
+            blobs_l = {}
+            for i in range(B_l):
+                sig = (0x4C52 + i).to_bytes(4, "little") + code_str.encode()
+                blob = rng.integers(
+                    0, 256, size=k_l * shard_l, dtype=np.uint8
+                ).tobytes()
+                blobs_l[store_l.put_object(
+                    sig, blob, k_l, n_l, code=code_str
+                )] = blob
+            child = reads_fam.labels(code=code_label)
+            r0 = child.value
+            for skey in blobs_l:
+                store_l.drop_shard(skey, 3)  # ONE data loss per stripe
+            scr_l.run_cycle()
+            healed = eng_l.drain_once()
+            check_smoke(healed == B_l,
+                        f"{code_label} storm healed {healed}/{B_l}")
+            for skey, blob in blobs_l.items():
+                check_smoke(store_l.read(skey) == blob,
+                            f"{code_label} repair produced wrong bytes")
+            per_heal[code_label] = (child.value - r0) / healed
+        stats["repair_fetch_amplification"] = round(
+            per_heal["lrc"] / per_heal["rs"], 4
+        )
+    except SmokeMismatch:
+        raise  # deterministic correctness failure: fail the run
+    except Exception as exc:  # noqa: BLE001 — secondary stat only
+        stats["lrc_repair_error"] = str(exc)[:80]
+
+    # --- hot->archival conversion throughput (docs/lrc.md): one cold
+    # 16 MiB object in hot RS(10,4) stripes merged into wide archival
+    # LRC(40/8+8) stripes through the conversion engine (decode-free
+    # gather + device-side re-encode + atomic manifest swap), then a
+    # byte-identity check across the boundary INCLUDING a degraded read
+    # with one data loss per archival stripe (local-tier heals).
+    try:
+        from noise_ec_tpu.host.plugin import ShardPlugin as _CSP
+        from noise_ec_tpu.host.transport import (
+            LoopbackHub as _CHub,
+            LoopbackNetwork as _CNet,
+            format_address as _cfmt,
+        )
+        from noise_ec_tpu.service import (
+            ObjectStore as _COS,
+            TenantRegistry as _CTR,
+        )
+        from noise_ec_tpu.store import (
+            ConversionEngine as _CCE,
+            RepairEngine as _CRE,
+            StripeStore as _CSS,
+        )
+
+        c_backend = "device" if on_tpu else "numpy"
+        c_hub = _CHub()
+        c_node = _CNet(c_hub, _cfmt("tcp", "localhost", 4000))
+        c_store = _CSS(backend=c_backend)
+        c_engine = _CRE(c_store, network=c_node, linger_seconds=0.0)
+        c_plugin = _CSP(backend=c_backend, store=c_store)
+        c_node.add_plugin(c_plugin)
+        c_tenants = _CTR()
+        c_tenants.configure(
+            "cold", policy="archive=lrc:40/8+8,age=0,stripe_bytes="
+            f"{4 << 20}"
+        )
+        c_objects = _COS(
+            c_store, c_plugin, c_node, tenants=c_tenants,
+            engine=c_engine, stripe_bytes=1 << 20, k=10, n=14,
+        )
+        conv_bytes = (32 if on_tpu else 16) << 20
+        cold_obj = rng.integers(
+            0, 256, size=conv_bytes, dtype=np.uint8
+        ).tobytes()
+        c_objects.put("cold", "glacier", cold_obj)
+        conv = _CCE(c_store, c_tenants, repair=c_engine)
+        t0 = time.perf_counter()
+        c_stats = conv.run_cycle()
+        t_conv = time.perf_counter() - t0
+        check_smoke(c_stats["converted"] == 1,
+                    f"conversion cycle converted {c_stats['converted']}/1")
+        c_doc = c_objects.resolve("cold", "glacier")
+        check_smoke(c_doc.get("code") == "lrc:8",
+                    f"archival manifest carries {c_doc.get('code')}")
+        check_smoke(c_objects.read("cold", "glacier") == cold_obj,
+                    "conversion changed object bytes")
+        for skey in c_doc["stripes"]:
+            c_store.drop_shard(skey, 1)
+        check_smoke(c_objects.read("cold", "glacier") == cold_obj,
+                    "degraded archival read returned wrong bytes")
+        stats["convert_mb_per_s"] = round(conv_bytes / t_conv / 1e6, 1)
+    except SmokeMismatch:
+        raise  # deterministic correctness failure: fail the run
+    except Exception as exc:  # noqa: BLE001 — secondary stat only
+        stats["convert_error"] = str(exc)[:80]
+
     # --- object service: PUT and degraded range-GET throughput through
     # the object layer (service/objects.py — chunk -> per-stripe sign +
     # erasure encode -> store + broadcast -> manifest; read = ranged

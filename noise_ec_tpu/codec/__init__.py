@@ -10,6 +10,11 @@ Two surfaces, mirroring the two codec interfaces in the reference's world:
   main.go:248-266, 73-77): share objects carrying their number, systematic
   layout, decode with error detection/correction.
 
+Layered on both: ``lrc.LocalReconstructionCode`` — Azure-style local
+parity groups over the same generator machinery (docs/lrc.md), healing a
+single loss from ~k/g group members instead of k, with the global
+parities as the past-budget fallback.
+
 Both dispatch to the same backends: pure NumPy ("numpy") or the JAX/Pallas
 device path ("device", geometry-cached kernels — see ``noise_ec_tpu.ops``).
 """
@@ -19,3 +24,8 @@ from noise_ec_tpu.codec.rs import (  # noqa: F401
     SubsetSearchTruncated,
 )
 from noise_ec_tpu.codec.fec import FEC, Share  # noqa: F401
+from noise_ec_tpu.codec.lrc import (  # noqa: F401
+    LocalReconstructionCode,
+    codec_for_code,
+    parse_code,
+)

@@ -32,6 +32,11 @@ Grammar (docs/fleet.md):
                        (default 1.1; must be > 1)
 ``repair=W``           weight of repair-storm ops (drop a stored shard,
                        degraded-read it back through the codec)
+``lrc@G``              run the repair-storm mix on the LRC tier
+                       (docs/lrc.md): each repair op exercises an
+                       LRC(k, G groups, n-k-G globals) stripe, healing
+                       single losses from ~k/G local shards; G must
+                       divide k and leave >= 1 global parity
 ``chat_bytes=B``       chat payload size (padded to a multiple of k)
 ``object_bytes=B``     object payload size
 ``stripe_bytes=B``     object-service stripe capacity
@@ -96,6 +101,9 @@ class FleetProfile:
     # (2 corrupt) combination.
     k: int = 4
     n: int = 8
+    # LRC local-group count for the repair mix (the ``lrc@G`` token);
+    # 0 = repair storms run on plain RS stripes.
+    lrc_groups: int = 0
     chaos_name: str = "clean"
     churn_peers: int = 0   # 0 = ~5% of the fleet when churn is scheduled
     chaos: ChaosProfile = field(default_factory=ChaosProfile)
@@ -116,6 +124,14 @@ class FleetProfile:
                 continue
             if tok.startswith(_CHAOS_PASSTHROUGH):
                 chaos_tokens.append(tok)
+                continue
+            if tok.startswith("lrc@"):
+                g = int(tok[len("lrc@"):])
+                if g < 1:
+                    raise ValueError(
+                        f"lrc@ group count must be >= 1, got {g}"
+                    )
+                kwargs["lrc_groups"] = g
                 continue
             if "=" not in tok:
                 raise ValueError(f"unparseable fleet token {tok!r}")
@@ -161,6 +177,18 @@ class FleetProfile:
             raise ValueError(f"zipf_s must be > 1, got {self.zipf_s}")
         if not 1 <= self.k <= self.n <= 256:
             raise ValueError(f"invalid fleet geometry k={self.k} n={self.n}")
+        if self.lrc_groups:
+            # The same parse-time contract service/tenants.py enforces:
+            # groups divide k, and >= 1 global parity remains.
+            if self.lrc_groups < 1 or self.k % self.lrc_groups:
+                raise ValueError(
+                    f"lrc@{self.lrc_groups} must divide k={self.k}"
+                )
+            if self.n - self.k - self.lrc_groups < 1:
+                raise ValueError(
+                    f"lrc@{self.lrc_groups} leaves no global parity "
+                    f"(k={self.k}, n={self.n})"
+                )
         if self.msgs < 1:
             raise ValueError(f"msgs must be >= 1, got {self.msgs}")
         if self.stripe_bytes < self.k:

@@ -565,9 +565,28 @@ class FleetLab:
     def submit_repair(self, sender: FleetPeer, rng) -> None:
         """One repair-storm op: drop a shard from a random stored stripe
         and degraded-read it back through the codec (success/failure is
-        scored; falls back to chat while the store is still empty)."""
+        scored; falls back to chat while the store is still empty).
+        With ``lrc@G`` in the profile the op runs on the LRC tier
+        instead: a seeded LRC(k, G, n-k-G) stripe loses one data shard
+        and the degraded read heals it from its ~k/G-member group cell
+        (codec/lrc.py local tier) — the fleet-scale proof that cheap
+        repair holds under the chaos profile."""
         if sender.store is None:
             self.submit_chat(sender, rng)
+            return
+        prof = self.profile
+        if prof.lrc_groups:
+            key = self._ensure_lrc_stripe(sender, rng)
+            try:
+                sender.store.drop_shard(
+                    key, int(rng.integers(0, prof.k))
+                )
+                sender.store.read(key)  # local-tier heal
+            except Exception as exc:  # noqa: BLE001 — scored, not raised
+                self.scorer.repair_result(False)
+                self._record_error(exc)
+            else:
+                self.scorer.repair_result(True)
             return
         keys = sender.store.keys()
         if not keys:
@@ -584,6 +603,27 @@ class FleetLab:
             self._record_error(exc)
         else:
             self.scorer.repair_result(True)
+
+    def _ensure_lrc_stripe(self, sender: FleetPeer, rng) -> str:
+        """The peer's store-local LRC stripe for the repair mix (lazily
+        created, seeded payload). LRC stripes are a STORE tier — the
+        wire path stays plain RS — so the repair op puts directly."""
+        keys = getattr(sender, "_lrc_keys", None)
+        if keys:
+            return keys[int(rng.integers(0, len(keys)))]
+        prof = self.profile
+        gs_bytes = max(prof.k, 512)
+        payload = rng.bytes(prof.k * gs_bytes)
+        sig = hashlib.blake2b(
+            b"noise-ec-fleet-lrc\0" + struct.pack("<I", sender.idx),
+            digest_size=32,
+        ).digest()
+        key = sender.store.put_object(
+            sig, payload, prof.k, prof.n,
+            code=f"lrc:{prof.lrc_groups}",
+        )
+        sender._lrc_keys = [key]
+        return key
 
     def _wait_drained(self, timeout: float) -> None:
         deadline = time.monotonic() + timeout

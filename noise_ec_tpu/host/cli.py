@@ -169,9 +169,19 @@ def build_parser() -> argparse.ArgumentParser:
         default="",
         metavar="FILE",
         help="tenant config JSON for the object service (namespaces, "
-        "byte/object quotas, per-tenant geometry, replication targets — "
-        "docs/object-service.md). Empty = open admission, unlimited "
-        "quotas",
+        "byte/object quotas, per-tenant geometry, replication targets, "
+        "hot->archival conversion policies — docs/object-service.md, "
+        "docs/lrc.md). Empty = open admission, unlimited quotas",
+    )
+    p.add_argument(
+        "-convert-interval",
+        type=float,
+        default=0.0,
+        metavar="SECONDS",
+        help="walk the object manifests every SECONDS converting cold "
+        "objects to their tenant's archival tier (policy grammar "
+        "'archive=lrc:K/G+R,age=...' — docs/lrc.md). 0 disables; "
+        "requires the object service (-object-port)",
     )
     p.add_argument(
         "-chaos-profile",
@@ -392,7 +402,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.stats_interval > 0:
         reporter = PeriodicReporter(args.stats_interval, stats_snapshot, log)
 
-    object_server = None
+    object_server = converter = None
     if args.object_port >= 0:
         from noise_ec_tpu.service import ObjectAPI, ObjectStore, TenantRegistry
 
@@ -431,6 +441,19 @@ def main(argv: list[str] | None = None) -> int:
         objects.enable_peer_routing(object_server.url)
         log.info("object service on %s/objects (%d tenants configured)",
                  object_server.url, len(tenants.names()))
+        if args.convert_interval > 0:
+            from noise_ec_tpu.store import ConversionEngine
+
+            converter = ConversionEngine(
+                store, tenants, cache=cache, repair=engine,
+                interval_seconds=args.convert_interval,
+            )
+            converter.start()
+            log.info(
+                "hot->archival conversion every %gs (per-tenant "
+                "'policy' drives the tier — docs/lrc.md)",
+                args.convert_interval,
+            )
 
     collector = None
     trace_peers = [u for u in args.trace_peers.split(",") if u]
@@ -537,6 +560,8 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         if fleet_lab is not None:
             fleet_lab.close()
+        if converter is not None:
+            converter.close()
         if scrubber is not None:
             scrubber.close()
         if engine is not None:

@@ -22,9 +22,18 @@ Constructions:
   all geometries. Kept (and tested for!) because BASELINE config 4 asks for
   the Cauchy-vs-PAR1 comparison. Smallest failure we exhibit: k=10, erased
   data shards {0, 9}, repaired from parity rows {0, 5}.
+- ``lrc:<g>``: Azure-style local reconstruction code (docs/lrc.md) — the k
+  data columns partition into ``g`` equal groups, rows k..k+g-1 are per-group
+  XOR parities (coefficient 1 over the group's columns — over GF(2^m),
+  addition IS XOR), and the remaining rows are the Cauchy global parities.
+  Deliberately NOT MDS: the local rows trade worst-case erasure tolerance
+  for single-loss repair that reads only the ~k/g surviving group members
+  (``codec.lrc.LocalReconstructionCode`` owns the repair-tier policy).
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 import numpy as np
 
@@ -81,9 +90,52 @@ def vandermonde_par1(gf: GF, k: int, n: int) -> np.ndarray:
     return G
 
 
+def parse_lrc_kind(kind: str, k: int, n: int) -> Optional[int]:
+    """Group count g of an ``"lrc:<g>"`` kind string (None for other
+    kinds), validated against the geometry: g must divide k, and at
+    least one global parity must remain beyond the g local rows — the
+    same contract ``service.tenants`` enforces at policy-parse time."""
+    if not kind.startswith("lrc:"):
+        return None
+    try:
+        g = int(kind[len("lrc:"):])
+    except ValueError:
+        raise ValueError(f"bad LRC kind {kind!r}: group count must be an int")
+    if g < 1:
+        raise ValueError(f"LRC group count must be >= 1, got {g}")
+    if k % g:
+        raise ValueError(
+            f"LRC group count {g} must divide data shards k={k}"
+        )
+    if n - k - g < 1:
+        raise ValueError(
+            f"LRC(k={k}, g={g}) needs >= 1 global parity; n={n} leaves "
+            f"{n - k - g}"
+        )
+    return g
+
+
+def lrc_generator(gf: GF, k: int, g: int, n: int) -> np.ndarray:
+    """(n, k) systematic LRC generator: identity, g local XOR-parity rows
+    (one per contiguous k/g-column group), then n-k-g Cauchy global rows."""
+    _check_geometry(gf, k, n)
+    gs = k // g
+    G = np.zeros((n, k), dtype=gf.dtype)
+    G[:k] = np.eye(k, dtype=gf.dtype)
+    for j in range(g):
+        G[k + j, j * gs : (j + 1) * gs] = 1
+    r = n - k - g
+    if r:
+        G[k + g :] = cauchy_parity(gf, k, r)
+    return G
+
+
 def generator_matrix(gf: GF, k: int, n: int, kind: str = "cauchy") -> np.ndarray:
     """(n, k) generator matrix of the requested construction."""
     _check_geometry(gf, k, n)
+    g = parse_lrc_kind(kind, k, n)
+    if g is not None:
+        return lrc_generator(gf, k, g, n)
     r = n - k
     if kind == "cauchy":
         G = np.zeros((n, k), dtype=gf.dtype)

@@ -58,6 +58,9 @@ PIPELINE_STAGES: tuple[str, ...] = (
     # one per repair dispatch (batched group or single-stripe restore).
     "scrub",
     "repair",
+    # Hot->archival conversion (docs/lrc.md): one span per converted
+    # object (gather -> re-encode -> manifest swap -> GC).
+    "convert",
 )
 
 # name -> (type, help, label names). The single source of truth for every
@@ -243,6 +246,51 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
     "noise_ec_store_anti_entropy_responses_total": (
         "counter",
         "Anti-entropy responses answered with local shards",
+        (),
+    ),
+    "noise_ec_store_repair_shards_read_total": (
+        "counter",
+        "Shards read as repair inputs by the engine's group drains, "
+        "labeled by codec code kind (rs, lrc) — the numerator of the "
+        "repair-storm bench's repair_fetch_amplification stat",
+        ("code",),
+    ),
+    # --- LRC repair tiers (codec/lrc.py, docs/lrc.md)
+    "noise_ec_lrc_repairs_total": (
+        "counter",
+        "Shards healed through the LRC codec, labeled by repair tier "
+        "(local = inside one group cell, global = full-k fallback)",
+        ("tier",),
+    ),
+    "noise_ec_lrc_repair_shards_read_total": (
+        "counter",
+        "Shards consumed as repair inputs by the LRC codec, labeled by "
+        "tier — local reads ~k/g per heal, global reads k",
+        ("tier",),
+    ),
+    # --- hot->archival conversion (store/convert.py, docs/lrc.md)
+    "noise_ec_convert_objects_total": (
+        "counter",
+        "Objects processed by the conversion engine, labeled by result "
+        "(converted, failed)",
+        ("result",),
+    ),
+    "noise_ec_convert_bytes_total": (
+        "counter",
+        "Logical object bytes re-encoded into archival stripes",
+        (),
+    ),
+    "noise_ec_convert_stripes_total": (
+        "counter",
+        "Source hot-tier stripes consumed by conversions, labeled by "
+        "gather mode (merge = decode-free data-shard join, reconstruct "
+        "= batched degraded rebuild)",
+        ("mode",),
+    ),
+    "noise_ec_convert_seconds": (
+        "histogram",
+        "Wall time per object conversion (gather, re-encode, manifest "
+        "swap, GC)",
         (),
     ),
     # --- resilience (noise_ec_tpu/resilience, docs/resilience.md)

@@ -23,7 +23,9 @@ amortizing tier:
 - :class:`PeerCacheDirectory` — which peers hold which addresses warm.
   Fed by warm-set adverts (:data:`WARMSET_MAGIC` objects piggybacked on
   the repair engine's announce loop — docs/object-service.md), each
-  entry maps an HTTP endpoint to its advertised address set with a TTL.
+  entry maps an HTTP endpoint to its advertised address set with a TTL
+  plus a ``load`` hint (the advertiser's in-flight reads), so routing
+  picks the LEAST-LOADED warm peer instead of the freshest advert.
   A per-endpoint :class:`~noise_ec_tpu.resilience.breakers.
   CircuitBreaker` guards the routing decision: a dead cache peer opens
   its breaker and the read degrades to the local decode path instead of
@@ -57,16 +59,21 @@ __all__ = [
 WARMSET_MAGIC = b"noise-ec-warmset/1\n"
 
 
-def warmset_blob(endpoint: str, addresses: Iterable[str]) -> bytes:
+def warmset_blob(
+    endpoint: str, addresses: Iterable[str], load: float = 0.0
+) -> bytes:
     """One warm-set advert payload: which addresses ``endpoint`` can
-    serve from its decoded cache. ``t`` (wall time) makes consecutive
-    adverts distinct objects — identical payloads would sign to the
-    identical stripe key and peers would absorb them as duplicates
-    without refreshing their directory TTL."""
+    serve from its decoded cache, plus the advertiser's ``load`` hint
+    (in-flight reads at advert time) so routing can pick the
+    LEAST-LOADED warm peer rather than the freshest advert. ``t`` (wall
+    time) makes consecutive adverts distinct objects — identical
+    payloads would sign to the identical stripe key and peers would
+    absorb them as duplicates without refreshing their directory TTL."""
     return WARMSET_MAGIC + json.dumps({
         "version": 1,
         "endpoint": endpoint,
         "addresses": list(addresses),
+        "load": float(load),
         "t": time.time(),
     }).encode()
 
@@ -90,6 +97,12 @@ def parse_warmset(data: bytes) -> Optional[dict]:
         isinstance(a, str) for a in addresses
     ):
         return None
+    # Load hint (PR-12 follow-on): absent in v1 adverts from older
+    # peers — coerce to 0.0 so mixed fleets keep routing.
+    load = doc.get("load", 0.0)
+    if not isinstance(load, (int, float)) or load < 0:
+        load = 0.0
+    doc["load"] = float(load)
     return doc
 
 
@@ -149,6 +162,11 @@ class DecodedObjectCache:
         self._lock = threading.Lock()
         self._entries: "OrderedDict[tuple[str, int], bytes]" = OrderedDict()
         self._by_addr: dict[str, set[int]] = {}
+        # Last touch (monotonic) per address: the conversion engine's
+        # temperature signal. Residency alone is NOT warmth — an LRU
+        # under no pressure never expires, so a write-through entry
+        # would otherwise pin its object hot forever.
+        self._addr_touched: dict[str, float] = {}
         self._by_stripe: dict[str, tuple[str, int]] = {}
         self._stripe_of: dict[tuple[str, int], str] = {}
         self.bytes_used = 0
@@ -174,6 +192,7 @@ class DecodedObjectCache:
             blob = self._entries.get((address, idx))
             if blob is not None:
                 self._entries.move_to_end((address, idx))
+                self._addr_touched[address] = time.monotonic()
         if blob is None:
             self._metrics.misses.add(1)
         else:
@@ -190,6 +209,22 @@ class DecodedObjectCache:
     def contains(self, address: str, idx: int) -> bool:
         with self._lock:
             return (address, idx) in self._entries
+
+    def warm(
+        self, address: str, within_seconds: Optional[float] = None
+    ) -> bool:
+        """True while a stripe of the address sits in the cache AND the
+        address was touched within ``within_seconds`` (None = any
+        resident entry counts) — the conversion engine's temperature
+        signal. Recency matters: an idle LRU never expires entries, so
+        residency alone would pin a write-through object hot forever."""
+        with self._lock:
+            if address not in self._by_addr:
+                return False
+            if within_seconds is None:
+                return True
+            touched = self._addr_touched.get(address, 0.0)
+            return time.monotonic() - touched <= within_seconds
 
     def addresses(self, limit: int = 256) -> list[str]:
         """Warm addresses, most recently used first — the node's
@@ -226,6 +261,7 @@ class DecodedObjectCache:
             self._entries[key] = blob
             self.bytes_used += len(blob)
             self._by_addr.setdefault(address, set()).add(idx)
+            self._addr_touched[address] = time.monotonic()
             if stripe_key is not None:
                 self._by_stripe[stripe_key] = key
                 self._stripe_of[key] = stripe_key
@@ -267,6 +303,7 @@ class DecodedObjectCache:
             count = len(self._entries)
             self._entries.clear()
             self._by_addr.clear()
+            self._addr_touched.clear()
             self._by_stripe.clear()
             self._stripe_of.clear()
             self.bytes_used = 0
@@ -286,6 +323,7 @@ class DecodedObjectCache:
             idxs.discard(idx)
             if not idxs:
                 self._by_addr.pop(address, None)
+                self._addr_touched.pop(address, None)
         skey = self._stripe_of.pop(key, None)
         if skey is not None:
             self._by_stripe.pop(skey, None)
@@ -334,8 +372,9 @@ class PeerCacheDirectory:
 
     ``observe`` ingests one advert; ``peers_for`` answers "who can serve
     this address from RAM right now" — fresh (within TTL) entries only,
-    most recently advertised first. Breakers are per endpoint and owned
-    here so the routing layer's failure handling has one home."""
+    least-loaded first (the advert's ``load`` hint; freshest advert
+    breaks ties). Breakers are per endpoint and owned here so the
+    routing layer's failure handling has one home."""
 
     def __init__(
         self,
@@ -346,8 +385,8 @@ class PeerCacheDirectory:
         self.ttl_seconds = ttl_seconds
         self.max_endpoints = max_endpoints
         self._lock = threading.Lock()
-        # endpoint -> (frozenset(addresses), observed_at)
-        self._peers: "OrderedDict[str, tuple[frozenset, float]]" = (
+        # endpoint -> (frozenset(addresses), observed_at, load hint)
+        self._peers: "OrderedDict[str, tuple[frozenset, float, float]]" = (
             OrderedDict()
         )
         self._breakers: dict[str, object] = {}
@@ -361,11 +400,15 @@ class PeerCacheDirectory:
                 )
         self._breaker_factory = breaker_factory
 
-    def observe(self, endpoint: str, addresses: Iterable[str]) -> None:
+    def observe(
+        self, endpoint: str, addresses: Iterable[str], load: float = 0.0
+    ) -> None:
         now = time.monotonic()
         with self._lock:
             self._peers.pop(endpoint, None)
-            self._peers[endpoint] = (frozenset(addresses), now)
+            self._peers[endpoint] = (
+                frozenset(addresses), now, max(0.0, float(load))
+            )
             while len(self._peers) > self.max_endpoints:
                 stale, _ = self._peers.popitem(last=False)
                 self._breakers.pop(stale, None)
@@ -376,12 +419,25 @@ class PeerCacheDirectory:
             self._breakers.pop(endpoint, None)
 
     def peers_for(self, address: str) -> list[str]:
+        """Fresh warm peers for the address, LEAST-LOADED first (the
+        PR-12 follow-on: a stampede of cold-stripe fetches used to pile
+        onto whichever peer advertised most recently; the load hint
+        spreads them). Ties break toward the freshest advert."""
         cutoff = time.monotonic() - self.ttl_seconds
         with self._lock:
-            return [
-                ep for ep, (addrs, t) in reversed(self._peers.items())
+            fresh = [
+                (load, -t, ep)
+                for ep, (addrs, t, load) in self._peers.items()
                 if t >= cutoff and address in addrs
             ]
+        fresh.sort()
+        return [ep for _, _, ep in fresh]
+
+    def load_of(self, endpoint: str) -> Optional[float]:
+        """The endpoint's last advertised load hint (None = unknown)."""
+        with self._lock:
+            entry = self._peers.get(endpoint)
+            return entry[2] if entry is not None else None
 
     def endpoints(self) -> list[str]:
         with self._lock:

@@ -260,6 +260,10 @@ class ObjectStore:
         self.directory = PeerCacheDirectory()
         self.advertise_url: Optional[str] = None
         self._advert_stripes: dict[str, str] = {}
+        # In-flight GET count: the warm-set advert's load hint, so warm
+        # peers route cold-stripe fetches to the LEAST-LOADED holder
+        # (docs/object-service.md "Read path").
+        self._live_reads = 0
         # PUT write-through stays bounded: objects bigger than this do
         # not pin their whole stripe set into the cache at once.
         self._write_through_cap = (
@@ -317,7 +321,9 @@ class ObjectStore:
         addresses = self.cache.addresses(limit=256)
         if not addresses:
             return
-        blob = warmset_blob(self.advertise_url, addresses)
+        with self._lock:
+            load = self._live_reads
+        blob = warmset_blob(self.advertise_url, addresses, load=load)
         k, n = self.default_k, self.default_n
         blob += b"\n" * ((-len(blob)) % k)
         self.plugin.shard_and_broadcast(self.network, blob, geometry=(k, n))
@@ -336,7 +342,9 @@ class ObjectStore:
             # announce interval and would otherwise accumulate forever.
             self.store.evict(prev)
         if endpoint != self.advertise_url:
-            self.directory.observe(endpoint, doc["addresses"])
+            self.directory.observe(
+                endpoint, doc["addresses"], load=doc.get("load", 0.0)
+            )
 
     def _on_store_evict(self, key: str) -> None:
         """Store delete listener: a stripe evicted out from under an
@@ -630,6 +638,8 @@ class ObjectStore:
             t0 = time.monotonic()
             sent = 0
             result = "ok"
+            with self._lock:
+                self._live_reads += 1
             try:
                 for i in range(i0, i1):
                     blob = self._read_stripe_tiered(
@@ -660,6 +670,8 @@ class ObjectStore:
                 result = "error"
                 raise
             finally:
+                with self._lock:
+                    self._live_reads -= 1
                 self._metrics.get(result)
                 self._metrics.get_bytes.add(sent)
                 self._metrics.get_seconds.observe(time.monotonic() - t0)

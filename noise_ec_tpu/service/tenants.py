@@ -70,6 +70,13 @@ class Tenant:
     # Per-tenant erasure geometry; 0 = the service default.
     k: int = 0
     n: int = 0
+    # Hot->archival conversion policy (docs/lrc.md; empty = never
+    # convert): e.g. "archive=lrc:20/4+6,age=600". Validated by
+    # ConversionPolicy.parse at configure time, so an unknown tier or an
+    # invalid LRC geometry (group count must divide k, >= 1 global
+    # parity) fails HERE with a clear ValueError, not in the background
+    # loop.
+    policy: str = ""
 
 
 class TenantRegistry:
@@ -93,7 +100,8 @@ class TenantRegistry:
             {"open_admission": false,
              "tenants": {"acme": {"max_bytes": 1073741824,
                                   "max_objects": 10000,
-                                  "replicas": 2, "k": 10, "n": 14}}}
+                                  "replicas": 2, "k": 10, "n": 14,
+                                  "policy": "archive=lrc:20/4+6,age=600"}}}
         """
         with open(path, "rb") as f:
             doc = json.load(f)
@@ -106,6 +114,7 @@ class TenantRegistry:
                 replicas=int(spec.get("replicas", 1)),
                 k=int(spec.get("k", 0)),
                 n=int(spec.get("n", 0)),
+                policy=str(spec.get("policy", "")),
             )
         return reg
 
@@ -121,6 +130,18 @@ class TenantRegistry:
                 )
         if tenant.replicas < 1:
             raise ValueError(f"tenant {name!r} replicas must be >= 1")
+        if tenant.policy:
+            # Parse-time policy validation (docs/lrc.md grammar): an
+            # unknown archival tier or an invalid LRC geometry must
+            # fail the configure call, not the background loop.
+            from noise_ec_tpu.store.convert import ConversionPolicy
+
+            try:
+                ConversionPolicy.parse(tenant.policy)
+            except ValueError as exc:
+                raise ValueError(
+                    f"tenant {name!r} policy {tenant.policy!r}: {exc}"
+                ) from exc
         self._tenants[name] = tenant
         return tenant
 

@@ -19,13 +19,20 @@ their codecs. Three pieces:
   by geometry into batched device dispatches (``parallel.batch``), writes
   repaired shards back, and falls back to anti-entropy shard fetch from
   peers over the existing SHARD transport opcode when local
-  reconstruction is impossible (more than n-k shards lost).
+  reconstruction is impossible (more than n-k shards lost). LRC stripes
+  (codec/lrc.py, docs/lrc.md) heal single losses from ~k/g local group
+  members instead of k.
+- :class:`ConversionEngine` (convert.py) — the hot→archival policy loop
+  (docs/lrc.md): merges cold narrow stripes into wide RS/LRC archival
+  generations via device-side re-encode, swapping manifests atomically
+  so degraded reads stay byte-identical across the boundary.
 
 Wiring: ``host/plugin.py`` lands verified receives in the store and feeds
 arriving shards to :meth:`StripeStore.note_shard`; ``host/cli.py`` exposes
 ``-store-dir`` / ``-scrub-interval``. See docs/store.md.
 """
 
+from noise_ec_tpu.store.convert import ConversionEngine, ConversionPolicy
 from noise_ec_tpu.store.repair import RepairEngine
 from noise_ec_tpu.store.scrub import Scrubber
 from noise_ec_tpu.store.stripe import (
@@ -36,6 +43,8 @@ from noise_ec_tpu.store.stripe import (
 )
 
 __all__ = [
+    "ConversionEngine",
+    "ConversionPolicy",
     "DegradedReadError",
     "RepairEngine",
     "Scrubber",

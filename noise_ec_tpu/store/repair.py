@@ -89,6 +89,15 @@ class _EngineMetrics:
         self.responses = reg.counter(
             "noise_ec_store_anti_entropy_responses_total"
         ).labels()
+        # Repair-input accounting by codec kind: the repair-storm
+        # bench's repair_fetch_amplification is (lrc reads per heal) /
+        # (rs reads per heal) off these counters (docs/lrc.md).
+        self.shards_read = {
+            code: reg.counter(
+                "noise_ec_store_repair_shards_read_total"
+            ).labels(code=code)
+            for code in ("rs", "lrc")
+        }
         self.announces = reg.counter(
             "noise_ec_store_announces_total"
         ).labels()
@@ -334,7 +343,8 @@ class RepairEngine:
                     if s is not None and i not in unverified
                 )
                 gkey = (
-                    meta.k, meta.n, meta.field, meta.shard_len, trusted
+                    meta.k, meta.n, meta.field, meta.shard_len, trusted,
+                    meta.code,
                 )
                 groups.setdefault(gkey, []).append((key, shards))
             else:
@@ -359,15 +369,36 @@ class RepairEngine:
     def _reconstruct_group(self, gkey: tuple, members: list) -> int:
         """Rebuild every non-trusted slot of a same-shape stripe group.
         B >= batch_min stripes fold into one batched device dispatch;
-        smaller groups take the per-stripe codec path."""
-        k, n, fieldname, shard_len, trusted = gkey
+        smaller groups take the per-stripe codec path. LRC stripes route
+        through the codec's tiered ``repair_many``: loss patterns inside
+        the group budget heal from ~k/g cell members per stripe (all
+        B×|wanted| heals in ONE coalesced all-ones dispatch) instead of
+        the full-k basis — the fetch-amplification win docs/lrc.md
+        quantifies."""
+        k, n, fieldname, shard_len, trusted, code = gkey
         wanted = [i for i in range(n) if i not in trusted]
         if not wanted or len(trusted) < k:
             return 0
         dt = self._sym_dtype(fieldname)
         repaired = 0
         with span("repair", stripes=len(members), k=k, n=n, **node_attrs()):
-            if len(members) >= self.batch_min:
+            from noise_ec_tpu.codec.lrc import LocalReconstructionCode
+
+            rs = self.store.codec(k, n, fieldname, code)
+            if isinstance(rs, LocalReconstructionCode):
+                plan = rs.repair_plan(trusted, wanted)
+                reads = (
+                    len({m for basis in plan.values() for m in basis})
+                    if plan is not None else k
+                )
+                rebuilt = rs.repair_many(
+                    [shards for _, shards in members], trusted, wanted
+                )
+                self.metrics.shards_read["lrc"].add(reads * len(members))
+                if plan is not None and len(members) >= self.batch_min:
+                    self.metrics.batches.add(1)
+                    self.metrics.batch_stripes.add(len(members))
+            elif len(members) >= self.batch_min:
                 # One coalesced dispatch for the whole group: the engine
                 # no longer keeps a private batch path — it hands the
                 # pre-formed batch to the live-path CoalescingDispatcher
@@ -378,9 +409,9 @@ class RepairEngine:
                 # device call as a repair storm.
                 from noise_ec_tpu.matrix.linalg import reconstruction_matrix
 
-                rs = self.store.codec(k, n, fieldname)
                 basis = sorted(trusted)[:k]
                 R = reconstruction_matrix(rs.gf, rs.G, basis, wanted)
+                self.metrics.shards_read["rs"].add(k * len(members))
                 stacks = [
                     np.stack([
                         np.frombuffer(shards[i], dtype=np.uint8).view(dt)
@@ -400,7 +431,7 @@ class RepairEngine:
                     for rows in filled
                 ]
             else:
-                rs = self.store.codec(k, n, fieldname)
+                self.metrics.shards_read["rs"].add(k * len(members))
                 required = [i in wanted for i in range(n)]
                 rebuilt = []
                 for _, shards in members:
@@ -433,14 +464,18 @@ class RepairEngine:
 
     # -------------------------------------------------- restore / verify
 
-    def _fec(self, k: int, n: int, fieldname: str):
-        fkey = (k, n, fieldname)
+    def _fec(self, k: int, n: int, fieldname: str, code: str = "rs"):
+        fkey = (k, n, fieldname, code)
         fec = self._fecs.get(fkey)
         if fec is None:
             from noise_ec_tpu.codec.fec import FEC
 
+            # The code kind IS the generator: an LRC stripe restores
+            # through FEC over the same "lrc:<g>" matrix (no GRS form,
+            # so correction runs the support-enumeration/subset tiers).
             fec = self._fecs[fkey] = FEC(
-                k, n, field=fieldname, backend="numpy"
+                k, n, field=fieldname, backend="numpy",
+                matrix="cauchy" if code == "rs" else code,
             )
         return fec
 
@@ -462,7 +497,7 @@ class RepairEngine:
         if len(present) < meta.k:
             self.enqueue(key, "fetch")
             return 0
-        fec = self._fec(meta.k, meta.n, meta.field)
+        fec = self._fec(meta.k, meta.n, meta.field, meta.code)
         with span("repair", key=key, kind="restore", **node_attrs()):
             try:
                 data_full = fec.decode(
@@ -489,7 +524,7 @@ class RepairEngine:
                 self.metrics.failures.add(1)
                 self.enqueue(key, "fetch")
                 return 0
-            rs = self.store.codec(meta.k, meta.n, meta.field)
+            rs = self.store.codec(meta.k, meta.n, meta.field, meta.code)
             stride = meta.shard_len // self._sym_dtype(meta.field).itemsize
             D = (
                 np.frombuffer(data_full, dtype=np.uint8)

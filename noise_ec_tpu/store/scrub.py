@@ -138,7 +138,10 @@ class Scrubber:
                         self._seen[key] = (missing, True)
                     self.engine.enqueue_auto(key)
                 else:
-                    gkey = (meta.k, meta.n, meta.field, meta.shard_len)
+                    gkey = (
+                        meta.k, meta.n, meta.field, meta.shard_len,
+                        meta.code,
+                    )
                     verify_groups.setdefault(gkey, []).append((key, shards))
                 self._throttle(t0, stats["scrubbed"])
             for gkey, members in verify_groups.items():
@@ -164,9 +167,11 @@ class Scrubber:
     def _verify_batch(self, gkey: tuple, members: list, stats: dict) -> None:
         """One batched parity check for B same-shape stripes: stack the
         data shards along the stripe axis and run a single (r, k) x
-        (k, B*S) multiply on the store codec's backend."""
-        k, n, fieldname, shard_len = gkey
-        rs = self.store.codec(k, n, fieldname)
+        (k, B*S) multiply on the store codec's backend (the r rows of an
+        LRC generator cover its local AND global parities, so one
+        multiply verifies both tiers)."""
+        k, n, fieldname, shard_len, code = gkey
+        rs = self.store.codec(k, n, fieldname, code)
         if rs.r == 0:
             ok = [True] * len(members)
         else:

@@ -37,6 +37,7 @@ from typing import Callable, Iterable, Optional
 
 import numpy as np
 
+from noise_ec_tpu.codec.lrc import codec_for_code, parse_code
 from noise_ec_tpu.codec.rs import ReedSolomon
 from noise_ec_tpu.obs.registry import default_registry
 from noise_ec_tpu.obs.trace import trace_key
@@ -77,6 +78,11 @@ class StripeMeta:
     shard_len: int
     object_len: int
     field: str = "gf256"
+    # Codec kind: "rs" (default) or "lrc:<g>" (docs/lrc.md — g local
+    # parity groups inside the n-k parity budget). The code travels with
+    # the stripe so every reader (degraded read, scrub verify, repair,
+    # conversion) rebuilds the SAME generator.
+    code: str = "rs"
     # Sender identity captured at put time: lets the repair engine verify
     # an error-corrected restore against the object signature, the same
     # end-to-end anchor the plugin's receive path uses. Optional — a
@@ -174,7 +180,7 @@ class StripeStore:
         # object service's decoded cache drops the RAM copy of a stripe
         # the store no longer backs.
         self._delete_listeners: list[Callable] = []
-        self._codecs: dict[tuple[int, int, str], ReedSolomon] = {}
+        self._codecs: dict[tuple[int, int, str, str], ReedSolomon] = {}
         self._codec_lock = threading.Lock()
         self.shard_bytes = 0
         # The repair engine registers itself so note_shard can classify
@@ -189,13 +195,15 @@ class StripeStore:
 
     # ------------------------------------------------------------- codecs
 
-    def codec(self, k: int, n: int, field: str = "gf256") -> ReedSolomon:
-        ckey = (k, n, field)
+    def codec(
+        self, k: int, n: int, field: str = "gf256", code: str = "rs"
+    ) -> ReedSolomon:
+        ckey = (k, n, field, code)
         with self._codec_lock:
             rs = self._codecs.get(ckey)
             if rs is not None:
                 return rs
-        rs = ReedSolomon(k, n - k, field=field, backend=self.backend)
+        rs = codec_for_code(code, k, n, field=field, backend=self.backend)
         with self._codec_lock:
             return self._codecs.setdefault(ckey, rs)
 
@@ -224,18 +232,21 @@ class StripeStore:
         n: int,
         *,
         field: str = "gf256",
+        code: str = "rs",
         sender_address: str = "",
         sender_public_key: bytes = b"",
     ) -> str:
         """Encode a (verified) object into a full trusted stripe; returns
         the store key. Re-putting the same key replaces the stripe — the
         put path only ever runs on signature-verified bytes, so the
-        replacement is at worst identical."""
+        replacement is at worst identical. ``code`` selects the codec
+        kind ("rs" or "lrc:<g>" — the archival tier's geometry)."""
         if not data:
             raise ValueError("cannot store an empty object")
         if not 1 <= k <= n:
             raise ValueError(f"invalid geometry k={k} n={n}")
-        rs = self.codec(k, n, field)
+        parse_code(code)  # reject unknown kinds before any encode
+        rs = self.codec(k, n, field, code)
         shards = [
             np.ascontiguousarray(s).view(np.uint8).tobytes()
             for s in rs.encode(rs.split(data))
@@ -247,6 +258,7 @@ class StripeStore:
             shard_len=len(shards[0]),
             object_len=len(data),
             field=field,
+            code=code,
             sender_address=sender_address,
             sender_public_key=bytes(sender_public_key),
         )
@@ -489,6 +501,7 @@ class StripeStore:
             return {
                 "k": stripe.meta.k,
                 "n": stripe.meta.n,
+                "code": stripe.meta.code,
                 "present": present,
                 "trusted": trusted,
                 "unverified": sorted(stripe.unverified),
@@ -547,7 +560,7 @@ class StripeStore:
                 f"need {k}"
             )
         self._metrics.degraded_reads.add(1)
-        rs = self.codec(k, meta.n, meta.field)
+        rs = self.codec(k, meta.n, meta.field, meta.code)
         full = rs.reconstruct_data(usable)
         return rs.join(full, meta.object_len)
 
@@ -642,7 +655,7 @@ class StripeStore:
             if s is not None and i not in unverified
         ]
         if len(trusted) >= meta.k:
-            rs = self.codec(meta.k, meta.n, meta.field)
+            rs = self.codec(meta.k, meta.n, meta.field, meta.code)
             usable = [
                 shards[i] if i in trusted else None for i in range(meta.n)
             ]
@@ -719,6 +732,7 @@ class StripeStore:
                 "shard_len": m.shard_len,
                 "object_len": m.object_len,
                 "field": m.field,
+                "code": m.code,
                 "sender_address": m.sender_address,
                 "sender_public_key": m.sender_public_key.hex(),
                 "unverified": sorted(stripe.unverified),
@@ -770,11 +784,13 @@ class StripeStore:
                     shard_len=int(doc["shard_len"]),
                     object_len=int(doc["object_len"]),
                     field=doc.get("field", "gf256"),
+                    code=doc.get("code", "rs"),
                     sender_address=doc.get("sender_address", ""),
                     sender_public_key=bytes.fromhex(
                         doc.get("sender_public_key", "")
                     ),
                 )
+                parse_code(meta.code)
             except (ValueError, KeyError, json.JSONDecodeError) as exc:
                 log.warning("skipping unreadable stripe %s: %s", key, exc)
                 continue

@@ -1,0 +1,384 @@
+"""Locally-repairable code tier (docs/lrc.md): generator kind, codec
+repair tiers, store/scrub/repair integration, the fetch-amplification
+acceptance bar, tenant/fleet grammar validation, and the warm-set load
+hint."""
+
+import numpy as np
+import pytest
+
+from noise_ec_tpu.codec.lrc import (
+    LocalReconstructionCode,
+    codec_for_code,
+    parse_code,
+)
+from noise_ec_tpu.gf.field import GF256
+from noise_ec_tpu.matrix.generators import generator_matrix, parse_lrc_kind
+from noise_ec_tpu.obs.registry import default_registry
+from noise_ec_tpu.store import RepairEngine, Scrubber, StripeStore
+
+
+def _sig(rng) -> bytes:
+    return bytes(rng.integers(0, 256, 64, dtype=np.uint8))
+
+
+def _as_bytes(row) -> bytes:
+    return bytes(np.ascontiguousarray(row).view(np.uint8))
+
+
+def _counter(name, **labels):
+    return default_registry().counter(name).labels(**labels)
+
+
+# ------------------------------------------------------------ generator
+
+
+def test_lrc_generator_kind():
+    gf = GF256()
+    G = generator_matrix(gf, 8, 12, "lrc:2")
+    assert G.shape == (12, 8)
+    assert np.array_equal(G[:8], np.eye(8, dtype=gf.dtype))
+    # Local rows: ones over each 4-column group, zero elsewhere.
+    assert list(G[8]) == [1, 1, 1, 1, 0, 0, 0, 0]
+    assert list(G[9]) == [0, 0, 0, 0, 1, 1, 1, 1]
+    # Global rows are the Cauchy block (nonzero everywhere).
+    assert np.all(G[10:] != 0)
+
+
+def test_lrc_kind_validation():
+    gf = GF256()
+    assert parse_lrc_kind("cauchy", 8, 12) is None
+    with pytest.raises(ValueError, match="divide"):
+        generator_matrix(gf, 8, 12, "lrc:3")
+    with pytest.raises(ValueError, match="global parity"):
+        generator_matrix(gf, 8, 10, "lrc:2")  # 2 locals eat all parity
+    with pytest.raises(ValueError, match=">= 1"):
+        generator_matrix(gf, 8, 12, "lrc:0")
+    with pytest.raises(ValueError, match="int"):
+        generator_matrix(gf, 8, 12, "lrc:x")
+
+
+def test_parse_code():
+    assert parse_code("rs") is None
+    assert parse_code("") is None
+    assert parse_code("lrc:4") == 4
+    with pytest.raises(ValueError):
+        parse_code("zstd")
+    with pytest.raises(ValueError):
+        parse_code("lrc:0")
+    assert codec_for_code("lrc:2", 8, 12, backend="numpy").g == 2
+    assert codec_for_code("rs", 4, 6, backend="numpy").r == 2
+
+
+# ---------------------------------------------------------------- codec
+
+
+@pytest.mark.parametrize("field,scale", [("gf256", 1), ("gf65536", 2)])
+def test_lrc_every_single_loss_heals_locally(rng, field, scale):
+    """Any single lost data or local-parity shard rebuilds from its
+    group cell alone (the local tier); a lost global parity falls back
+    to global. Bytes identical either way."""
+    lrc = LocalReconstructionCode(8, 2, 3, field=field, backend="numpy")
+    data = [
+        bytes(rng.integers(0, 256, 32 * scale, dtype=np.uint8))
+        for _ in range(8)
+    ]
+    full = [_as_bytes(s) for s in lrc.encode(data)]
+    assert lrc.verify(full)
+    local = _counter("noise_ec_lrc_repairs_total", tier="local")
+    glob = _counter("noise_ec_lrc_repairs_total", tier="global")
+    for lost in range(lrc.n):
+        shards = list(full)
+        shards[lost] = None
+        l0, g0 = local.value, glob.value
+        out = lrc.reconstruct(shards)
+        assert _as_bytes(out[lost]) == full[lost]
+        if lost < lrc.k + lrc.g:
+            assert (local.value, glob.value) == (l0 + 1, g0)
+        else:
+            assert (local.value, glob.value) == (l0, g0 + 1)
+
+
+def test_lrc_local_reads_are_group_sized(rng):
+    lrc = LocalReconstructionCode(12, 3, 2, backend="numpy")
+    data = [
+        bytes(rng.integers(0, 256, 16, dtype=np.uint8)) for _ in range(12)
+    ]
+    full = [_as_bytes(s) for s in lrc.encode(data)]
+    reads = _counter("noise_ec_lrc_repair_shards_read_total", tier="local")
+    r0 = reads.value
+    shards = list(full)
+    shards[5] = None
+    lrc.reconstruct(shards)
+    # group size k/g = 4: the heal reads the 3 other data members + the
+    # local parity, never the other 8 data shards or the globals.
+    assert reads.value - r0 == 4
+
+
+def test_lrc_tier_fallbacks(rng):
+    """Two losses in one cell exceed its budget -> global reconstruct;
+    losses spread across different cells stay local."""
+    lrc = LocalReconstructionCode(8, 2, 3, backend="numpy")
+    data = [
+        bytes(rng.integers(0, 256, 24, dtype=np.uint8)) for _ in range(8)
+    ]
+    full = [_as_bytes(s) for s in lrc.encode(data)]
+    # same cell (shard 0 and its group's parity 8): global
+    assert lrc.repair_plan(
+        set(range(lrc.n)) - {0, 8}, [0, 8]
+    ) is None
+    shards = list(full)
+    shards[0] = shards[8] = None
+    out = lrc.reconstruct(shards)
+    assert [_as_bytes(s) for s in out] == full
+    # different cells: both local
+    plan = lrc.repair_plan(set(range(lrc.n)) - {0, 5}, [0, 5])
+    assert plan is not None and len(plan[0]) == len(plan[5]) == 4
+    shards = list(full)
+    shards[0] = shards[5] = None
+    out = lrc.reconstruct(shards)
+    assert [_as_bytes(s) for s in out] == full
+    # up to r_global + 1 = 4 arbitrary erasures always recover
+    shards = list(full)
+    for i in (1, 2, 9, 10):
+        shards[i] = None
+    out = lrc.reconstruct(shards)
+    assert [_as_bytes(s) for s in out] == full
+
+
+def test_lrc_constructor_validation():
+    with pytest.raises(ValueError, match="divide"):
+        LocalReconstructionCode(8, 3, 2, backend="numpy")
+    with pytest.raises(ValueError, match="global parity"):
+        LocalReconstructionCode(8, 2, 0, backend="numpy")
+    with pytest.raises(ValueError, match=">= 1"):
+        LocalReconstructionCode(8, 0, 2, backend="numpy")
+
+
+def test_lrc_repair_many_batched(rng):
+    """B same-pattern stripes heal through one repair_many call; bytes
+    match the per-stripe path."""
+    lrc = LocalReconstructionCode(8, 2, 2, backend="numpy")
+    members, truths = [], []
+    for _ in range(5):
+        data = [
+            bytes(rng.integers(0, 256, 16, dtype=np.uint8))
+            for _ in range(8)
+        ]
+        full = [_as_bytes(s) for s in lrc.encode(data)]
+        truths.append(full)
+        shards = list(full)
+        shards[2] = shards[6] = None
+        members.append(shards)
+    trusted = [i for i in range(lrc.n) if i not in (2, 6)]
+    fixed = lrc.repair_many(members, trusted, [2, 6])
+    for full, out in zip(truths, fixed):
+        assert out[2] == full[2] and out[6] == full[6]
+
+
+# ------------------------------------------------------ store + repair
+
+
+def test_store_lrc_stripe_lifecycle(rng, tmp_path):
+    """put/read/degraded-read/persist round trip with an LRC code, and
+    the meta code survives disk."""
+    store = StripeStore(str(tmp_path), backend="numpy")
+    blob = bytes(rng.integers(0, 256, 8 * 48, dtype=np.uint8))
+    key = store.put_object(_sig(rng), blob, 8, 12, code="lrc:2")
+    assert store.meta(key).code == "lrc:2"
+    assert store.status(key)["code"] == "lrc:2"
+    assert store.read(key) == blob
+    store.drop_shard(key, 1)
+    assert store.read(key) == blob  # degraded read, local-tier heal
+    again = StripeStore(str(tmp_path), backend="numpy")
+    assert again.meta(key).code == "lrc:2"
+    assert again.read(key) == blob
+
+
+def test_store_rejects_unknown_code(rng):
+    store = StripeStore(backend="numpy")
+    with pytest.raises(ValueError, match="unknown codec code"):
+        store.put_object(_sig(rng), b"x" * 64, 4, 6, code="zstd")
+
+
+def test_repair_engine_fetch_amplification(rng):
+    """THE acceptance bar (ISSUE 13): the same single-loss repair storm
+    at equal storage overhead — LRC(24/8+8) vs RS(24,16), both n=40 —
+    must read >= 5x fewer shards per heal on the LRC tier, measured off
+    the engine's own counters (the bench stat's exact mechanism)."""
+    per_heal = {}
+    for label, code in (("rs", "rs"), ("lrc", "lrc:8")):
+        store = StripeStore(backend="numpy")
+        engine = RepairEngine(store, linger_seconds=0.0)
+        scrub = Scrubber(store, engine, interval_seconds=3600.0)
+        blobs = {}
+        for _ in range(6):
+            blob = bytes(rng.integers(0, 256, 24 * 32, dtype=np.uint8))
+            blobs[store.put_object(
+                _sig(rng), blob, 24, 40, code=code
+            )] = blob
+        child = _counter(
+            "noise_ec_store_repair_shards_read_total", code=label
+        )
+        r0 = child.value
+        for key in blobs:
+            store.drop_shard(key, 2)
+        scrub.run_cycle()
+        healed = engine.drain_once()
+        assert healed == 6
+        for key, blob in blobs.items():
+            assert store.status(key)["missing"] == []
+            assert store.read(key) == blob
+        per_heal[label] = (child.value - r0) / healed
+    # LRC(24/8+8): a heal reads the 3-member group cell; RS reads k=24.
+    assert per_heal["lrc"] == 3
+    assert per_heal["rs"] == 24
+    assert per_heal["rs"] / per_heal["lrc"] >= 5
+
+
+def test_repair_engine_lrc_past_budget_falls_back(rng):
+    """Two losses in one cell drain through the global tier and still
+    heal (bytes identical)."""
+    store = StripeStore(backend="numpy")
+    engine = RepairEngine(store, linger_seconds=0.0)
+    blob = bytes(rng.integers(0, 256, 8 * 32, dtype=np.uint8))
+    key = store.put_object(_sig(rng), blob, 8, 12, code="lrc:2")
+    store.drop_shard(key, 0)
+    store.drop_shard(key, 8)  # same cell as shard 0
+    engine.enqueue_auto(key)
+    assert engine.drain_once() == 1
+    assert store.status(key)["missing"] == []
+    assert store.read(key) == blob
+
+
+def test_scrub_restore_corrupt_lrc_stripe(rng):
+    """A silently corrupted shard on a full LRC stripe is caught by the
+    batched parity verify and fixed by the FEC restore over the
+    "lrc:<g>" generator (within the d = r+2 radius)."""
+    store = StripeStore(backend="numpy")
+    engine = RepairEngine(store, linger_seconds=0.0)
+    scrub = Scrubber(store, engine, interval_seconds=3600.0)
+    blob = bytes(rng.integers(0, 256, 8 * 32, dtype=np.uint8))
+    key = store.put_object(_sig(rng), blob, 8, 12, code="lrc:2")
+    store.corrupt_shard(key, 3, lambda b: bytes([b[0] ^ 0x5A]) + b[1:])
+    stats = scrub.run_cycle()
+    assert stats["flagged_corrupt"] == 1
+    assert engine.drain_once() == 1
+    assert store.read(key) == blob
+
+
+# --------------------------------------------------- grammar validation
+
+
+def test_tenant_lrc_policy_validation():
+    from noise_ec_tpu.service import TenantRegistry
+
+    reg = TenantRegistry()
+    t = reg.configure("cold", policy="archive=lrc:20/4+6,age=600")
+    assert t.policy == "archive=lrc:20/4+6,age=600"
+    with pytest.raises(ValueError, match="unknown archival tier"):
+        reg.configure("bad1", policy="archive=ice:20+6")
+    with pytest.raises(ValueError, match="divide"):
+        reg.configure("bad2", policy="archive=lrc:20/3+6")
+    with pytest.raises(ValueError, match="global parity"):
+        reg.configure("bad3", policy="archive=lrc:20/4+0")
+    with pytest.raises(ValueError, match="group count"):
+        reg.configure("bad4", policy="archive=lrc:20+6")
+    with pytest.raises(ValueError, match="archival tier"):
+        reg.configure("bad5", policy="age=600")
+    # the rejected names were never configured
+    assert reg.names() == ["cold"]
+
+
+def test_tenant_policy_from_file(tmp_path):
+    import json
+
+    from noise_ec_tpu.service import TenantRegistry
+
+    path = tmp_path / "tenants.json"
+    path.write_text(json.dumps({
+        "tenants": {"cold": {"policy": "archive=rs:20+8,age=60"}}
+    }))
+    reg = TenantRegistry.from_file(str(path))
+    assert reg.get("cold").policy == "archive=rs:20+8,age=60"
+    path.write_text(json.dumps({
+        "tenants": {"cold": {"policy": "archive=lrc:20/7+8"}}
+    }))
+    with pytest.raises(ValueError, match="divide"):
+        TenantRegistry.from_file(str(path))
+
+
+def test_fleet_lrc_token():
+    from noise_ec_tpu.fleet.profile import FleetProfile
+
+    prof = FleetProfile.parse("peers=8,repair=1,lrc@2")
+    assert prof.lrc_groups == 2
+    for bad in ("lrc@3", "lrc@4", "lrc@0"):
+        with pytest.raises(ValueError):
+            FleetProfile.parse(f"peers=8,{bad}")
+
+
+def test_fleet_lossy_delivery_holds_on_lrc_tier():
+    """ISSUE-13 satellite: the `lossy` profile's delivery-rate bar
+    holds while the repair mix exercises the LRC tier, and the local
+    repair tier actually engages."""
+    from noise_ec_tpu.fleet.profile import FleetProfile
+    from noise_ec_tpu.fleet.runner import FleetLab
+
+    local = _counter("noise_ec_lrc_repairs_total", tier="local")
+    l0 = local.value
+    prof = FleetProfile.parse(
+        "peers=12,fanout=3,msgs=60,chat=0.6,repair=0.4,chaos=lossy,lrc@2"
+    )
+    lab = FleetLab(prof, seed=3)
+    try:
+        report = lab.run(drain_timeout=30.0)
+    finally:
+        lab.close()
+    assert report["delivery"]["rate"] >= 0.999
+    assert report["repair"]["failed"] == 0
+    assert local.value > l0
+
+
+# ------------------------------------------------- warm-set load hints
+
+
+def test_warmset_advert_carries_load():
+    from noise_ec_tpu.service.cache import parse_warmset, warmset_blob
+
+    doc = parse_warmset(warmset_blob("http://a:1", ["aa" * 8], load=3))
+    assert doc["load"] == 3.0
+    # v1 adverts without the hint keep parsing (mixed fleets)
+    import json
+
+    from noise_ec_tpu.service.cache import WARMSET_MAGIC
+
+    legacy = WARMSET_MAGIC + json.dumps({
+        "version": 1, "endpoint": "http://b:1",
+        "addresses": ["aa" * 8], "t": 0.0,
+    }).encode()
+    doc = parse_warmset(legacy)
+    assert doc is not None and doc["load"] == 0.0
+    # junk loads coerce to 0, not a crash
+    junk = WARMSET_MAGIC + json.dumps({
+        "version": 1, "endpoint": "http://c:1",
+        "addresses": ["aa" * 8], "load": "busy", "t": 0.0,
+    }).encode()
+    assert parse_warmset(junk)["load"] == 0.0
+
+
+def test_peer_directory_routes_least_loaded_first():
+    from noise_ec_tpu.service.cache import PeerCacheDirectory
+
+    d = PeerCacheDirectory()
+    addr = "ab" * 8
+    d.observe("http://busy:1", [addr], load=9)
+    d.observe("http://idle:1", [addr], load=0)
+    d.observe("http://mid:1", [addr], load=4)
+    # least-loaded first, NOT freshest-advert first
+    assert d.peers_for(addr) == [
+        "http://idle:1", "http://mid:1", "http://busy:1"
+    ]
+    assert d.load_of("http://busy:1") == 9.0
+    # tie on load -> freshest advert wins
+    d.observe("http://idle2:1", [addr], load=0)
+    assert d.peers_for(addr)[0] == "http://idle2:1"

@@ -28,7 +28,11 @@ north-star bar) — but until this tool nothing *noticed* when
   tolerance, the host-staged ``mesh_*`` stats the load-tail one);
 - on fresh runs, holds the tiered read path to its bars
   (:func:`cache_hot_check` — the ISSUE-12 guard: hot cached GETs >= 10x
-  the degraded decode path at >= 90% hit rate).
+  the degraded decode path at >= 90% hit rate);
+- on fresh runs, holds the LRC tier to its fetch-amplification bar
+  (:func:`lrc_repair_check` — the ISSUE-13 guard: a single-loss heal on
+  LRC reads >= 5x fewer shards than equal-overhead RS, i.e.
+  ``repair_fetch_amplification`` <= 0.2).
 
 Modes:
 
@@ -72,7 +76,11 @@ HIGHER_BETTER_SUFFIXES = ("_gbps", "_mb_per_s", "_msgs_per_s", "_per_s")
 # the wide-geometry sweep keys rs100_30_encode_gbps /
 # rs200_56_decode_corrupt_p50_ms get device tolerance from their
 # suffixes the same way).
-LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_ratio")
+LOWER_BETTER_SUFFIXES = ("_ms", "_s", "_ratio", "_amplification")
+# "_amplification" keys are read-cost ratios like "_ratio"
+# (repair_fetch_amplification: LRC shards read per heal over RS shards
+# read per heal — docs/lrc.md): lower is the whole point, and a rise
+# past tolerance means single-loss repair stopped being local.
 
 DEFAULT_TOLERANCE = 0.10
 # Host-path stats ride a single shared core with measured 10-40% load
@@ -90,6 +98,12 @@ HOST_PREFIXES = (
     # a host-path number (RAM-tier serve through the Python service
     # layer) and must never accidentally land under device tolerance.
     "object_get_hot",
+    # Conversion throughput crosses the Python service layer per stripe
+    # (gather + manifest swap), so load tails apply. NOTE:
+    # repair_fetch_amplification deliberately does NOT ride a host
+    # prefix — it is an exact shard count ratio, deterministic round
+    # over round, and gets the tight device tolerance.
+    "convert_",
 )
 
 # The ISSUE-12 hot-read acceptance bars (cache_hot_check, fresh runs):
@@ -98,6 +112,13 @@ HOST_PREFIXES = (
 # not amortizing and the read path regressed to codec speed.
 CACHE_HOT_FACTOR = 10.0
 CACHE_HOT_HIT_RATE = 0.90
+
+# The ISSUE-13 LRC acceptance bar (lrc_repair_check, fresh runs): a
+# single-loss heal on the LRC tier must read >= 5x fewer shards than
+# the equal-overhead RS geometry — repair_fetch_amplification (LRC
+# reads per heal / RS reads per heal, docs/lrc.md) <= 0.2. Above it the
+# local-repair tier is not engaging and repair cost regressed to k.
+LRC_FETCH_AMPLIFICATION_MAX = 0.2
 
 # The ISSUE-11 wire hot-loop rig bars (ROADMAP transport item): applied
 # by wire_rig_check on fresh runs once the recorded MULTICHIP rounds
@@ -267,6 +288,23 @@ def cache_hot_check(stats: dict) -> list[str]:
             "being served by the cache tier"
         )
     return problems
+
+
+def lrc_repair_check(stats: dict) -> list[str]:
+    """ISSUE-13 acceptance bar for the LRC tier, fresh runs only
+    (recorded rounds before the LRC tier genuinely lack the key)."""
+    try:
+        amp = float(stats["repair_fetch_amplification"])
+    except (KeyError, TypeError, ValueError):
+        return []
+    if amp > LRC_FETCH_AMPLIFICATION_MAX:
+        return [
+            f"repair_fetch_amplification {amp} above the "
+            f"{LRC_FETCH_AMPLIFICATION_MAX} bar — LRC single-loss heals "
+            "are not staying local (docs/lrc.md; the >= 5x fewer-fetches "
+            "acceptance bar)"
+        ]
+    return []
 
 
 def north_star_check(stats: dict) -> list[str]:
@@ -518,6 +556,7 @@ def main(argv: list[str] | None = None) -> int:
         problems.extend(mesh_rig_check(current))
         problems.extend(wire_rig_check(current))
         problems.extend(cache_hot_check(current))
+        problems.extend(lrc_repair_check(current))
     if args.json:
         print(json.dumps(
             {"against": against_name, "findings": findings,

@@ -65,6 +65,14 @@ and cross-checks every referenced name against the declarative registry:
   ``-recv-shards`` flag) must appear in docs/design.md §15 "Wire hot
   loop" — that section owns the ring layout, batch-verify policy and
   REUSEPORT sharding those series instrument;
+- **LRC docs parity**: the locally-repairable-code + conversion
+  families (``noise_ec_lrc_*``, ``noise_ec_convert_*``, the engine's
+  per-code shards-read counter) and the tier's surfaces (the codec and
+  engine classes, the policy grammar, the ``lrc@`` fleet token, the
+  ``-convert-interval`` flag, the bench keys) must appear in
+  docs/lrc.md — that doc owns the group layout, repair tier order,
+  conversion policy grammar and fetch-amplification math those series
+  instrument;
 - **panel docs parity**: the wide-geometry panel-tier families
   (``noise_ec_kernel_tile_*``) and the tier's surfaces (the panel
   kernel/planner entry points, the packed GF(2^16) layout helpers, the
@@ -187,6 +195,7 @@ def check() -> list[str]:
     problems.extend(check_mesh_docs())
     problems.extend(check_panel_docs())
     problems.extend(check_wire_docs())
+    problems.extend(check_lrc_docs())
     return problems
 
 
@@ -531,6 +540,54 @@ def check_wire_docs() -> list[str]:
     problems.extend(
         f"wire surface {tok} is not documented in docs/design.md"
         for tok in WIRE_DOC_TOKENS
+        if tok not in text
+    )
+    return problems
+
+
+# The LRC + conversion tier (docs/lrc.md owns the group layout, repair
+# tier order, conversion policy grammar and fetch-amplification math the
+# noise_ec_lrc_* / noise_ec_convert_* families — and the engine's
+# per-code shards-read counter — instrument): its families must be
+# documented there as well as in the observability registry table, plus
+# the surfaces that exist only as identifiers/strings in the code.
+LRC_PREFIXES = ("noise_ec_lrc_", "noise_ec_convert_")
+LRC_EXTRAS = ("noise_ec_store_repair_shards_read_total",)
+LRC_DOC_TOKENS = (
+    "LocalReconstructionCode",
+    "ConversionEngine",
+    "ConversionPolicy",
+    "lrc:K/G+R",
+    "archive=",
+    "lrc@",
+    "-convert-interval",
+    "repair_fetch_amplification",
+    "convert_mb_per_s",
+    "prev_stripes",
+)
+
+
+def check_lrc_docs() -> list[str]:
+    """LRC/conversion families + surfaces vs docs/lrc.md."""
+    from noise_ec_tpu.obs.registry import METRICS
+
+    doc_path = REPO / "docs" / "lrc.md"
+    names = [n for n in METRICS if n.startswith(LRC_PREFIXES)] + [
+        n for n in LRC_EXTRAS if n in METRICS
+    ]
+    if not names:
+        return []
+    if not doc_path.exists():
+        return [f"docs file {doc_path} missing (LRC metrics exist)"]
+    text = doc_path.read_text(encoding="utf-8")
+    problems = [
+        f"LRC metric {n!r} is not documented in docs/lrc.md"
+        for n in names
+        if not re.search(rf"\b{re.escape(n)}\b", text)
+    ]
+    problems.extend(
+        f"LRC surface {tok} is not documented in docs/lrc.md"
+        for tok in LRC_DOC_TOKENS
         if tok not in text
     )
     return problems
