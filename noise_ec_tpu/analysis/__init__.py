@@ -1,0 +1,46 @@
+"""Machine-checked invariants (docs/static-analysis.md).
+
+Thirteen PRs of this codebase rest on conventions that used to live
+only in prose and hard-won runtime fixes: loop threads must not block
+(PR 7's ``submit_wait`` split, PR 4's ``wait_writable`` guard), donated
+device arrays are invalidated exactly once (PR 8), exported
+``_FrameRing`` views never escape the parse scope (PR 11), and every
+metric/span name is declared before use (PR 1). This package turns
+those conventions into analyzers that run in tier-1:
+
+- :mod:`core` — the AST-walking framework: rule registry, per-line
+  ``# noise-ec: allow(<rule>)`` suppressions, the project model;
+- :mod:`rules` — the concurrency/dataflow rules (loop-affinity,
+  donation, zero-copy);
+- :mod:`registry_rules` — the metric/span/docs discipline rules
+  (subsuming ``tools/check_metrics.py``, which remains as a CLI shim);
+- :mod:`lockgraph` — the dynamic lock-order + loop-blocking harness
+  (lockdep/tsan-lite) that the chaos-soak and fleet tests run under.
+
+Entry points: ``tools/lint.py --all`` on the command line,
+:func:`run_project` in-process (tests/test_static_analysis.py).
+"""
+
+from noise_ec_tpu.analysis.core import (
+    FILE_RULES,
+    PROJECT_RULES,
+    Finding,
+    Project,
+    SourceFile,
+    all_rules,
+    run_project,
+)
+
+# Importing the rule modules registers their rules with the framework.
+from noise_ec_tpu.analysis import rules as _rules  # noqa: F401,E402
+from noise_ec_tpu.analysis import registry_rules as _registry_rules  # noqa: F401,E402
+
+__all__ = [
+    "FILE_RULES",
+    "PROJECT_RULES",
+    "Finding",
+    "Project",
+    "SourceFile",
+    "all_rules",
+    "run_project",
+]

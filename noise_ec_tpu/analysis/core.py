@@ -1,0 +1,245 @@
+"""Static-analysis framework: rules, suppressions, the project model.
+
+Everything is stdlib ``ast`` — no new dependencies. A **rule** is a
+callable registered under a stable id; it either checks one
+:class:`SourceFile` at a time (``scope="file"``) or the whole
+:class:`Project` at once (``scope="project"``, for registry/docs
+cross-checks that have no single home file). Rules yield
+:class:`Finding`s; the runner filters findings suppressed by a
+
+    # noise-ec: allow(<rule-id>) — <one-line justification>
+
+comment on the flagged line or the line directly above it. The
+suppression syntax is deliberately loud (greppable, justified) — the
+catalog in docs/static-analysis.md is the contract for when one is
+acceptable.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Iterable, Optional
+
+__all__ = [
+    "FILE_RULES",
+    "PROJECT_RULES",
+    "Finding",
+    "Project",
+    "Rule",
+    "SourceFile",
+    "all_rules",
+    "rule",
+    "run_project",
+]
+
+REPO = Path(__file__).resolve().parent.parent.parent
+PKG = REPO / "noise_ec_tpu"
+
+_ALLOW = re.compile(r"#\s*noise-ec:\s*allow\(([A-Za-z0-9_,\- ]+)\)")
+_LOOP_AFFINE = re.compile(r"#\s*noise-ec:\s*loop-affine\b")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to a repo-relative path + line."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Rule:
+    id: str
+    scope: str  # "file" | "project"
+    invariant: str  # one-line statement of what must hold
+    motivation: str  # the PR / incident that made it a rule
+    check: Callable = field(repr=False, default=None)
+
+
+FILE_RULES: dict[str, Rule] = {}
+PROJECT_RULES: dict[str, Rule] = {}
+
+
+def rule(id: str, *, scope: str, invariant: str, motivation: str):
+    """Register a rule. File rules take ``(SourceFile) -> Iterable[
+    Finding]``; project rules take ``(Project) -> Iterable[Finding]``."""
+    if scope not in ("file", "project"):
+        raise ValueError(f"bad rule scope {scope!r}")
+    registry = FILE_RULES if scope == "file" else PROJECT_RULES
+
+    def deco(fn):
+        if id in FILE_RULES or id in PROJECT_RULES:
+            raise ValueError(f"duplicate rule id {id!r}")
+        registry[id] = Rule(
+            id=id, scope=scope, invariant=invariant,
+            motivation=motivation, check=fn,
+        )
+        return fn
+
+    return deco
+
+
+def all_rules() -> dict[str, Rule]:
+    return {**FILE_RULES, **PROJECT_RULES}
+
+
+class SourceFile:
+    """One parsed Python source file plus its per-line suppressions."""
+
+    def __init__(self, path: Path, root: Path = REPO,
+                 text: Optional[str] = None):
+        self.path = Path(path)
+        try:
+            self.rel = str(self.path.relative_to(root))
+        except ValueError:
+            self.rel = str(self.path)
+        self.text = self.path.read_text(encoding="utf-8") if text is None else text
+        self.tree = ast.parse(self.text, filename=self.rel)
+        self.lines = self.text.splitlines()
+        # line number (1-based) -> rule ids allowed there
+        self.allows: dict[int, set[str]] = {}
+        # line numbers carrying the loop-affine marker (annotating a def)
+        self.loop_affine_lines: set[int] = set()
+        for i, line in enumerate(self.lines, start=1):
+            m = _ALLOW.search(line)
+            if m:
+                ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+                self.allows.setdefault(i, set()).update(ids)
+            if _LOOP_AFFINE.search(line):
+                self.loop_affine_lines.add(i)
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        """Allowed on the flagged line or the line directly above."""
+        for ln in (line, line - 1):
+            ids = self.allows.get(ln)
+            if ids and rule_id in ids:
+                return True
+        return False
+
+
+class Project:
+    """The analyzable tree: package sources + docs + the live registry.
+
+    ``metrics`` / ``pipeline_stages`` default to the real
+    ``obs.registry`` declarations but are injectable so rule tests can
+    pin firing behavior against synthetic registries without touching
+    the production one.
+    """
+
+    def __init__(
+        self,
+        root: Path = REPO,
+        package: Path = PKG,
+        files: Optional[list[SourceFile]] = None,
+        metrics: Optional[dict] = None,
+        pipeline_stages: Optional[tuple] = None,
+    ):
+        self.root = Path(root)
+        self.package = Path(package)
+        if files is None:
+            files = [
+                SourceFile(p, root=self.root)
+                for p in sorted(self.package.rglob("*.py"))
+                if "__pycache__" not in p.parts
+            ]
+        self.files = files
+        self._metrics = metrics
+        self._pipeline_stages = pipeline_stages
+        self._docs: dict[str, Optional[str]] = {}
+
+    @property
+    def metrics(self) -> dict:
+        if self._metrics is None:
+            from noise_ec_tpu.obs.registry import METRICS
+
+            self._metrics = METRICS
+        return self._metrics
+
+    @property
+    def pipeline_stages(self) -> tuple:
+        if self._pipeline_stages is None:
+            from noise_ec_tpu.obs.registry import PIPELINE_STAGES
+
+            self._pipeline_stages = PIPELINE_STAGES
+        return self._pipeline_stages
+
+    def doc_text(self, relpath: str) -> Optional[str]:
+        """The text of a repo doc (cached), or None when absent."""
+        if relpath not in self._docs:
+            p = self.root / relpath
+            self._docs[relpath] = (
+                p.read_text(encoding="utf-8") if p.exists() else None
+            )
+        return self._docs[relpath]
+
+    def set_doc(self, relpath: str, text: Optional[str]) -> None:
+        """Inject doc content (rule tests)."""
+        self._docs[relpath] = text
+
+
+def run_project(
+    project: Optional[Project] = None,
+    rule_ids: Optional[Iterable[str]] = None,
+) -> list[Finding]:
+    """Run the selected rules (default: all) over the project, dropping
+    suppressed findings. Findings sort by (path, line, rule)."""
+    project = project or Project()
+    wanted = set(rule_ids) if rule_ids is not None else None
+    findings: list[Finding] = []
+    by_rel = {f.rel: f for f in project.files}
+    for rid, r in FILE_RULES.items():
+        if wanted is not None and rid not in wanted:
+            continue
+        for sf in project.files:
+            for f in r.check(sf):
+                if not sf.suppressed(f.rule, f.line):
+                    findings.append(f)
+    for rid, r in PROJECT_RULES.items():
+        if wanted is not None and rid not in wanted:
+            continue
+        for f in r.check(project):
+            sf = by_rel.get(f.path)
+            if sf is not None and sf.suppressed(f.rule, f.line):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------- AST helpers
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """``foo(...)`` -> "foo"; ``a.b.c(...)`` -> "c"; else None."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def dotted(node: ast.expr) -> Optional[str]:
+    """``a.b.c`` -> "a.b.c" for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_str(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

@@ -1,0 +1,425 @@
+"""Dynamic lock-order + loop-blocking harness (lockdep / tsan-lite).
+
+Opt-in instrumentation for test time: :func:`install` monkeypatches
+``threading.Lock`` / ``threading.RLock`` so every lock created while
+installed is a recording wrapper, and patches ``time.sleep`` to see
+sleeps on loop threads. The wrappers feed one process-wide
+:class:`LockGraph`:
+
+- **lock-order edges** — acquiring B while holding A records the
+  directed edge A→B (per lock *instance*, with the creation sites and
+  the first acquisition stack kept for the report). A cycle in that
+  graph is a latent deadlock: two threads interleaving the two orders
+  stop forever, which a test run only catches if it actually hangs —
+  the graph catches the *order*, which every passing run exercises.
+- **loop-blocking events** — a thread running an asyncio event loop
+  must never park: a contended lock acquire that waits longer than
+  ``block_threshold`` on a loop thread, or any ``time.sleep`` on a loop
+  thread, records an event (the dynamic twin of the static
+  ``loop-affinity`` rule).
+- **sleep-under-lock events** — ``time.sleep`` while holding an
+  instrumented lock on any thread is recorded separately (reported, not
+  asserted: worker-side lingers are sometimes deliberate, but they are
+  exactly what turns a benign lock into a loop-stalling one).
+
+The chaos-soak and fleet acceptance tests run under this harness via
+the ``lockgraph`` fixture (tests/conftest.py), which asserts zero
+cycles and zero loop-blocking events over the run — tier-1 itself is
+the race detector. Locks created *before* :func:`install` are not
+instrumented; the fixture installs before the test constructs its
+networks/stores, so everything the test builds is covered.
+
+The instrumentation's own bookkeeping uses raw ``_thread`` locks so it
+can never recurse into itself, and the wrappers implement the full
+``acquire(blocking, timeout)`` / context-manager surface (including
+what ``threading.Condition`` needs from a user-supplied lock).
+"""
+
+from __future__ import annotations
+
+import _thread
+import threading
+import time
+import traceback
+from typing import Optional
+
+__all__ = [
+    "LockGraph",
+    "current_graph",
+    "install",
+    "uninstall",
+]
+
+_REAL_LOCK = _thread.allocate_lock  # never patched; recursion-proof
+_REAL_SLEEP = time.sleep
+
+
+def _on_loop_thread() -> bool:
+    """True while the current thread is inside a running asyncio loop
+    (protocol callbacks, call_soon callbacks, coroutine steps)."""
+    try:
+        import asyncio
+
+        return asyncio._get_running_loop() is not None
+    except Exception:  # pragma: no cover — defensive
+        return False
+
+
+def _site(skip: int = 2) -> str:
+    """file:line of the caller outside this module/threading."""
+    for frame in reversed(traceback.extract_stack()[:-skip]):
+        if "analysis/lockgraph" in frame.filename or \
+                frame.filename.endswith("threading.py"):
+            continue
+        return f"{frame.filename}:{frame.lineno}"
+    return "<unknown>"
+
+
+class LockGraph:
+    """The process-wide recording target while installed."""
+
+    def __init__(self, block_threshold: float = 0.2):
+        self.block_threshold = block_threshold
+        self._raw = _REAL_LOCK()
+        self._tls = threading.local()
+        # lock id -> creation site
+        self.locks: dict[int, str] = {}
+        # (id_a, id_b) -> {"sites", "count", "stack"}
+        self.edges: dict[tuple[int, int], dict] = {}
+        self.loop_block_events: list[dict] = []
+        self.sleep_under_lock_events: list[dict] = []
+        self.acquisitions = 0
+
+    # ------------------------------------------------------- bookkeeping
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def register(self, lock_id: int, site: str) -> None:
+        with self._raw:
+            self.locks[lock_id] = site
+
+    def before_acquire(self, lock_id: int) -> None:
+        """Record order edges from every held lock to this one (called
+        for blocking acquires only — try-locks cannot deadlock)."""
+        held = self._held()
+        if not held:
+            return
+        with self._raw:
+            self.acquisitions += 1
+            for h in held:
+                if h == lock_id:
+                    continue  # reentrant wrappers handle their own state
+                key = (h, lock_id)
+                entry = self.edges.get(key)
+                if entry is None:
+                    self.edges[key] = {
+                        "sites": (self.locks.get(h, "?"),
+                                  self.locks.get(lock_id, "?")),
+                        "count": 1,
+                        "stack": "".join(traceback.format_stack()[-8:-2]),
+                    }
+                else:
+                    entry["count"] += 1
+
+    def acquired(self, lock_id: int) -> None:
+        self._held().append(lock_id)
+
+    def released(self, lock_id: int) -> None:
+        held = self._held()
+        # remove the most recent occurrence (lock discipline is LIFO in
+        # practice, but release-out-of-order must not corrupt the stack)
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == lock_id:
+                del held[i]
+                return
+
+    def blocked_wait(self, lock_id: int, waited: float) -> None:
+        if waited >= self.block_threshold and _on_loop_thread():
+            with self._raw:
+                self.loop_block_events.append({
+                    "kind": "loop-lock-wait",
+                    "lock": self.locks.get(lock_id, "?"),
+                    "waited": waited,
+                    "thread": threading.current_thread().name,
+                    "stack": "".join(traceback.format_stack()[-8:-2]),
+                })
+
+    def note_sleep(self, seconds: float) -> None:
+        if _on_loop_thread():
+            with self._raw:
+                self.loop_block_events.append({
+                    "kind": "loop-sleep",
+                    "seconds": seconds,
+                    "thread": threading.current_thread().name,
+                    "stack": "".join(traceback.format_stack()[-8:-2]),
+                })
+        elif self._held():
+            with self._raw:
+                self.sleep_under_lock_events.append({
+                    "kind": "sleep-under-lock",
+                    "seconds": seconds,
+                    "locks": [self.locks.get(h, "?") for h in self._held()],
+                    "thread": threading.current_thread().name,
+                    "stack": "".join(traceback.format_stack()[-8:-2]),
+                })
+
+    # ----------------------------------------------------------- reports
+
+    def cycles(self) -> list[list[str]]:
+        """Lock-order cycles, as lists of creation sites. Tarjan SCCs
+        over the instance graph: an SCC with more than one node (or a
+        self-edge) means both orders were observed — a latent deadlock."""
+        with self._raw:
+            adj: dict[int, list[int]] = {}
+            for (a, b) in self.edges:
+                adj.setdefault(a, []).append(b)
+                adj.setdefault(b, [])
+        index: dict[int, int] = {}
+        low: dict[int, int] = {}
+        on_stack: set[int] = set()
+        stack: list[int] = []
+        counter = [0]
+        out: list[list[str]] = []
+
+        def strongconnect(v: int) -> None:
+            # iterative Tarjan (recursion depth is unbounded otherwise)
+            work = [(v, iter(adj[v]))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on_stack.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(adj[w])))
+                        advanced = True
+                        break
+                    if w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1 or (node, node) in self.edges:
+                        out.append([self.locks.get(i, "?") for i in scc])
+
+        for v in list(adj):
+            if v not in index:
+                strongconnect(v)
+        return out
+
+    def report(self) -> dict:
+        cycles = self.cycles()  # takes _raw itself (non-reentrant)
+        with self._raw:
+            return {
+                "locks": len(self.locks),
+                "edges": len(self.edges),
+                "acquisitions": self.acquisitions,
+                "cycles": cycles,
+                "loop_block_events": list(self.loop_block_events),
+                "sleep_under_lock_events":
+                    list(self.sleep_under_lock_events),
+            }
+
+
+class _InstrumentedLock:
+    """Drop-in ``threading.Lock`` recording into a :class:`LockGraph`."""
+
+    def __init__(self, graph: LockGraph):
+        self._inner = _REAL_LOCK()
+        self._graph = graph
+        self.site = _site()
+        graph.register(id(self), self.site)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        g = self._graph
+        if not blocking:
+            got = self._inner.acquire(False)
+            if got:
+                g.acquired(id(self))
+            return got
+        g.before_acquire(id(self))
+        got = self._inner.acquire(False)
+        if not got:
+            t0 = time.monotonic()
+            if timeout is None or timeout < 0:
+                got = self._inner.acquire(True)
+            else:
+                got = self._inner.acquire(True, timeout)
+            g.blocked_wait(id(self), time.monotonic() - t0)
+            if not got:
+                return False
+        g.acquired(id(self))
+        return True
+
+    def release(self) -> None:
+        self._inner.release()
+        self._graph.released(id(self))
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def _at_fork_reinit(self) -> None:
+        # stdlib registers this (os.register_at_fork in
+        # concurrent.futures.thread, threading internals): the child
+        # process starts with the lock free.
+        self._inner._at_fork_reinit()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return f"<InstrumentedLock {self.site} {self._inner!r}>"
+
+
+class _InstrumentedRLock:
+    """Drop-in ``threading.RLock``: reentrant re-acquires record no
+    edges (holding yourself is not an order) and push/pop the held
+    stack exactly once per outermost acquire/release."""
+
+    def __init__(self, graph: LockGraph):
+        self._inner = _REAL_LOCK()
+        self._graph = graph
+        self._owner: Optional[int] = None
+        self._count = 0
+        self.site = _site()
+        graph.register(id(self), self.site)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        me = _thread.get_ident()
+        if self._owner == me:
+            self._count += 1
+            return True
+        g = self._graph
+        if not blocking:
+            got = self._inner.acquire(False)
+            if not got:
+                return False
+        else:
+            g.before_acquire(id(self))
+            got = self._inner.acquire(False)
+            if not got:
+                t0 = time.monotonic()
+                if timeout is None or timeout < 0:
+                    got = self._inner.acquire(True)
+                else:
+                    got = self._inner.acquire(True, timeout)
+                g.blocked_wait(id(self), time.monotonic() - t0)
+                if not got:
+                    return False
+        self._owner = me
+        self._count = 1
+        g.acquired(id(self))
+        return True
+
+    def release(self) -> None:
+        if self._owner != _thread.get_ident():
+            raise RuntimeError("cannot release un-acquired lock")
+        self._count -= 1
+        if self._count == 0:
+            self._owner = None
+            self._inner.release()
+            self._graph.released(id(self))
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def _at_fork_reinit(self) -> None:
+        self._inner._at_fork_reinit()
+        self._owner = None
+        self._count = 0
+
+    # threading.Condition support for user-supplied rlocks
+    def _is_owned(self) -> bool:
+        return self._owner == _thread.get_ident()
+
+    def _release_save(self):
+        count, owner = self._count, self._owner
+        self._count = 0
+        self._owner = None
+        self._inner.release()
+        self._graph.released(id(self))
+        return (count, owner)
+
+    def _acquire_restore(self, state) -> None:
+        self.acquire()
+        self._count, self._owner = state
+
+
+_installed: Optional[dict] = None
+
+
+def current_graph() -> Optional[LockGraph]:
+    return _installed["graph"] if _installed else None
+
+
+def install(block_threshold: float = 0.2) -> LockGraph:
+    """Patch ``threading.Lock``/``RLock`` + ``time.sleep`` and return
+    the recording graph. Locks created while installed stay
+    instrumented (and functional) after :func:`uninstall`."""
+    global _installed
+    if _installed is not None:
+        raise RuntimeError("lockgraph already installed")
+    graph = LockGraph(block_threshold=block_threshold)
+
+    def make_lock():
+        return _InstrumentedLock(graph)
+
+    def make_rlock():
+        return _InstrumentedRLock(graph)
+
+    def sleep(seconds):
+        graph.note_sleep(seconds)
+        _REAL_SLEEP(seconds)
+
+    _installed = {
+        "graph": graph,
+        "Lock": threading.Lock,
+        "RLock": threading.RLock,
+        "sleep": time.sleep,
+    }
+    threading.Lock = make_lock
+    threading.RLock = make_rlock
+    time.sleep = sleep
+    return graph
+
+
+def uninstall() -> Optional[LockGraph]:
+    """Restore the real factories; returns the graph for assertions."""
+    global _installed
+    if _installed is None:
+        return None
+    threading.Lock = _installed["Lock"]
+    threading.RLock = _installed["RLock"]
+    time.sleep = _installed["sleep"]
+    graph = _installed["graph"]
+    _installed = None
+    return graph

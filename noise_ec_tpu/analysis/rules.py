@@ -1,0 +1,599 @@
+"""Concurrency + dataflow rules: loop-affinity, donation, zero-copy.
+
+Each rule encodes an invariant this codebase already paid to learn
+(docs/static-analysis.md has the catalog with the motivating PRs):
+
+- **loop-affinity** — event-loop threads must never block. Contexts
+  checked: every ``async def`` body, the callback methods of
+  ``asyncio.(Buffered|Datagram)Protocol`` subclasses, and any function
+  annotated ``# noise-ec: loop-affine`` on its ``def`` line (the
+  transport write path's documented contract, now machine-checked).
+  Flagged: direct blocking calls (``time.sleep``, ``submit_wait``,
+  sync socket ops, un-awaited ``.wait()``/``.result()``, blocking
+  ``.acquire()``), acquiring a **blocking-held** lock (one whose spans
+  anywhere in the module contain a blocking call while held — acquiring
+  such a lock on the loop inherits the holder's stall), and one-hop
+  calls to same-module functions whose bodies directly block.
+
+- **donation** — a device array donated to a jit entry
+  (``donate_argnums``) is invalidated by the dispatch; reading the name
+  afterwards in the same scope is a use-after-free that XLA surfaces as
+  a deleted-buffer error only on donating backends (TPU/GPU), i.e.
+  never in CPU CI. Donation marks: ``<pool>.donate(name)`` and literal
+  ``donate=True`` call arguments.
+
+- **zero-copy** — memoryview slices of ``_FrameRing`` buffers
+  (``.frames()`` / ``.writable()``) are valid only until the next ring
+  fill/compaction; storing one on ``self``, returning or yielding it,
+  or parking it in a container lets it dangle. Escape requires an
+  explicit ``bytes()`` copy. (``get_buffer`` returning the writable
+  tail is the BufferedProtocol contract — the loop owns that view for
+  exactly one fill — and is exempt.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from noise_ec_tpu.analysis.core import (
+    Finding,
+    SourceFile,
+    call_name,
+    dotted,
+    rule,
+)
+
+# ------------------------------------------------------------- blocking model
+
+# Fully-dotted callables that block the calling thread.
+BLOCKING_DOTTED = {
+    "time.sleep",
+    "select.select",
+    "socket.create_connection",
+    "socket.getaddrinfo",
+    "subprocess.run",
+    "subprocess.check_output",
+    "os.system",
+}
+# Method names that block regardless of receiver.
+BLOCKING_METHODS = {"submit_wait", "sendall", "recvfrom"}
+# Method names that block unless awaited (asyncio twins exist).
+BLOCKING_UNLESS_AWAITED = {"wait", "result"}
+# Names that look like locks/conditions when used as a context manager
+# or ``.acquire()`` receiver.
+_LOCK_TOKENS = ("lock", "cond", "mutex")
+
+PROTOCOL_BASES = {
+    "BufferedProtocol",
+    "Protocol",
+    "DatagramProtocol",
+    "SubprocessProtocol",
+}
+PROTOCOL_CALLBACKS = {
+    "connection_made",
+    "connection_lost",
+    "data_received",
+    "buffer_updated",
+    "get_buffer",
+    "eof_received",
+    "pause_writing",
+    "resume_writing",
+    "datagram_received",
+    "error_received",
+    "pipe_data_received",
+    "process_exited",
+}
+
+
+def _is_lock_name(name: str) -> bool:
+    low = name.lower()
+    return any(tok in low for tok in _LOCK_TOKENS)
+
+
+def _lock_expr_name(node: ast.expr) -> Optional[str]:
+    """The lock-ish final name of ``self._lock`` / ``net._lock`` /
+    ``_lock``, or None when the expression is not lock-shaped."""
+    if isinstance(node, ast.Attribute) and _is_lock_name(node.attr):
+        return node.attr
+    if isinstance(node, ast.Name) and _is_lock_name(node.id):
+        return node.id
+    return None
+
+
+def _nonblocking_acquire(call: ast.Call) -> bool:
+    """``.acquire(blocking=False)`` / ``.acquire(False)`` /
+    ``.acquire(timeout=0)`` never park the thread."""
+    for kw in call.keywords:
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+        if kw.arg == "timeout" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value == 0:
+            return True
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and call.args[0].value in (False, 0):
+        return True
+    return False
+
+
+def _blocking_call(call: ast.Call, awaited_ids: set[int],
+                   same_lock: Optional[str] = None) -> Optional[str]:
+    """A short description when ``call`` blocks the calling thread,
+    else None. ``same_lock``: the lock name whose span we are inside —
+    ``<lock>.wait()`` there is the Condition pattern (the wait releases
+    the lock) and does not count."""
+    d = dotted(call.func)
+    if d in BLOCKING_DOTTED:
+        return f"{d}()"
+    name = call_name(call)
+    if name in BLOCKING_METHODS:
+        return f".{name}()"
+    if name == "acquire" and isinstance(call.func, ast.Attribute):
+        if _lock_expr_name(call.func.value) and not _nonblocking_acquire(call):
+            return ".acquire()"
+        return None
+    if name in BLOCKING_UNLESS_AWAITED and id(call) not in awaited_ids:
+        if isinstance(call.func, ast.Attribute):
+            recv = dotted(call.func.value)
+            if same_lock is not None and recv is not None \
+                    and recv.endswith(same_lock):
+                return None  # Condition.wait inside its own lock span
+            # Only lock/event/future-shaped receivers: bare ``x.wait()``
+            # on arbitrary objects is too common to flag blindly.
+            base = recv.rsplit(".", 1)[-1].lower() if recv else ""
+            if name == "wait" and not (
+                _is_lock_name(base) or "event" in base or "fut" in base
+                or "cond" in base or base == "registered"
+            ):
+                return None
+            return f".{name}() (un-awaited)"
+    return None
+
+
+def _awaited_call_ids(root: ast.AST) -> set[int]:
+    """ids of every Call inside an ``await`` expression — including
+    nested ones (``await asyncio.wait_for(ev.wait(), ...)`` awaits the
+    inner wait too)."""
+    out: set[int] = set()
+    for n in ast.walk(root):
+        if isinstance(n, ast.Await):
+            out.update(
+                id(c) for c in ast.walk(n.value) if isinstance(c, ast.Call)
+            )
+    return out
+
+
+def _own_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk ``fn``'s body without descending into nested function
+    definitions (a nested def is not executed by entering the outer
+    scope; nested async defs are their own loop context)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _ModuleIndex:
+    """Per-file context shared by the loop-affinity walk."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        # (class name or None, method name) -> FunctionDef — for one-hop
+        # resolution a bare name map is enough when unique.
+        self.defs_by_name: dict[str, list[ast.AST]] = {}
+        self.loop_contexts: list[tuple[ast.AST, str]] = []
+        # lock key (class, attr) -> list of blocking descriptions found
+        # inside any ``with <lock>`` span of that key
+        self.blocking_held: dict[tuple[Optional[str], str], str] = {}
+        self._index()
+
+    def _index(self) -> None:
+        for node in ast.walk(self.sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs_by_name.setdefault(node.name, []).append(node)
+        for cls in [n for n in ast.walk(self.sf.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            is_protocol = any(
+                (d := dotted(b)) and d.rsplit(".", 1)[-1] in PROTOCOL_BASES
+                for b in cls.bases
+            )
+            for item in cls.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(item, ast.AsyncFunctionDef):
+                    continue  # picked up by the async walk below
+                if is_protocol and item.name in PROTOCOL_CALLBACKS:
+                    self.loop_contexts.append(
+                        (item, f"{cls.name}.{item.name} (protocol callback)")
+                    )
+            # lock spans per enclosing class
+            self._index_lock_spans(cls, cls.name)
+        self._index_lock_spans(self.sf.tree, None, top_only=True)
+        for node in ast.walk(self.sf.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                self.loop_contexts.append(
+                    (node, f"async {node.name}")
+                )
+            elif isinstance(node, ast.FunctionDef) and (
+                node.lineno in self.sf.loop_affine_lines
+                or node.lineno - 1 in self.sf.loop_affine_lines
+            ):
+                self.loop_contexts.append(
+                    (node, f"{node.name} (marked loop-affine)")
+                )
+
+    def _index_lock_spans(self, scope: ast.AST, cls_name: Optional[str],
+                          top_only: bool = False) -> None:
+        nodes = ast.walk(scope) if not top_only else (
+            n for n in ast.walk(scope)
+            if not isinstance(n, ast.ClassDef)
+        )
+        for node in nodes:
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                lname = _lock_expr_name(item.context_expr)
+                if lname is None:
+                    continue
+                key = self._lock_key(item.context_expr, cls_name, lname)
+                if key in self.blocking_held:
+                    continue
+                awaited = _awaited_call_ids(node)
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        desc = _blocking_call(sub, awaited, same_lock=lname)
+                        if desc:
+                            self.blocking_held[key] = (
+                                f"{desc} at line {sub.lineno}"
+                            )
+                            break
+
+    @staticmethod
+    def _lock_key(expr: ast.expr, cls_name: Optional[str],
+                  lname: str) -> tuple[Optional[str], str]:
+        """``self.X`` binds to the enclosing class; anything else is an
+        unknown-receiver lock keyed module-wide by name."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            return (cls_name, lname)
+        return ("?", lname)
+
+    def resolve_local(self, call: ast.Call) -> Optional[ast.FunctionDef]:
+        """One-hop callee: a unique same-module plain function matching
+        a bare-name call or a ``self.method(...)`` call. Arbitrary
+        receivers (``writer.close()``) stay unresolved — matching them
+        by method name alone mistakes stdlib objects for our defs."""
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if not (isinstance(f.value, ast.Name) and f.value.id == "self"):
+                return None
+            name = f.attr
+        elif isinstance(f, ast.Name):
+            name = f.id
+        else:
+            return None
+        defs = self.defs_by_name.get(name, [])
+        if len(defs) == 1 and isinstance(defs[0], ast.FunctionDef):
+            return defs[0]
+        return None
+
+
+def _context_class(sf: SourceFile, fn: ast.AST) -> Optional[str]:
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef) and fn in node.body:
+            return node.name
+    return None
+
+
+@rule(
+    "loop-affinity",
+    scope="file",
+    invariant="event-loop threads must not execute blocking calls or "
+              "acquire locks whose holders block",
+    motivation="PR 4 (wait_writable deadlock guard), PR 7 (submit_wait "
+               "split: 'loop threads must not block'), PR 11 (batched "
+               "verify moved off the loop)",
+)
+def check_loop_affinity(sf: SourceFile):
+    idx = _ModuleIndex(sf)
+    for fn, label in idx.loop_contexts:
+        awaited = _awaited_call_ids(fn)
+        cls = _context_class(sf, fn)
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Call):
+                desc = _blocking_call(node, awaited)
+                if desc:
+                    yield Finding(
+                        "loop-affinity", sf.rel, node.lineno,
+                        f"blocking call {desc} inside {label}: the event "
+                        "loop stalls every connection while this waits — "
+                        "move it to the dispatch pool or use the asyncio "
+                        "form",
+                    )
+                    continue
+                callee = idx.resolve_local(node)
+                if callee is not None:
+                    c_awaited = _awaited_call_ids(callee)
+                    for sub in _own_nodes(callee):
+                        if isinstance(sub, ast.Call):
+                            d = _blocking_call(sub, c_awaited)
+                            if d:
+                                yield Finding(
+                                    "loop-affinity", sf.rel, node.lineno,
+                                    f"call to {callee.name}() inside "
+                                    f"{label}, whose body blocks "
+                                    f"({d} at line {sub.lineno})",
+                                )
+                                break
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lname = _lock_expr_name(item.context_expr)
+                    if lname is None:
+                        continue
+                    key = _ModuleIndex._lock_key(
+                        item.context_expr, cls, lname
+                    )
+                    held = idx.blocking_held.get(key)
+                    if held:
+                        yield Finding(
+                            "loop-affinity", sf.rel, node.lineno,
+                            f"acquiring {lname} inside {label}, but a "
+                            f"holder of this lock blocks while holding "
+                            f"it ({held}): the loop inherits that stall "
+                            "— shrink the holder's critical section or "
+                            "hand the work to the dispatch pool",
+                        )
+
+
+# ---------------------------------------------------------------- donation
+
+
+# Donating entries whose positional arguments (by index) hand their
+# device buffers to the dispatch when called with donate=True. Index 0
+# is the generator matrix everywhere in this codebase (replicated, never
+# donated); the words/stripes operand is index 1.
+DONATED_ARG_INDEX = {None: (1,)}
+
+
+def _donation_marks(fn: ast.AST) -> list[tuple[str, ast.stmt, str]]:
+    """(name, donating statement, kind) triples in ``fn``'s own body.
+
+    ``kind="call"``: a literal ``donate=True`` argument — that call IS
+    the consuming dispatch, so the buffer dies with the statement.
+    ``kind="mark"``: ``<pool>.donate(name)`` bookkeeping — the buffer
+    dies at the NEXT statement that reads the name (the dispatch the
+    mark announces), so exactly one downstream consumer is legal.
+    """
+    marks: dict[int, tuple[str, ast.stmt, str]] = {}
+    for stmt in _own_nodes(fn):
+        if not isinstance(stmt, ast.stmt):
+            continue
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name == "donate" and node.args \
+                    and isinstance(node.args[0], ast.Name):
+                prev = marks.get(id(node))
+                # innermost containing statement wins (nested compound
+                # statements each see the same call)
+                if prev is None or stmt.lineno > prev[1].lineno:
+                    marks[id(node)] = (node.args[0].id, stmt, "mark")
+                continue
+            if any(
+                kw.arg == "donate" and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+                for kw in node.keywords
+            ):
+                for i in DONATED_ARG_INDEX[None]:
+                    if i < len(node.args) and isinstance(node.args[i],
+                                                         ast.Name):
+                        prev = marks.get(id(node))
+                        if prev is None or stmt.lineno > prev[1].lineno:
+                            marks[id(node)] = (
+                                node.args[i].id, stmt, "call"
+                            )
+    return list(marks.values())
+
+
+def _branch_excluded_lines(fn: ast.AST, stmt: ast.stmt) -> set[int]:
+    """Lines on no control path through ``stmt``: for every ancestor
+    ``if``, the lines of the branch not containing it. Keeps the
+    donation dataflow from chasing reads in a mutually-exclusive arm."""
+    parents: dict[int, ast.AST] = {}
+    for node in ast.walk(fn):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    excluded: set[int] = set()
+    child: ast.AST = stmt
+    while child is not fn:
+        par = parents.get(id(child))
+        if par is None:
+            break
+        if isinstance(par, ast.If):
+            other = par.orelse if child in par.body else (
+                par.body if child in par.orelse else []
+            )
+            for s in other:
+                excluded.update(
+                    range(s.lineno, getattr(s, "end_lineno", s.lineno) + 1)
+                )
+        child = par
+    return excluded
+
+
+@rule(
+    "donation",
+    scope="file",
+    invariant="a name whose device buffer was donated (donate=True / "
+              "pool.donate) must not be read again in the same scope",
+    motivation="PR 8 (donated arrays are invalidated exactly once; "
+               "maybe_analyze_program takes ShapeDtypeStructs because "
+               "donated arrays must not be re-touched)",
+)
+def check_donation(sf: SourceFile):
+    for fn in ast.walk(sf.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        marks = _donation_marks(fn)
+        if not marks:
+            continue
+        loads: list[tuple[str, int]] = []
+        stores: list[tuple[str, int]] = []
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    loads.append((node.id, node.lineno))
+                else:  # Store and Del both end the donated binding
+                    stores.append((node.id, node.lineno))
+        for name, stmt, kind in marks:
+            end = getattr(stmt, "end_lineno", stmt.lineno)
+            dead = _branch_excluded_lines(fn, stmt)
+            m_loads = [(n, l) for n, l in loads if l not in dead]
+            m_stores = [(n, l) for n, l in stores if l not in dead]
+            if kind == "mark":
+                # The buffer survives until the dispatch the mark
+                # announces: the first later read is the consumer.
+                consumer = min(
+                    (ll for ln, ll in m_loads if ln == name and ll > end),
+                    default=None,
+                )
+                if consumer is None:
+                    continue
+                # ... through the end of the innermost statement
+                # containing that read (a multi-line dispatch call).
+                end = min(
+                    getattr(s, "end_lineno", s.lineno)
+                    for s in _own_nodes(fn)
+                    if isinstance(s, ast.stmt)
+                    and s.lineno <= consumer
+                    and getattr(s, "end_lineno", s.lineno) >= consumer
+                )
+            for lname, lline in m_loads:
+                if lname != name or lline <= end:
+                    continue
+                # A rebind on the donating statement itself
+                # (``x = f(x, donate=True)``) or anywhere before the
+                # read re-points the name at a live buffer.
+                rebound = any(
+                    sname == name and stmt.lineno <= sline <= lline
+                    for sname, sline in m_stores
+                )
+                if rebound:
+                    continue
+                yield Finding(
+                    "donation", sf.rel, lline,
+                    f"{name!r} was donated at line {stmt.lineno} "
+                    "(its device buffer now belongs to the dispatch "
+                    "output) but is read again here — on TPU/GPU this "
+                    "is a deleted-buffer error that CPU CI never sees; "
+                    "rebind the name or capture a ShapeDtypeStruct "
+                    "before donating",
+                )
+                break  # one finding per donated name
+
+
+# ---------------------------------------------------------------- zero-copy
+
+
+_VIEW_SOURCES = ("frames", "writable")
+_STORE_METHODS = {"append", "add", "appendleft", "put", "put_nowait",
+                  "insert"}
+
+
+def _is_view_source(call: ast.Call) -> bool:
+    return isinstance(call.func, ast.Attribute) \
+        and call.func.attr in _VIEW_SOURCES
+
+
+@rule(
+    "zero-copy",
+    scope="file",
+    invariant="_FrameRing views (.frames()/.writable()) must not escape "
+              "the parse scope without an explicit bytes() copy",
+    motivation="PR 11 (frames parse IN PLACE as memoryview slices; the "
+               "ring compacts/relocates under any escaped view)",
+)
+def check_zero_copy(sf: SourceFile):
+    for fn in ast.walk(sf.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        views: dict[str, int] = {}  # name -> bound line
+        rebinds: list[tuple[str, int]] = []
+        for node in _own_nodes(fn):
+            if isinstance(node, (ast.For, ast.AsyncFor)) \
+                    and isinstance(node.iter, ast.Call) \
+                    and _is_view_source(node.iter) \
+                    and isinstance(node.target, ast.Name):
+                views[node.target.id] = node.lineno
+            elif isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                if isinstance(node.value, ast.Call) \
+                        and _is_view_source(node.value):
+                    views[node.targets[0].id] = node.lineno
+                else:
+                    rebinds.append((node.targets[0].id, node.lineno))
+        if not views:
+            continue
+
+        def is_live_view(name_node: ast.expr, use_line: int) -> bool:
+            if not isinstance(name_node, ast.Name):
+                return False
+            bound = views.get(name_node.id)
+            if bound is None or use_line < bound:
+                return False
+            return not any(
+                rn == name_node.id and bound < rl <= use_line
+                for rn, rl in rebinds
+            )
+
+        for node in _own_nodes(fn):
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, (ast.Attribute, ast.Subscript)) \
+                            and is_live_view(node.value, node.lineno):
+                        yield Finding(
+                            "zero-copy", sf.rel, node.lineno,
+                            f"ring view {node.value.id!r} stored outside "
+                            "the parse scope — it dangles at the next "
+                            "ring fill/compaction; store bytes(view) "
+                            "instead",
+                        )
+            elif isinstance(node, ast.Return) \
+                    and is_live_view(node.value, node.lineno):
+                if fn.name == "get_buffer":
+                    continue  # BufferedProtocol fill contract
+                yield Finding(
+                    "zero-copy", sf.rel, node.lineno,
+                    f"ring view {node.value.id!r} returned from "
+                    f"{fn.name}() — the caller outlives the parse scope; "
+                    "return bytes(view) instead",
+                )
+            elif isinstance(node, (ast.Yield, ast.YieldFrom)) \
+                    and is_live_view(getattr(node, "value", None),
+                                     node.lineno):
+                yield Finding(
+                    "zero-copy", sf.rel, node.lineno,
+                    f"ring view yielded from {fn.name}() — the consumer "
+                    "may hold it across the next fill; yield bytes(view) "
+                    "or document the single-fill contract at the source",
+                )
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _STORE_METHODS \
+                    and isinstance(node.func.value, ast.Attribute):
+                for arg in node.args:
+                    if is_live_view(arg, node.lineno):
+                        yield Finding(
+                            "zero-copy", sf.rel, node.lineno,
+                            f"ring view {arg.id!r} parked in a container "
+                            f"(.{node.func.attr}) — it dangles at the "
+                            "next ring fill; store bytes(view) instead",
+                        )

@@ -1498,6 +1498,7 @@ class TCPNetwork:
 
     # -- write path (event-loop thread only) --
 
+    # noise-ec: loop-affine
     def _enqueue_frames(
         self, writer: asyncio.StreamWriter, parts: list, nframes: int,
         nbytes: int,
@@ -1540,6 +1541,7 @@ class TCPNetwork:
     # under it and let oversized batches fall back to the joined write.
     _SENDMSG_MAX_BUFS = 512
 
+    # noise-ec: loop-affine
     def _flush_writer(self, writer: asyncio.StreamWriter) -> None:
         handle = self._flush_handles.pop(writer, None)
         if handle is not None:
@@ -1569,6 +1571,7 @@ class TCPNetwork:
             self._tasks.add(task)
             task.add_done_callback(self._tasks.discard)
 
+    # noise-ec: loop-affine
     def _write_vectored(self, writer, bufs: list, nframes: int) -> None:
         """Flush a coalesced buffer list: ONE ``sendmsg`` iovec syscall
         when the transport buffer is empty (the steady state — the
@@ -1638,6 +1641,7 @@ class TCPNetwork:
                 return
         self._write_safe_here(writer, frame)
 
+    # noise-ec: loop-affine
     def _write_safe_here(self, writer, frame: bytes) -> None:
         if writer.transport.get_write_buffer_size() > self.MAX_PEER_WRITE_BUFFER:
             # A stalled reader must not grow sender memory without bound.
@@ -1880,6 +1884,7 @@ class TCPNetwork:
             for p in others:
                 self._write_safe(p.writer, announce)
 
+    # noise-ec: loop-affine
     def _on_frame(
         self, body, writer: asyncio.StreamWriter, conn: _Conn
     ) -> None:

@@ -37,6 +37,32 @@ def rng():
     return np.random.default_rng(0x5EED)
 
 
+@pytest.fixture
+def lockgraph():
+    """Opt-in lockdep/tsan-lite harness (docs/static-analysis.md):
+    instruments every ``threading.Lock``/``RLock`` the test creates,
+    recording lock-order edges and loop-thread blocking; teardown
+    asserts zero ordering cycles and zero loop-blocking events, so the
+    test run itself is the race detector. Sleep-under-lock events are
+    reported but not asserted (worker-side lingers can be deliberate)."""
+    from noise_ec_tpu.analysis import lockgraph as lg
+
+    graph = lg.install()
+    try:
+        yield graph
+    finally:
+        lg.uninstall()
+    report = graph.report()
+    assert report["locks"], "lockgraph engaged but saw no locks created"
+    assert report["cycles"] == [], (
+        f"lock-order cycles over the run: {report['cycles']}"
+    )
+    assert report["loop_block_events"] == [], (
+        "loop threads blocked during the run: "
+        f"{report['loop_block_events']}"
+    )
+
+
 def hypothesis_stubs():
     """Stand-ins for ``(given, settings, st)`` when hypothesis is absent.
 

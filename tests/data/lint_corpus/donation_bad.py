@@ -1,0 +1,16 @@
+"""Corpus: donation rule true positives (reads after the buffer died)."""
+
+import jax
+import numpy as np
+
+
+def mark_then_read_past_consumer(pool, fn, words):
+    words_dev = jax.device_put(words)
+    pool.donate(words_dev)  # bookkeeping: the NEXT dispatch consumes it
+    out = fn(words_dev)  # the consuming dispatch — legal
+    return out, words_dev.sum()  # read after consumption: deleted buffer
+
+
+def literal_donate_then_read(codec, M, words_dev):
+    out = codec.matmul_stripes(M, words_dev, donate=True)
+    return np.array(out) + np.array(words_dev)  # words_dev is dead here

@@ -1,0 +1,32 @@
+"""Corpus twin: the legal donation shapes — zero findings expected."""
+
+import jax
+
+
+def mark_then_single_consumer(pool, fn, words):
+    # The dispatch.py idiom: shape captured BEFORE the dispatch, the
+    # donate mark announces the next call, nothing reads the name after.
+    words_dev = jax.device_put(words)
+    struct = jax.ShapeDtypeStruct(words_dev.shape, words_dev.dtype)
+    pool.donate(words_dev)
+    out = fn(words_dev)  # the one consuming dispatch
+    return out, struct
+
+
+def donate_into_rebind(codec, M, words_dev):
+    # Donate-into-output: the name is rebound by the dispatch result,
+    # so later reads see the NEW buffer.
+    words_dev = codec.matmul_stripes(M, words_dev, donate=True)
+    return words_dev.sum()
+
+
+def branch_isolated(pool, fn, words, staged):
+    import numpy as np
+
+    if staged:
+        arr = jax.device_put(np.ascontiguousarray(words))
+        pool.donate(arr)
+    else:
+        arr = words  # the other arm never donated; its reads are fine
+        arr = arr + 0
+    return fn(arr)

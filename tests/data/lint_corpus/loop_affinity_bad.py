@@ -1,0 +1,47 @@
+"""Corpus: every way the loop-affinity rule must fire.
+
+Not imported by anything — parsed by tests/test_static_analysis.py to
+pin the rule's true-positive behavior.
+"""
+
+import asyncio
+import threading
+import time
+
+
+class Node:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.dispatch = None
+
+    def worker_side(self, payload):
+        # A holder that blocks while holding: this lock becomes
+        # "blocking-held", so acquiring it on the loop inherits the
+        # stall (the static twin of lockgraph's hold-while-blocking).
+        with self._lock:
+            time.sleep(0.5)
+            self.last = payload
+
+    async def tick(self):
+        time.sleep(0.1)  # direct blocking call in a coroutine
+        with self._lock:  # acquiring a blocking-held lock on the loop
+            return self.last
+
+    async def forward(self, key, fn):
+        # blocking backpressure entry on the loop thread (PR 7: TCP
+        # keeps non-blocking submit — loop threads must not block)
+        self.dispatch.submit_wait(key, fn)
+
+    def helper(self):
+        time.sleep(0.2)
+
+    async def hop(self):
+        self.helper()  # one-hop: same-module callee that blocks
+
+
+class Conn(asyncio.BufferedProtocol):
+    def __init__(self, sock):
+        self.sock = sock
+
+    def buffer_updated(self, nbytes):
+        self.sock.sendall(b"ack")  # sync socket op in a protocol callback

@@ -1,0 +1,38 @@
+"""Corpus twin: the same shapes done right — zero findings expected."""
+
+import asyncio
+import threading
+
+
+class Node:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.dispatch = None
+        self.last = None
+
+    def worker_side(self, payload):
+        # Tiny critical section; the slow work happens outside the lock,
+        # so the lock never becomes blocking-held.
+        with self._lock:
+            self.last = payload
+
+    async def tick(self):
+        await asyncio.sleep(0.1)  # the asyncio form yields the loop
+        with self._lock:  # acquiring a never-blocking-held lock is fine
+            return self.last
+
+    async def forward(self, key, fn):
+        # non-blocking submit on the loop; overflow is counted, not waited
+        self.dispatch.submit(key, fn)
+
+    async def handshake(self, conn):
+        # awaited waits (including nested in wait_for) are the loop idiom
+        await asyncio.wait_for(conn.registered.wait(), timeout=5)
+
+
+class Conn(asyncio.BufferedProtocol):
+    def __init__(self, net):
+        self.net = net
+
+    def buffer_updated(self, nbytes):
+        self.net.record(nbytes)  # hand off; no sync I/O on the loop

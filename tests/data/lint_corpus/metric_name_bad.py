@@ -1,0 +1,12 @@
+"""Corpus: metric-name rule true positives."""
+
+from noise_ec_tpu.obs.registry import default_registry
+
+
+def instrument():
+    reg = default_registry()
+    # Undeclared: a typo'd name forks a series nothing documents.
+    typo = reg.counter("noise_ec_transport_shards_inn_total")
+    # Type conflict: declared a counter, requested as a gauge.
+    wrong = reg.gauge("noise_ec_transport_shards_in_total")
+    return typo, wrong

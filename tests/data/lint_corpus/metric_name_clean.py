@@ -1,0 +1,10 @@
+"""Corpus twin: declared names requested with their declared types."""
+
+from noise_ec_tpu.obs.registry import default_registry
+
+
+def instrument():
+    reg = default_registry()
+    shards = reg.counter("noise_ec_transport_shards_in_total")
+    depth = reg.gauge("noise_ec_dispatch_queue_depth")
+    return shards, depth

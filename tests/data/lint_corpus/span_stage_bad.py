@@ -1,0 +1,8 @@
+"""Corpus: span-stage rule true positive (an unbounded stage label)."""
+
+from noise_ec_tpu.obs.trace import span
+
+
+def handle(payload):
+    with span("totally_new_stage"):  # not in PIPELINE_STAGES
+        return len(payload)

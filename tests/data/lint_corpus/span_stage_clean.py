@@ -1,0 +1,8 @@
+"""Corpus twin: declared pipeline stages only."""
+
+from noise_ec_tpu.obs.trace import span
+
+
+def handle(payload):
+    with span("decode"):
+        return len(payload)

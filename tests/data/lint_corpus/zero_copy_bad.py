@@ -1,0 +1,23 @@
+"""Corpus: zero-copy rule true positives (ring views escaping)."""
+
+_MAX_FRAME = 1 << 20
+
+
+class Consumer:
+    def __init__(self, ring):
+        self.ring = ring
+        self.backlog = []
+        self.last = None
+
+    def parse(self):
+        for frame in self.ring.frames(_MAX_FRAME):
+            self.last = frame  # stored on self: dangles at next fill
+            self.backlog.append(frame)  # parked in a container: dangles
+
+    def first_frame(self):
+        for frame in self.ring.frames(_MAX_FRAME):
+            return frame  # escapes the parse scope uncopied
+
+    def stash_tail(self):
+        view = self.ring.writable(4096)
+        self.pending = view  # the writable tail is the next fill's target

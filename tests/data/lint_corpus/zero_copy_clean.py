@@ -1,0 +1,35 @@
+"""Corpus twin: ring views consumed inside the parse scope — zero
+findings expected."""
+
+_MAX_FRAME = 1 << 20
+
+
+class Consumer:
+    def __init__(self, ring, net):
+        self.ring = ring
+        self.net = net
+        self.backlog = []
+        self.last = None
+
+    def parse(self):
+        # The PR-11 contract: views are consumed before the next fill;
+        # anything kept is materialized with bytes().
+        for frame in self.ring.frames(_MAX_FRAME):
+            self.net.on_frame(frame)  # handing off within the scope is fine
+            self.last = bytes(frame)  # explicit copy may be stored
+            self.backlog.append(bytes(frame))
+
+    def first_frame(self):
+        for frame in self.ring.frames(_MAX_FRAME):
+            return bytes(frame)  # copies may escape
+
+    def get_buffer(self, sizehint):
+        # BufferedProtocol fill contract: the loop owns this view for
+        # exactly one recv_into — the one legal uncopied return.
+        return self.ring.writable(sizehint)
+
+    def fill(self, data):
+        view = self.ring.writable(len(data))
+        view[: len(data)] = data
+        view = None  # rebound before anything could store it
+        return len(data)

@@ -357,7 +357,7 @@ def test_fleet_fairness_10x_talker_keeps_quiet_p99_in_slo():
 # ------------------------------------------------- tier-1 acceptance
 
 
-def test_small_fleet_acceptance_mixed_traffic_under_named_chaos():
+def test_small_fleet_acceptance_mixed_traffic_under_named_chaos(lockgraph):
     """The tier-1 acceptance bar (ISSUE 7): >= 50 in-process peers,
     mixed chat + object traffic, a NAMED chaos profile, delivery >=
     99.9% with shed-with-Retry-After counted separately from lost —

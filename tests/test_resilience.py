@@ -460,7 +460,7 @@ def test_wait_writable_is_noop_on_event_loop_thread():
 # ------------------------------------------------------ acceptance soak
 
 
-def test_chaos_soak_eventual_delivery_and_health_flip():
+def test_chaos_soak_eventual_delivery_and_health_flip(lockgraph):
     """The acceptance soak (ISSUE 4): two TCP nodes through the chaos
     proxy — 5% drop, 1% corrupt, one scheduled 2 s directional
     partition, one forced connection reset — deliver 100% of a

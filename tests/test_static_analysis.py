@@ -1,0 +1,436 @@
+"""Invariant analyzer suite (docs/static-analysis.md).
+
+Three layers of pins:
+
+1. the FULL suite runs in-process on the real package and must be
+   clean — this is the tier-1 gate every future PR inherits (with a
+   wall-clock budget so the gate stays cheap);
+2. every file rule fires on its seeded corpus file and stays silent on
+   the clean twin (true-positive/false-positive pins), every project
+   rule fires on synthetic drift, and a meta-test proves no registered
+   rule is unpinned;
+3. the lockgraph harness detects a deliberately-constructed AB/BA lock
+   cycle and loop-thread blocking, stays silent on clean ordering, and
+   survives the stdlib lock surface (Condition, RLock reentrancy) —
+   the chaos-soak and fleet acceptance tests then run under it via the
+   ``lockgraph`` fixture.
+"""
+
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from noise_ec_tpu.analysis import (
+    Project,
+    SourceFile,
+    all_rules,
+    run_project,
+)
+from noise_ec_tpu.analysis import lockgraph as lg
+
+REPO = Path(__file__).resolve().parent.parent
+CORPUS = REPO / "tests" / "data" / "lint_corpus"
+
+
+def _run_on(path: Path, rule_id: str, **project_kw):
+    sf = SourceFile(path, root=REPO)
+    project = Project(root=REPO, files=[sf], **project_kw)
+    return run_project(project, rule_ids=[rule_id])
+
+
+# ------------------------------------------------------------ the CI gate
+
+
+def test_full_suite_clean_on_package_within_budget():
+    t0 = time.monotonic()
+    findings = run_project()
+    elapsed = time.monotonic() - t0
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert elapsed < 30.0, f"analyzer suite took {elapsed:.1f}s (budget 30s)"
+
+
+def test_lint_cli_exit_codes():
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import lint
+    finally:
+        sys.path.pop(0)
+    # 2: nothing to do / unknown rule; 0: clean single-rule run.
+    assert lint.main([]) == 2
+    assert lint.main(["--rule", "no-such-rule", "--all"]) == 2
+    assert lint.main(["--rule", "docs-catalog"]) == 0
+    assert lint.main(["--list"]) == 0
+    # 1: findings (corpus file under the file rules).
+    assert lint.main([str(CORPUS / "zero_copy_bad.py")]) == 1
+
+
+# ----------------------------------------------------------- corpus pins
+
+# rule id -> (bad corpus, clean twin). The meta-test below closes the
+# loop: every registered rule must appear here or in GLOBAL_PINNED.
+CORPUS_RULES = {
+    "loop-affinity": ("loop_affinity_bad.py", "loop_affinity_clean.py"),
+    "donation": ("donation_bad.py", "donation_clean.py"),
+    "zero-copy": ("zero_copy_bad.py", "zero_copy_clean.py"),
+    "metric-name": ("metric_name_bad.py", "metric_name_clean.py"),
+    "span-stage": ("span_stage_bad.py", "span_stage_clean.py"),
+}
+
+# Project rules pinned by the synthetic-drift tests in this module.
+GLOBAL_PINNED = {
+    "metric-registry",
+    "docs-observability",
+    "docs-subsystem",
+    "docs-catalog",
+}
+
+
+@pytest.mark.parametrize("rule_id", sorted(CORPUS_RULES))
+def test_rule_fires_on_corpus_and_not_on_clean_twin(rule_id):
+    bad, clean = CORPUS_RULES[rule_id]
+    bad_findings = _run_on(CORPUS / bad, rule_id)
+    assert bad_findings, f"{rule_id} did not fire on corpus {bad}"
+    assert all(f.rule == rule_id for f in bad_findings)
+    clean_findings = _run_on(CORPUS / clean, rule_id)
+    assert clean_findings == [], "\n".join(
+        f.render() for f in clean_findings
+    )
+
+
+def test_every_registered_rule_is_pinned():
+    pinned = set(CORPUS_RULES) | GLOBAL_PINNED
+    assert set(all_rules()) == pinned, (
+        "rules without a corpus/synthetic pin: "
+        f"{sorted(set(all_rules()) - pinned)}; stale pins: "
+        f"{sorted(pinned - set(all_rules()))}"
+    )
+
+
+def test_loop_affinity_corpus_covers_every_shape():
+    """The bad corpus encodes five distinct firing shapes; losing one
+    to a rule regression must fail loudly, not shrink coverage."""
+    findings = _run_on(CORPUS / "loop_affinity_bad.py", "loop-affinity")
+    lines = {f.line for f in findings}
+    assert len(findings) >= 5, "\n".join(f.render() for f in findings)
+    assert len(lines) >= 5
+
+
+def test_suppression_comment_silences_one_finding(tmp_path):
+    src = (
+        "import time\n"
+        "async def tick():\n"
+        "    time.sleep(1)  # noise-ec: allow(loop-affinity) — test pin\n"
+        "async def tock():\n"
+        "    time.sleep(1)\n"
+    )
+    p = tmp_path / "suppressed.py"
+    p.write_text(src)
+    findings = _run_on(p, "loop-affinity")
+    assert len(findings) == 1 and findings[0].line == 5
+
+
+# ------------------------------------------------- project-rule pins
+
+SYNTH_METRICS = {
+    "noise_ec_synth_used_total": ("counter", "help", ()),
+}
+
+
+def _synth_project(metrics, source: str, docs: dict):
+    sf = SourceFile(
+        CORPUS / "metric_name_clean.py", root=REPO, text=source
+    )
+    project = Project(
+        root=REPO, files=[sf], metrics=metrics,
+        pipeline_stages=("decode",),
+    )
+    for rel, text in docs.items():
+        project.set_doc(rel, text)
+    return project
+
+
+def test_metric_registry_rule_fires_on_synthetic_drift():
+    metrics = {
+        "noise_ec_unused_total": ("counter", "h", ()),  # no call site
+        "noise_ec_badname": ("counter", "h", ()),  # counter w/o _total
+        "noise_ec_depth_total": ("gauge", "h", ()),  # gauge WITH _total
+        "noise_ec_lat": ("histogram", "h", ()),
+        "noise_ec_lat_sum": ("gauge", "h", ()),  # suffix collision
+    }
+    src = (
+        "def f(reg):\n"
+        "    reg.counter('noise_ec_badname')\n"
+        "    reg.gauge('noise_ec_depth_total')\n"
+        "    reg.histogram('noise_ec_lat')\n"
+        "    reg.gauge('noise_ec_lat_sum')\n"
+    )
+    project = _synth_project(metrics, src, {})
+    msgs = [f.message for f in run_project(project, ["metric-registry"])]
+    assert any("no call site" in m for m in msgs)
+    assert any("must end in '_total'" in m for m in msgs)
+    assert any("must not end in '_total'" in m for m in msgs)
+    assert any("generates" in m for m in msgs)
+
+
+def test_docs_observability_rule_fires_on_undocumented_family():
+    from noise_ec_tpu.obs.server import SPANS_DOC_FIELDS
+    from noise_ec_tpu.obs.trace import SPAN_FIELDS
+
+    fields = " ".join(
+        f"`{f}`" for f in tuple(SPAN_FIELDS) + tuple(SPANS_DOC_FIELDS)
+    )
+    project = _synth_project(
+        SYNTH_METRICS, "x = 1\n",
+        {"docs/observability.md": f"schema: {fields}\n"},
+    )
+    findings = run_project(project, ["docs-observability"])
+    assert any(
+        "noise_ec_synth_used_total" in f.message for f in findings
+    )
+    project.set_doc(
+        "docs/observability.md",
+        f"noise_ec_synth_used_total schema: {fields}\n",
+    )
+    assert run_project(project, ["docs-observability"]) == []
+
+
+def test_docs_subsystem_rule_fires_on_missing_family_and_token():
+    metrics = {"noise_ec_fleet_shed_total": ("counter", "h", ())}
+    project = _synth_project(metrics, "x = 1\n", {"docs/fleet.md": "empty"})
+    findings = run_project(project, ["docs-subsystem"])
+    msgs = [f.message for f in findings]
+    assert any("noise_ec_fleet_shed_total" in m for m in msgs)
+    assert any("-fleet-profile" in m for m in msgs)
+
+
+def test_docs_catalog_rule_fires_both_directions():
+    project = _synth_project(
+        SYNTH_METRICS, "x = 1\n",
+        {"docs/static-analysis.md": "| `no-such-rule` | stale row |\n"},
+    )
+    findings = run_project(project, ["docs-catalog"])
+    msgs = [f.message for f in findings]
+    # every real rule is missing from the synthetic doc...
+    assert any("'loop-affinity' is not documented" in m for m in msgs)
+    # ...and the stale documented row is flagged
+    assert any("no-such-rule" in m for m in msgs)
+
+
+def test_check_metrics_shim_contract():
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import check_metrics
+    finally:
+        sys.path.pop(0)
+    assert check_metrics.check() == []
+    used = check_metrics.scan_source()
+    assert "noise_ec_transport_shards_in_total" in used
+    assert used["noise_ec_transport_shards_in_total"] == {"counter"}
+
+
+# ----------------------------------------------------- lockgraph harness
+
+
+def _join(*threads):
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+
+
+def test_lockgraph_detects_ab_ba_cycle():
+    graph = lg.install()
+    try:
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                with a:
+                    pass
+
+        # Sequential: the ORDER is recorded on every passing run — no
+        # actual deadlock interleaving required to catch it.
+        _join(threading.Thread(target=t1))
+        _join(threading.Thread(target=t2))
+    finally:
+        lg.uninstall()
+    cycles = graph.cycles()
+    assert cycles, "AB/BA order must produce a cycle"
+    assert len(cycles[0]) == 2
+
+
+def test_lockgraph_clean_on_consistent_order():
+    graph = lg.install()
+    try:
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def t(n):
+            def run():
+                for _ in range(n):
+                    with a:
+                        with b:
+                            pass
+            return threading.Thread(target=run)
+
+        _join(t(50), t(50))
+    finally:
+        lg.uninstall()
+    assert graph.cycles() == []
+    assert graph.edges  # the order itself was observed
+
+
+def test_lockgraph_records_loop_thread_lock_wait():
+    import asyncio
+
+    graph = lg.install(block_threshold=0.05)
+    try:
+        lock = threading.Lock()
+        acquired = threading.Event()
+        loop_entered = threading.Event()
+        loop = asyncio.new_event_loop()
+        t = threading.Thread(target=loop.run_forever, daemon=True)
+        t.start()
+
+        def holder():
+            with lock:
+                acquired.set()
+                # Release only after the loop callback is running, so
+                # its acquire is GUARANTEED to contend (no scheduling
+                # race on a loaded box).
+                loop_entered.wait(timeout=5)
+                lg._REAL_SLEEP(0.3)
+
+        h = threading.Thread(target=holder)
+        h.start()
+        assert acquired.wait(timeout=5)
+
+        import concurrent.futures
+
+        fut = concurrent.futures.Future()
+
+        def on_loop():
+            try:
+                loop_entered.set()
+                with lock:  # contends >= threshold on a loop thread
+                    pass
+                fut.set_result(None)
+            except BaseException as exc:  # pragma: no cover
+                fut.set_exception(exc)
+
+        loop.call_soon_threadsafe(on_loop)
+        fut.result(timeout=5)
+        h.join(timeout=5)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+    finally:
+        lg.uninstall()
+    kinds = {e["kind"] for e in graph.loop_block_events}
+    assert "loop-lock-wait" in kinds, graph.loop_block_events
+
+
+def test_lockgraph_records_sleep_on_loop_thread_and_under_lock():
+    import asyncio
+
+    graph = lg.install()
+    try:
+        loop = asyncio.new_event_loop()
+        t = threading.Thread(target=loop.run_forever, daemon=True)
+        t.start()
+        import concurrent.futures
+
+        fut = concurrent.futures.Future()
+        loop.call_soon_threadsafe(
+            lambda: (time.sleep(0.01), fut.set_result(None))
+        )
+        fut.result(timeout=5)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+        # worker-side sleep under a held lock: reported, separate list
+        lock = threading.Lock()
+        with lock:
+            time.sleep(0.01)
+    finally:
+        lg.uninstall()
+    assert any(
+        e["kind"] == "loop-sleep" for e in graph.loop_block_events
+    ), graph.loop_block_events
+    assert any(
+        e["kind"] == "sleep-under-lock"
+        for e in graph.sleep_under_lock_events
+    )
+
+
+def test_lockgraph_stdlib_surface_condition_and_rlock():
+    graph = lg.install()
+    try:
+        # Condition over an instrumented Lock: wait/notify round trip.
+        cond = threading.Condition(threading.Lock())
+        hits = []
+
+        def waiter():
+            with cond:
+                while not hits:
+                    cond.wait(timeout=5)
+                hits.append("woke")
+
+        w = threading.Thread(target=waiter)
+        w.start()
+        lg._REAL_SLEEP(0.05)
+        with cond:
+            hits.append("go")
+            cond.notify_all()
+        w.join(timeout=5)
+        assert "woke" in hits
+        # RLock reentrancy: nested self-acquire records no self-edge.
+        r = threading.RLock()
+        with r:
+            with r:
+                assert r._is_owned()
+        assert not r._is_owned()
+        # os.register_at_fork hooks (concurrent.futures.thread registers
+        # one at first import) must find the stdlib lock surface.
+        assert hasattr(threading.Lock(), "_at_fork_reinit")
+        threading.Lock()._at_fork_reinit()
+        threading.RLock()._at_fork_reinit()
+    finally:
+        lg.uninstall()
+    assert graph.cycles() == []
+
+
+def test_lockgraph_release_out_of_order_keeps_stack_sane():
+    graph = lg.install()
+    try:
+        a = threading.Lock()
+        b = threading.Lock()
+        a.acquire()
+        b.acquire()
+        a.release()  # non-LIFO release must not corrupt held tracking
+        b.release()
+        with a:
+            with b:
+                pass
+    finally:
+        lg.uninstall()
+    assert graph.cycles() == []
+
+
+def test_lockgraph_install_is_exclusive_and_restores():
+    real_lock = threading.Lock
+    graph = lg.install()
+    try:
+        with pytest.raises(RuntimeError):
+            lg.install()
+    finally:
+        assert lg.uninstall() is graph
+    assert threading.Lock is real_lock
+    assert lg.uninstall() is None

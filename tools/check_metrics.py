@@ -1,92 +1,25 @@
 #!/usr/bin/env python
-"""Static metric-name lint: source literals vs obs.registry.METRICS.
+"""Metric/span/docs lint — thin shim over ``noise_ec_tpu.analysis``.
 
-Walks the package source for registry calls —
-``reg.counter("name")`` / ``.gauge("name")`` / ``.histogram("name")`` —
-and cross-checks every referenced name against the declarative registry:
+The checks that lived here since PR 1 (undeclared metric names, type
+conflicts, unused declarations, naming conventions, suffix collisions,
+unbounded span stages, and the docs-parity lints for every subsystem
+doc) are now first-class rules in the analysis framework
+(``noise_ec_tpu/analysis/registry_rules.py``, docs/static-analysis.md
+catalog) so they compose with per-line suppressions and the corpus
+pins. This module keeps the historical entry points working:
 
-- **undeclared**: a call site uses a name METRICS does not declare
-  (a typo forks a time series silently in looser systems; here the
-  runtime Registry raises too, but only when the code path runs — this
-  catches it at lint time);
-- **type conflict**: the same name requested as two different types;
-- **unused**: a declared name no call site references (dead registry
-  entries rot the docs);
-- **suffix collision**: a histogram's generated series
-  (``_bucket``/``_sum``/``_count``) or a name pair differing only by
-  the ``_total`` convention colliding with another declared name;
-- **naming convention**: counters must end in ``_total``; gauges and
-  histograms must not (Prometheus convention — the store metric family
-  and everything after it is held to it);
-- **unbounded span stages**: every ``span("name")`` literal in the
-  source must appear in ``obs.registry.PIPELINE_STAGES`` — span names
-  become ``stage`` label values on ``noise_ec_stage_seconds`` /
-  ``noise_ec_spans_total``, and the label set stays bounded only if the
-  tuple is the single source of truth (the scrub/repair spans joined it
-  this way);
-- **docs drift**: every declared registry family must appear in
-  ``docs/observability.md`` — an undocumented series is invisible to
-  the operator the docs' metric table exists for;
-- **resilience docs parity**: the resilience metric families
-  (``noise_ec_peer_*``, ``noise_ec_reconnect_*``, ``noise_ec_nack_*``,
-  ``noise_ec_codec_*``, the store announce counter) must ALSO appear in
-  ``docs/resilience.md`` — that doc owns the fault model those series
-  instrument, the same two-home rule the ``noise_ec_store_*`` family
-  follows with docs/store.md's metric table living in
-  observability.md;
-- **span schema drift**: every span dict field
-  (``obs.trace.SPAN_FIELDS``) and every ``/spans`` dump-document key
-  (``obs.server.SPANS_DOC_FIELDS``) must be documented (backticked) in
-  ``docs/observability.md`` — the distributed-trace collector and any
-  external tooling parse exactly that schema;
-- **device-telemetry docs parity**: the operator-facing device
-  surfaces (``/profile``, ``/xprof``, the ``-profile`` / ``-xprof-dir``
-  flags, ``tools/bench_gate.py``, the cost_analysis roofline, the
-  device bucket set) must appear in docs/observability.md's "Device
-  telemetry" section — they exist only as strings in the code, so the
-  METRICS-table check cannot see them drift;
-- **object-service docs parity**: the ``noise_ec_object_*`` families
-  and the service's operator surfaces (the ``/objects`` tree, the
-  ``-object-port`` / ``-tenants`` flags, the 503 ``Retry-After`` shed
-  contract, the manifest magic) must appear in docs/object-service.md
-  — that doc owns the API and tenancy semantics those series
-  instrument, the same two-home rule the resilience families follow;
-- **cache docs parity**: the tiered read path's surfaces (the decoded
-  cache class, the warm-set magic, the single-flight coalescer entry,
-  the direct-route header, the cache CLI flag and the hot-read bench
-  keys) must appear in docs/object-service.md's "Read path" section —
-  that section owns the tier order, invalidation-by-address argument
-  and watermark policy the ``noise_ec_object_cache_*`` /
-  ``noise_ec_object_read_route_total`` families instrument (the
-  families themselves ride the object-docs check's prefix walk);
-- **wire docs parity**: the wire hot-loop families
-  (``noise_ec_wire_*``) and the loop's surfaces (the recv ring, the
-  batch-verify stage, SHARD_BATCH framing, the sendmsg flush, the
-  ``-recv-shards`` flag) must appear in docs/design.md §15 "Wire hot
-  loop" — that section owns the ring layout, batch-verify policy and
-  REUSEPORT sharding those series instrument;
-- **LRC docs parity**: the locally-repairable-code + conversion
-  families (``noise_ec_lrc_*``, ``noise_ec_convert_*``, the engine's
-  per-code shards-read counter) and the tier's surfaces (the codec and
-  engine classes, the policy grammar, the ``lrc@`` fleet token, the
-  ``-convert-interval`` flag, the bench keys) must appear in
-  docs/lrc.md — that doc owns the group layout, repair tier order,
-  conversion policy grammar and fetch-amplification math those series
-  instrument;
-- **panel docs parity**: the wide-geometry panel-tier families
-  (``noise_ec_kernel_tile_*``) and the tier's surfaces (the panel
-  kernel/planner entry points, the packed GF(2^16) layout helpers, the
-  budget and calibration constants) must appear in docs/design.md §14
-  "Wide-geometry panel kernels" — that section owns the grid layout,
-  VMEM cost model and tile auto-tune policy those series attribute.
+- ``python tools/check_metrics.py`` — run the registry/docs rules,
+  exit 1 on problems (tests/test_obs.py wraps it);
+- ``check()`` — the problem list (empty = clean);
+- ``scan_source()`` — metric name -> requested-type set, as before.
 
-Run directly (``python tools/check_metrics.py``; exit 1 on problems) or
-through the tier-1 test that wraps it (tests/test_obs.py).
+New rules belong in the framework, not here; ``tools/lint.py --all``
+runs the full suite.
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
@@ -95,536 +28,56 @@ PKG = REPO / "noise_ec_tpu"
 if str(REPO) not in sys.path:  # direct `python tools/check_metrics.py` runs
     sys.path.insert(0, str(REPO))
 
-_CALL = re.compile(
-    r"\.(counter|gauge|histogram)\(\s*[\"']([A-Za-z0-9_:]+)[\"']"
+# The rule ids this shim covers — exactly the historical check set.
+METRIC_RULE_IDS = (
+    "metric-name",
+    "span-stage",
+    "metric-registry",
+    "docs-observability",
+    "docs-subsystem",
 )
-_SPAN = re.compile(r"(?<![\w.])span\(\s*[\"']([A-Za-z0-9_]+)[\"']")
+
+# Historical constants, re-exported for callers that imported them.
+from noise_ec_tpu.analysis.registry_rules import SUBSYSTEM_DOCS  # noqa: E402
+
+RESILIENCE_PREFIXES = SUBSYSTEM_DOCS["resilience"]["prefixes"]
+RESILIENCE_EXTRAS = SUBSYSTEM_DOCS["resilience"]["extras"]
+DEVICE_DOC_TOKENS = SUBSYSTEM_DOCS["device"]["tokens"]
+OBJECT_DOC_TOKENS = SUBSYSTEM_DOCS["object"]["tokens"]
+CACHE_DOC_TOKENS = SUBSYSTEM_DOCS["cache"]["tokens"]
+FLEET_PREFIXES = SUBSYSTEM_DOCS["fleet"]["prefixes"]
+FLEET_DOC_TOKENS = SUBSYSTEM_DOCS["fleet"]["tokens"]
+DATAPATH_PREFIXES = SUBSYSTEM_DOCS["datapath"]["prefixes"]
+DATAPATH_DOC_TOKENS = SUBSYSTEM_DOCS["datapath"]["tokens"]
+MESH_PREFIXES = SUBSYSTEM_DOCS["mesh"]["prefixes"]
+MESH_DOC_TOKENS = SUBSYSTEM_DOCS["mesh"]["tokens"]
+PANEL_PREFIXES = SUBSYSTEM_DOCS["panel"]["prefixes"]
+PANEL_DOC_TOKENS = SUBSYSTEM_DOCS["panel"]["tokens"]
+WIRE_PREFIXES = SUBSYSTEM_DOCS["wire"]["prefixes"]
+WIRE_DOC_TOKENS = SUBSYSTEM_DOCS["wire"]["tokens"]
+LRC_PREFIXES = SUBSYSTEM_DOCS["lrc"]["prefixes"]
+LRC_EXTRAS = SUBSYSTEM_DOCS["lrc"]["extras"]
+LRC_DOC_TOKENS = SUBSYSTEM_DOCS["lrc"]["tokens"]
 
 
 def scan_source() -> dict[str, set[str]]:
     """name -> set of requested types across the package source."""
-    used: dict[str, set[str]] = {}
-    for path in sorted(PKG.rglob("*.py")):
-        text = path.read_text(encoding="utf-8")
-        for mtype, name in _CALL.findall(text):
-            used.setdefault(name, set()).add(mtype)
-    return used
+    from noise_ec_tpu.analysis import Project
+    from noise_ec_tpu.analysis.registry_rules import scan_metric_calls
 
-
-def scan_spans() -> dict[str, set[str]]:
-    """span stage name -> set of files using it across the package."""
-    used: dict[str, set[str]] = {}
-    for path in sorted(PKG.rglob("*.py")):
-        text = path.read_text(encoding="utf-8")
-        for name in _SPAN.findall(text):
-            used.setdefault(name, set()).add(
-                str(path.relative_to(REPO))
-            )
-    return used
+    return {
+        name: {mtype for _, _, mtype in sites}
+        for name, sites in scan_metric_calls(Project()).items()
+    }
 
 
 def check() -> list[str]:
-    """All problems found (empty list = clean)."""
-    from noise_ec_tpu.obs.registry import METRICS
+    """All metric/span/docs problems found (empty list = clean)."""
+    from noise_ec_tpu.analysis import run_project
 
-    problems: list[str] = []
-    used = scan_source()
-    for name, types in sorted(used.items()):
-        decl = METRICS.get(name)
-        if decl is None:
-            problems.append(
-                f"undeclared metric {name!r} (used as {sorted(types)}); "
-                "declare it in noise_ec_tpu/obs/registry.py METRICS"
-            )
-            continue
-        for t in sorted(types):
-            if t != decl[0]:
-                problems.append(
-                    f"metric {name!r} declared {decl[0]} but requested "
-                    f"as {t}"
-                )
-    for name in METRICS:
-        if name not in used:
-            problems.append(
-                f"declared metric {name!r} has no call site; remove it "
-                "from METRICS or wire it up"
-            )
-    # Generated-series collisions: histogram suffixes and the _total
-    # convention must not alias another declared family.
-    names = set(METRICS)
-    for name, (mtype, _, _) in METRICS.items():
-        generated = (
-            [f"{name}_bucket", f"{name}_sum", f"{name}_count"]
-            if mtype == "histogram"
-            else []
-        )
-        for g in generated:
-            if g in names:
-                problems.append(
-                    f"histogram {name!r} generates {g!r}, which is also "
-                    "declared as its own metric"
-                )
-    # Naming convention: counters carry _total, nothing else does.
-    for name, (mtype, _, _) in METRICS.items():
-        if mtype == "counter" and not name.endswith("_total"):
-            problems.append(
-                f"counter {name!r} must end in '_total' (Prometheus "
-                "convention)"
-            )
-        if mtype != "counter" and name.endswith("_total"):
-            problems.append(
-                f"{mtype} {name!r} must not end in '_total'"
-            )
-    # Span stages must come from the bounded PIPELINE_STAGES tuple: span
-    # names turn into 'stage' label values on the tracer's families.
-    from noise_ec_tpu.obs.registry import PIPELINE_STAGES
-
-    for stage, files in sorted(scan_spans().items()):
-        if stage not in PIPELINE_STAGES:
-            problems.append(
-                f"span stage {stage!r} (used in {sorted(files)}) is not "
-                "declared in obs.registry.PIPELINE_STAGES"
-            )
-    problems.extend(check_docs())
-    problems.extend(check_resilience_docs())
-    problems.extend(check_device_docs())
-    problems.extend(check_object_docs())
-    problems.extend(check_cache_docs())
-    problems.extend(check_fleet_docs())
-    problems.extend(check_datapath_docs())
-    problems.extend(check_mesh_docs())
-    problems.extend(check_panel_docs())
-    problems.extend(check_wire_docs())
-    problems.extend(check_lrc_docs())
-    return problems
-
-
-# The metric families owned by the resilience subsystem (plus the store's
-# announce counter, which the resilience doc's silent-loss recovery flow
-# depends on). Each must be documented in docs/resilience.md as well as
-# the generic observability table.
-RESILIENCE_PREFIXES = (
-    "noise_ec_peer_",
-    "noise_ec_reconnect_",
-    "noise_ec_nack_",
-    "noise_ec_codec_",
-)
-RESILIENCE_EXTRAS = ("noise_ec_store_announces_total",)
-
-
-def check_resilience_docs() -> list[str]:
-    """Resilience families vs docs/resilience.md (module docstring)."""
-    from noise_ec_tpu.obs.registry import METRICS
-
-    doc_path = REPO / "docs" / "resilience.md"
-    names = [
-        n for n in METRICS if n.startswith(RESILIENCE_PREFIXES)
-    ] + [n for n in RESILIENCE_EXTRAS if n in METRICS]
-    if not names:
-        return []
-    if not doc_path.exists():
-        return [f"docs file {doc_path} missing (resilience metrics exist)"]
-    text = doc_path.read_text(encoding="utf-8")
     return [
-        f"resilience metric {n!r} is not documented in docs/resilience.md"
-        for n in names
-        if not re.search(rf"\b{re.escape(n)}\b", text)
+        f.render() for f in run_project(rule_ids=METRIC_RULE_IDS)
     ]
-
-
-# Operator-facing device-telemetry surfaces that must stay documented in
-# docs/observability.md's "Device telemetry" section: the endpoints and
-# flags exist only as strings in the code, so the generic METRICS check
-# cannot see them drift.
-DEVICE_DOC_TOKENS = (
-    "/profile",
-    "/xprof",
-    "-xprof-dir",
-    "-profile",
-    "tools/bench_gate.py",
-    "cost_analysis",
-    "DEVICE_LATENCY_BUCKETS",
-)
-
-
-def check_device_docs() -> list[str]:
-    """Device-telemetry endpoints/flags vs docs/observability.md."""
-    doc_path = REPO / "docs" / "observability.md"
-    if not doc_path.exists():
-        return [f"docs file {doc_path} missing"]
-    text = doc_path.read_text(encoding="utf-8")
-    return [
-        f"device-telemetry surface {tok} is not documented in "
-        "docs/observability.md (Device telemetry section)"
-        for tok in DEVICE_DOC_TOKENS
-        if tok not in text
-    ]
-
-
-# The object service's operator surfaces (docs/object-service.md owns
-# the API those series instrument): endpoints, CLI flags, the shed
-# contract and the manifest wire magic live only as strings in the code.
-OBJECT_DOC_TOKENS = (
-    "/objects",
-    "-object-port",
-    "-tenants",
-    "Retry-After",
-    "noise-ec-manifest/1",
-)
-
-
-def check_object_docs() -> list[str]:
-    """Object-service families + surfaces vs docs/object-service.md."""
-    from noise_ec_tpu.obs.registry import METRICS
-
-    doc_path = REPO / "docs" / "object-service.md"
-    names = [n for n in METRICS if n.startswith("noise_ec_object_")]
-    if not names:
-        return []
-    if not doc_path.exists():
-        return [f"docs file {doc_path} missing (object metrics exist)"]
-    text = doc_path.read_text(encoding="utf-8")
-    problems = [
-        f"object metric {n!r} is not documented in docs/object-service.md"
-        for n in names
-        if not re.search(rf"\b{re.escape(n)}\b", text)
-    ]
-    problems.extend(
-        f"object-service surface {tok} is not documented in "
-        "docs/object-service.md"
-        for tok in OBJECT_DOC_TOKENS
-        if tok not in text
-    )
-    return problems
-
-
-# The tiered read path's operator surfaces (docs/object-service.md
-# "Read path" owns the tier order, the invalidation-by-address argument
-# and the watermark policy): they exist only as identifiers/strings in
-# the code, so the METRICS prefix walk cannot see them drift.
-CACHE_DOC_TOKENS = (
-    "Read path",
-    "DecodedObjectCache",
-    "noise-ec-warmset/1",
-    "submit_shared",
-    "X-NoiseEC-Route",
-    "-object-cache-mb",
-    "object_get_hot_mb_per_s",
-    "object_get_hit_rate",
-)
-
-
-def check_cache_docs() -> list[str]:
-    """Read-path surfaces vs docs/object-service.md (module docstring)."""
-    doc_path = REPO / "docs" / "object-service.md"
-    if not doc_path.exists():
-        return [f"docs file {doc_path} missing"]
-    text = doc_path.read_text(encoding="utf-8")
-    return [
-        f"read-path surface {tok} is not documented in "
-        "docs/object-service.md (Read path section)"
-        for tok in CACHE_DOC_TOKENS
-        if tok not in text
-    ]
-
-
-# The fleet lab's metric families plus the backpressure family it
-# exposed as missing (docs/fleet.md owns the grammar, scoring semantics
-# and the device-to-transport backpressure chain those series
-# instrument — the same two-home rule as the resilience families), and
-# the operator surfaces that exist only as strings in the code.
-FLEET_PREFIXES = (
-    "noise_ec_fleet_",
-    "noise_ec_backpressure_",
-)
-FLEET_DOC_TOKENS = (
-    "-fleet-profile",
-    "-fleet-size",
-    "-fleet-report",
-    "/fleet",
-    "churn@",
-    "Retry-After",
-)
-
-
-def check_fleet_docs() -> list[str]:
-    """Fleet/backpressure families + surfaces vs docs/fleet.md."""
-    from noise_ec_tpu.obs.registry import METRICS
-
-    doc_path = REPO / "docs" / "fleet.md"
-    names = [n for n in METRICS if n.startswith(FLEET_PREFIXES)]
-    if not names:
-        return []
-    if not doc_path.exists():
-        return [f"docs file {doc_path} missing (fleet metrics exist)"]
-    text = doc_path.read_text(encoding="utf-8")
-    problems = [
-        f"fleet metric {n!r} is not documented in docs/fleet.md"
-        for n in names
-        if not re.search(rf"\b{re.escape(n)}\b", text)
-    ]
-    problems.extend(
-        f"fleet surface {tok} is not documented in docs/fleet.md"
-        for tok in FLEET_DOC_TOKENS
-        if tok not in text
-    )
-    return problems
-
-
-# The host<->device data path (docs/design.md §12 owns the buffer
-# lifecycle, donation rules and coalescer flush policy the
-# noise_ec_coalesce_* / noise_ec_device_buffer_pool_* families
-# instrument): its families must be documented THERE as well as in the
-# observability registry table, plus the surfaces that exist only as
-# identifiers in the code.
-DATAPATH_PREFIXES = (
-    "noise_ec_coalesce_",
-    "noise_ec_device_buffer_pool_",
-)
-DATAPATH_DOC_TOKENS = (
-    "CoalescingDispatcher",
-    "DeviceBufferPool",
-    "donate_argnums",
-    "copy_to_host_async",
-    "submit_many",
-    "submit_shared",
-    "matmul_stripes_many",
-)
-
-
-def check_datapath_docs() -> list[str]:
-    """Data-path families + surfaces vs docs/design.md §12."""
-    from noise_ec_tpu.obs.registry import METRICS
-
-    doc_path = REPO / "docs" / "design.md"
-    names = [n for n in METRICS if n.startswith(DATAPATH_PREFIXES)]
-    if not names:
-        return []
-    if not doc_path.exists():
-        return [f"docs file {doc_path} missing (data-path metrics exist)"]
-    text = doc_path.read_text(encoding="utf-8")
-    problems = [
-        f"data-path metric {n!r} is not documented in docs/design.md "
-        "(host<->device data path section)"
-        for n in names
-        if n not in text
-    ]
-    problems.extend(
-        f"data-path surface {tok} is not documented in docs/design.md"
-        for tok in DATAPATH_DOC_TOKENS
-        if tok not in text
-    )
-    return problems
-
-
-# The mesh dispatch tier (docs/design.md §13 owns the axis layout, the
-# shard_map-vs-pjit decision table and the donation-on-mesh rules the
-# noise_ec_mesh_* families instrument): its families must be documented
-# there as well as in the observability registry table, plus the
-# surfaces that exist only as identifiers in the code.
-MESH_PREFIXES = ("noise_ec_mesh_",)
-MESH_DOC_TOKENS = (
-    "MeshRouter",
-    "configure_mesh_router",
-    "shard_map",
-    "pjit",
-    "in_shardings",
-    "out_shardings",
-)
-
-
-def check_mesh_docs() -> list[str]:
-    """Mesh-tier families + surfaces vs docs/design.md §13."""
-    from noise_ec_tpu.obs.registry import METRICS
-
-    doc_path = REPO / "docs" / "design.md"
-    names = [n for n in METRICS if n.startswith(MESH_PREFIXES)]
-    if not names:
-        return []
-    if not doc_path.exists():
-        return [f"docs file {doc_path} missing (mesh metrics exist)"]
-    text = doc_path.read_text(encoding="utf-8")
-    problems = [
-        f"mesh metric {n!r} is not documented in docs/design.md "
-        "(mesh dispatch tier section)"
-        for n in names
-        if n not in text
-    ]
-    problems.extend(
-        f"mesh surface {tok} is not documented in docs/design.md"
-        for tok in MESH_DOC_TOKENS
-        if tok not in text
-    )
-    return problems
-
-
-# The wide-geometry panel tier (docs/design.md §14 owns the block-panel
-# grid layout, the VMEM cost model, the tile auto-tune policy and the
-# GF(2^16) packed byte-sliced layout the noise_ec_kernel_tile_* families
-# attribute): its families must be documented there as well as in the
-# observability registry table, plus the surfaces that exist only as
-# identifiers in the code.
-PANEL_PREFIXES = ("noise_ec_kernel_tile_",)
-PANEL_DOC_TOKENS = (
-    "gf2_matmul_pallas_panel_rows",
-    "panel_plan",
-    "split_bits_rows_panels",
-    "pack_words_lanes_blocked",
-    "decode1_words_bytesliced",
-    "PANEL_TEMP_ALIVE_FRACTION",
-    "pl.when",
-    "PANEL_XOR_BUDGET",
-)
-
-
-def check_panel_docs() -> list[str]:
-    """Panel-tier families + surfaces vs docs/design.md §14."""
-    from noise_ec_tpu.obs.registry import METRICS
-
-    doc_path = REPO / "docs" / "design.md"
-    names = [n for n in METRICS if n.startswith(PANEL_PREFIXES)]
-    if not names:
-        return []
-    if not doc_path.exists():
-        return [f"docs file {doc_path} missing (panel metrics exist)"]
-    text = doc_path.read_text(encoding="utf-8")
-    problems = [
-        f"panel metric {n!r} is not documented in docs/design.md "
-        "(wide-geometry panel kernels section)"
-        for n in names
-        if n not in text
-    ]
-    problems.extend(
-        f"panel surface {tok} is not documented in docs/design.md"
-        for tok in PANEL_DOC_TOKENS
-        if tok not in text
-    )
-    return problems
-
-
-# The wire hot loop (docs/design.md §15 owns the ring layout, the
-# batch-verify policy and the REUSEPORT sharding story the
-# noise_ec_wire_* families instrument): its families must be documented
-# there as well as in the observability registry table, plus the
-# surfaces that exist only as identifiers in the code.
-WIRE_PREFIXES = ("noise_ec_wire_",)
-WIRE_DOC_TOKENS = (
-    "recv_into",
-    "sendmsg",
-    "SO_REUSEPORT",
-    "verify_batch",
-    "SHARD_BATCH",
-    "-recv-shards",
-    "_FrameRing",
-    "broadcast_many",
-)
-
-
-def check_wire_docs() -> list[str]:
-    """Wire hot-loop families + surfaces vs docs/design.md §15."""
-    from noise_ec_tpu.obs.registry import METRICS
-
-    doc_path = REPO / "docs" / "design.md"
-    names = [n for n in METRICS if n.startswith(WIRE_PREFIXES)]
-    if not names:
-        return []
-    if not doc_path.exists():
-        return [f"docs file {doc_path} missing (wire metrics exist)"]
-    text = doc_path.read_text(encoding="utf-8")
-    problems = [
-        f"wire metric {n!r} is not documented in docs/design.md "
-        "(wire hot loop section)"
-        for n in names
-        if n not in text
-    ]
-    problems.extend(
-        f"wire surface {tok} is not documented in docs/design.md"
-        for tok in WIRE_DOC_TOKENS
-        if tok not in text
-    )
-    return problems
-
-
-# The LRC + conversion tier (docs/lrc.md owns the group layout, repair
-# tier order, conversion policy grammar and fetch-amplification math the
-# noise_ec_lrc_* / noise_ec_convert_* families — and the engine's
-# per-code shards-read counter — instrument): its families must be
-# documented there as well as in the observability registry table, plus
-# the surfaces that exist only as identifiers/strings in the code.
-LRC_PREFIXES = ("noise_ec_lrc_", "noise_ec_convert_")
-LRC_EXTRAS = ("noise_ec_store_repair_shards_read_total",)
-LRC_DOC_TOKENS = (
-    "LocalReconstructionCode",
-    "ConversionEngine",
-    "ConversionPolicy",
-    "lrc:K/G+R",
-    "archive=",
-    "lrc@",
-    "-convert-interval",
-    "repair_fetch_amplification",
-    "convert_mb_per_s",
-    "prev_stripes",
-)
-
-
-def check_lrc_docs() -> list[str]:
-    """LRC/conversion families + surfaces vs docs/lrc.md."""
-    from noise_ec_tpu.obs.registry import METRICS
-
-    doc_path = REPO / "docs" / "lrc.md"
-    names = [n for n in METRICS if n.startswith(LRC_PREFIXES)] + [
-        n for n in LRC_EXTRAS if n in METRICS
-    ]
-    if not names:
-        return []
-    if not doc_path.exists():
-        return [f"docs file {doc_path} missing (LRC metrics exist)"]
-    text = doc_path.read_text(encoding="utf-8")
-    problems = [
-        f"LRC metric {n!r} is not documented in docs/lrc.md"
-        for n in names
-        if not re.search(rf"\b{re.escape(n)}\b", text)
-    ]
-    problems.extend(
-        f"LRC surface {tok} is not documented in docs/lrc.md"
-        for tok in LRC_DOC_TOKENS
-        if tok not in text
-    )
-    return problems
-
-
-def check_docs() -> list[str]:
-    """Docs-vs-code drift: every registry family and every span/dump
-    schema field must be documented in docs/observability.md."""
-    from noise_ec_tpu.obs.registry import METRICS
-    from noise_ec_tpu.obs.server import SPANS_DOC_FIELDS
-    from noise_ec_tpu.obs.trace import SPAN_FIELDS
-
-    doc_path = REPO / "docs" / "observability.md"
-    problems: list[str] = []
-    if not doc_path.exists():
-        return [f"docs file {doc_path} missing"]
-    text = doc_path.read_text(encoding="utf-8")
-    for name in METRICS:
-        if not re.search(rf"\b{re.escape(name)}\b", text):
-            problems.append(
-                f"metric {name!r} is not documented in "
-                "docs/observability.md (registry table)"
-            )
-    for field in SPAN_FIELDS:
-        if f"`{field}`" not in text:
-            problems.append(
-                f"span field {field!r} (obs.trace.SPAN_FIELDS) is not "
-                "documented in docs/observability.md"
-            )
-    for field in SPANS_DOC_FIELDS:
-        if f"`{field}`" not in text:
-            problems.append(
-                f"/spans document key {field!r} "
-                "(obs.server.SPANS_DOC_FIELDS) is not documented in "
-                "docs/observability.md"
-            )
-    return problems
 
 
 def main() -> int:

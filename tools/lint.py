@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Invariant analyzer CLI (docs/static-analysis.md).
+
+Runs the ``noise_ec_tpu.analysis`` rule suite — concurrency/dataflow
+rules (loop-affinity, donation, zero-copy) plus the registry/docs
+discipline rules — over the package source.
+
+Usage::
+
+    python tools/lint.py --all              # everything (the CI gate)
+    python tools/lint.py --list             # rule catalog, one per line
+    python tools/lint.py --rule zero-copy --all
+    python tools/lint.py path/to/file.py    # file rules on given files
+
+Exit codes are stable: **0** clean, **1** findings, **2** usage or
+internal error. Suppress a single finding with a justified
+``# noise-ec: allow(<rule>)`` comment on (or directly above) the
+flagged line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # direct `python tools/lint.py` runs
+    sys.path.insert(0, str(REPO))
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="lint.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "--all", action="store_true",
+        help="run every rule over the whole package (the CI gate)",
+    )
+    parser.add_argument(
+        "--rule", action="append", dest="rules", metavar="ID",
+        help="run only this rule id (repeatable)",
+    )
+    parser.add_argument(
+        "--list", action="store_true", help="list registered rules",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="specific files to check (file-scope rules only)",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        from noise_ec_tpu.analysis import (
+            FILE_RULES,
+            Project,
+            SourceFile,
+            all_rules,
+            run_project,
+        )
+    except Exception as exc:  # noqa: BLE001 — import failure = exit 2
+        print(f"lint: cannot load analysis framework: {exc}",
+              file=sys.stderr)
+        return 2
+
+    if args.list:
+        for rid, r in sorted(all_rules().items()):
+            print(f"{rid:20s} [{r.scope:7s}] {r.invariant}")
+        return 0
+
+    rule_ids = args.rules
+    if rule_ids:
+        unknown = set(rule_ids) - set(all_rules())
+        if unknown:
+            print(f"lint: unknown rule id(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    try:
+        if args.paths:
+            files = []
+            for p in args.paths:
+                path = Path(p)
+                if not path.exists():
+                    print(f"lint: no such file: {p}", file=sys.stderr)
+                    return 2
+                files.append(SourceFile(path, root=REPO))
+            project = Project(root=REPO, files=files)
+            # Explicit paths check file rules only, unless --all adds
+            # the project-wide cross-checks back in.
+            ids = rule_ids or (
+                list(all_rules()) if args.all else list(FILE_RULES)
+            )
+            findings = run_project(project, rule_ids=ids)
+        elif args.all or rule_ids:
+            findings = run_project(rule_ids=rule_ids)
+        else:
+            parser.print_usage(sys.stderr)
+            print("lint: nothing to do (use --all, --rule or paths)",
+                  file=sys.stderr)
+            return 2
+    except Exception as exc:  # noqa: BLE001 — analyzer crash = exit 2
+        print(f"lint: internal error: {exc}", file=sys.stderr)
+        return 2
+
+    for f in findings:
+        print(f.render(), file=sys.stderr)
+    if findings:
+        print(f"lint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("lint: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
