@@ -214,7 +214,7 @@ def main() -> None:
     from noise_ec_tpu.gf.field import GF256
     from noise_ec_tpu.matrix.generators import generator_matrix
     from noise_ec_tpu.matrix.linalg import reconstruction_matrix
-    from noise_ec_tpu.ops.dispatch import DeviceCodec
+    from noise_ec_tpu.ops.dispatch import DeviceCodec, plan_sublaunches
 
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
@@ -1225,6 +1225,14 @@ def main() -> None:
         # (whole-plane) and RS(200,56) (the widest panel geometry).
         for (k3, r3) in ((17, 3), (50, 20), (100, 30)):
             G3 = generator_matrix(gf, k3, k3 + r3, "cauchy")
+            # The route key rides next to every wide-sweep metric so a
+            # probe demotion (panel -> mxu) is visible in the recorded
+            # round, not just as a throughput cliff; panel routes also
+            # record the program-size model's sub-launch count G.
+            route3, plan3 = dev._route_plan(G3[k3:])
+            stats[f"rs{k3}_{r3}_route"] = route3
+            if route3 == "panel":
+                stats[f"rs{k3}_{r3}_sublaunches"] = plan_sublaunches(plan3)
             sm3 = rng.integers(0, 256, size=(k3, 8192)).astype(np.uint8)
             check_smoke(
                 np.array_equal(
@@ -1263,7 +1271,14 @@ def main() -> None:
         try:
             kN, rN = 200, 56
             GN = generator_matrix(gf, kN, kN + rN, "cauchy")
-            stats["rs200_56_route"] = dev._route_plan(GN[kN:])[0]
+            routeN, planN = dev._route_plan(GN[kN:])
+            stats["rs200_56_route"] = routeN
+            # The ROADMAP bar's named lever: G > 1 here means the
+            # program-size model split the ~361k-XOR network across
+            # K-grid sub-launches instead of demoting to the MXU.
+            stats["rs200_56_sublaunches"] = (
+                plan_sublaunches(planN) if routeN == "panel" else 0
+            )
             smN = rng.integers(0, 256, size=(kN, 4096)).astype(np.uint8)
             check_smoke(
                 np.array_equal(
@@ -1388,6 +1403,17 @@ def main() -> None:
                 G16[k:].astype(np.int64),
                 _gfi16(gf16, G16[:k]).astype(np.int64),
             ).astype(np.uint16)
+            # Route + sub-launch count of the wide-field decode fold —
+            # the other geometry the ROADMAP bar names (a GF(2^16)
+            # RS(100,30)-class fold is RS(200,56)-sized in byte rows).
+            routeD16, planD16 = dev16._route_plan(
+                dev16.decode1_matrix(A16, 1)
+            )
+            stats["gf65536_decode_route"] = routeD16
+            if routeD16 == "panel":
+                stats["gf65536_decode_sublaunches"] = plan_sublaunches(
+                    planD16
+                )
             w16d = jnp.asarray(
                 np.ascontiguousarray(_p16(cw16)).view("<u4")
             )  # (2m, TW8) packed byte-sliced words
@@ -1458,6 +1484,22 @@ def main() -> None:
         total_compiles = sum(c.value for _, c in compiles.children())
         if total_compiles:
             stats["device_jit_compiles"] = int(total_compiles)
+        # Sub-launch telemetry (design.md §14 "Sub-launch splitting"):
+        # how many K-grid sub-launches the panel dispatches executed and
+        # how many distinct sub-launch programs the run built — the
+        # program-set size the persistent compile cache amortizes.
+        sub_d = default_registry().counter(
+            "noise_ec_kernel_sublaunch_dispatches_total"
+        )
+        total_sub = sum(c.value for _, c in sub_d.children())
+        if total_sub:
+            stats["device_sublaunch_dispatches"] = int(total_sub)
+        sub_p = default_registry().counter(
+            "noise_ec_kernel_sublaunch_programs_total"
+        )
+        total_prog = sum(c.value for _, c in sub_p.children())
+        if total_prog:
+            stats["device_sublaunch_programs"] = int(total_prog)
     except Exception as exc:  # noqa: BLE001 — telemetry must not fail bench
         stats["device_obs_error"] = str(exc)[:80]
 

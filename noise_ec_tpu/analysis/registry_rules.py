@@ -297,12 +297,16 @@ SUBSYSTEM_DOCS: dict[str, dict] = {
     },
     "panel": {
         "doc": "docs/design.md",
-        "prefixes": ("noise_ec_kernel_tile_",),
-        "extras": (),
+        "prefixes": ("noise_ec_kernel_tile_",
+                     "noise_ec_kernel_sublaunch_"),
+        "extras": ("noise_ec_compile_cache_hits_total",),
         "tokens": ("gf2_matmul_pallas_panel_rows", "panel_plan",
                    "split_bits_rows_panels", "pack_words_lanes_blocked",
                    "decode1_words_bytesliced", "PANEL_TEMP_ALIVE_FRACTION",
-                   "pl.when", "PANEL_XOR_BUDGET"),
+                   "pl.when", "PANEL_XOR_BUDGET",
+                   "PANEL_SUBLAUNCH_XOR_BUDGET", "sublaunch_count",
+                   "input_output_aliases", "-compile-cache-dir",
+                   "prewarm_ladder"),
     },
     "wire": {
         "doc": "docs/design.md",

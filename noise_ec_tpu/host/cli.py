@@ -101,6 +101,19 @@ def build_parser() -> argparse.ArgumentParser:
         "the first /profile request)",
     )
     p.add_argument(
+        "-compile-cache-dir",
+        default="",
+        metavar="DIR",
+        help="persistent JAX compilation cache under DIR "
+        "(docs/design.md §14): compiled device programs — including the "
+        "panel tier's K-grid sub-launch set and the batch ladder — are "
+        "serialized to disk and replayed on restart, so geometry churn "
+        "stops paying the cold-compile tax per process. Also arms the "
+        "ladder pre-warm hook (the default geometry's power-of-two batch "
+        "programs compile at startup, off the serving path). Empty "
+        "disables",
+    )
+    p.add_argument(
         "-recv-dir",
         default="",
         metavar="DIR",
@@ -276,6 +289,15 @@ def main(argv: list[str] | None = None) -> int:
     setup_logging()  # stderr-forced, like flag.Set("logtostderr") main.go:118
     args = build_parser().parse_args(argv)
 
+    compile_cache_armed = False
+    if args.compile_cache_dir and args.backend == "device":
+        # Before the first jit: the cache decision is made once per
+        # process, so arming it after a compile would strand that
+        # program outside the cache.
+        from noise_ec_tpu.ops.dispatch import enable_compile_cache
+
+        compile_cache_armed = enable_compile_cache(args.compile_cache_dir)
+
     keys = KeyPair.random()  # fresh identity per run, main.go:132
     log.info("private key: %s", keys.private_key_hex())
     log.info("public key: %s", keys.public_key_hex())
@@ -348,7 +370,10 @@ def main(argv: list[str] | None = None) -> int:
     plugin = ShardPlugin(
         backend=args.backend, on_message=on_message, store=store
     )
-    plugin.prewarm()  # compile the default geometry before traffic arrives
+    # Compile the default geometry before traffic arrives; with the
+    # persistent cache armed, also pre-warm the batch ladder so every
+    # expected program lands in (or replays from) the on-disk cache.
+    plugin.prewarm(ladder=8 if compile_cache_armed else 0)
     net.add_plugin(plugin)
 
     net.listen()  # background accept loop (go net.Listen(), main.go:169)

@@ -397,7 +397,8 @@ class ShardPlugin:
             self._novel_inflight.pop((k, n), None)
             self._novel_pending.pop((k, n), None)
 
-    def prewarm(self, geometries=None, stripe_len: int = 64) -> None:
+    def prewarm(self, geometries=None, stripe_len: int = 64,
+                ladder: int = 0) -> None:
         """Build (and jit-warm) codecs for ``geometries`` before traffic.
 
         First use of a novel (k, n) constructs the FEC and, on the device
@@ -405,6 +406,13 @@ class ShardPlugin:
         otherwise land on the dispatch path of whichever peer sends that
         geometry first (round-1 ADVICE finding 3). Call at startup with the
         geometries you expect; defaults to this plugin's own (k, n).
+
+        ``ladder > 1`` additionally pre-warms the power-of-two batch
+        ladder up to that size (the coalescer's quantized batch
+        programs, ops/dispatch.prewarm_ladder) — paired with the
+        persistent compile cache (-compile-cache-dir) so a restart
+        replays the whole program set from disk instead of recompiling
+        it under live traffic.
         """
         if geometries is None:  # explicit [] means: warm nothing
             geometries = [(self.minimum_needed_shards, self.total_shards)]
@@ -412,6 +420,12 @@ class ShardPlugin:
             fec = self._fec(k, n)
             shares = fec.encode_shares(bytes(k * stripe_len))  # content is irrelevant
             fec.decode(shares[:k])
+            if ladder > 1 and fec._rs._dev is not None:
+                from noise_ec_tpu.ops.dispatch import prewarm_ladder
+
+                prewarm_ladder(
+                    fec._rs._dev, fec._rs.G[k:], max_batch=ladder
+                )
 
     def _recently_completed(self, key: str) -> bool:
         """True iff ``key`` completed within the dedup window. Lazily drops

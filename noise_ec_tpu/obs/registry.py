@@ -391,6 +391,27 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "gain to the panel plan that produced it",
         ("entry", "tile"),
     ),
+    "noise_ec_kernel_sublaunch_dispatches_total": (
+        "counter",
+        "K-grid sub-launches executed per panel-routed dispatch entry "
+        "(a dispatch under a G-way split plan adds G) — the split "
+        "path's execution-side telemetry; / kernel_calls gives the "
+        "mean G a geometry runs at",
+        ("entry",),
+    ),
+    "noise_ec_kernel_sublaunch_programs_total": (
+        "counter",
+        "Distinct sub-launch pallas_call programs built (panel-tier "
+        "program-cache misses, initial + accumulating) — the program-"
+        "set growth the persistent compile cache amortizes",
+        (),
+    ),
+    "noise_ec_compile_cache_hits_total": (
+        "counter",
+        "Persistent JAX compilation-cache hits (-compile-cache-dir): "
+        "programs a restart replayed from disk instead of recompiling",
+        (),
+    ),
     "noise_ec_kernel_bytes_total": (
         "counter",
         "Payload bytes moved per device-kernel entry point (the registry "

@@ -632,9 +632,11 @@ def _panel_probe_compiles(bits_rows: tuple, C: int, plan: tuple) -> bool:
     """AOT-compile two lane tiles of the panel matmul under ``plan``;
     True iff Mosaic accepts it (same two-tile rationale as the fused
     planner's probe: past two tiles VMEM pressure is grid-length
-    independent). A panel plan that fails the probe demotes the matrix
-    to the MXU route instead of failing the dispatch."""
-    KB, RB, TL, _cap = plan
+    independent). With G > 1 in the plan this compiles the whole
+    sub-launch CHAIN — every one of the G programs — so a Mosaic
+    program-size rejection of any slice fails the probe and
+    panel_plan_for escalates G instead of demoting straight to MXU."""
+    TL = plan[2]
     try:
         shape = jax.ShapeDtypeStruct((C, 8, 2 * TL), jnp.uint32)
 
@@ -645,19 +647,130 @@ def _panel_probe_compiles(bits_rows: tuple, C: int, plan: tuple) -> bool:
 
         jax.jit(f).lower(shape).compile()
         return True
-    except Exception:  # noqa: BLE001 — any compile failure demotes
-        log.warning(
-            "panel plan %s failed to compile; demoting matrix to the "
-            "MXU route", plan,
-        )
+    except Exception:  # noqa: BLE001 — any compile failure escalates
+        log.warning("panel plan %s failed the compile probe", plan)
         return False
 
 
 def tile_label(plan: tuple) -> str:
     """The (KB, RB, TL) triple as the `tile` label value of the
-    noise_ec_kernel_tile_* families (temp cap excluded: it is derived
-    from the triple and the label set must stay bounded)."""
+    noise_ec_kernel_tile_* families (temp cap and sub-launch count
+    excluded: both are derived from the network + triple and the label
+    set must stay bounded)."""
     return f"kb{plan[0]}_rb{plan[1]}_tl{plan[2]}"
+
+
+def plan_sublaunches(plan: tuple) -> int:
+    """G of a panel plan (1 for legacy 4-tuple plans)."""
+    return plan[4] if len(plan) > 4 else 1
+
+
+_sublaunch_children: dict[str, object] = {}
+
+
+def record_sublaunch_dispatch(entry: str, g: int) -> None:
+    """Count a panel-routed dispatch's G sub-launches against
+    ``noise_ec_kernel_sublaunch_dispatches_total{entry}`` — the
+    execution-side view of the split (the program-side count lives in
+    pallas_gf2mm._record_sublaunch_program)."""
+    child = _sublaunch_children.get(entry)
+    if child is None:
+        from noise_ec_tpu.obs.registry import default_registry
+
+        child = _sublaunch_children[entry] = default_registry().counter(
+            "noise_ec_kernel_sublaunch_dispatches_total"
+        ).labels(entry=entry)
+    child.add(g)
+
+
+# ------------------------------------------------ persistent compile cache
+#
+# The sub-launch split multiplies the panel program set (G programs per
+# wide geometry instead of one) and the batch ladder multiplies it
+# again — and every one of those programs was re-compiled from scratch
+# on every process restart, seconds each on real hardware. The
+# persistent JAX compilation cache (CLI -compile-cache-dir) keeps the
+# serialized executables on disk keyed by program fingerprint, so a
+# restarted node replays the whole set as cache hits; the ladder
+# pre-warm hook (prewarm_ladder) compiles the expected program set at
+# startup so even the FIRST restart after a deploy pays the compile
+# tax off the serving path.
+
+_cache_hits_child = None
+_cache_listener_installed = False
+
+
+def _note_cache_event(event: str) -> None:
+    """jax.monitoring listener body: count persistent-compile-cache
+    hits into noise_ec_compile_cache_hits_total (split out for tests —
+    the monitoring hook itself cannot be fired on demand)."""
+    global _cache_hits_child
+    if not event.startswith("/jax/compilation_cache/cache_hits"):
+        return
+    if _cache_hits_child is None:
+        from noise_ec_tpu.obs.registry import default_registry
+
+        _cache_hits_child = default_registry().counter(
+            "noise_ec_compile_cache_hits_total"
+        ).labels()
+    _cache_hits_child.add(1)
+
+
+def enable_compile_cache(cache_dir: str) -> bool:
+    """Arm the persistent JAX compilation cache at ``cache_dir``
+    (module comment above). Returns True when armed; safe to call
+    before OR after the first jit (jax memoizes its is-cache-used
+    check per task, so the cache state is reset after reconfiguring).
+    Size/time floors are zeroed: the program set here is many SMALL
+    kernels, exactly what the defaults would skip."""
+    global _cache_listener_installed
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception as exc:  # noqa: BLE001 — cache is an optimization
+        log.warning("persistent compile cache unavailable: %s", exc)
+        return False
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()  # drop the memoized pre-config decision
+    except Exception:  # noqa: BLE001 — older jax initializes lazily
+        pass
+    if not _cache_listener_installed:
+        try:
+            from jax import monitoring
+
+            def _listener(event, **kwargs):  # noqa: ANN001 — jax hook
+                _note_cache_event(event)
+
+            monitoring.register_event_listener(_listener)
+            _cache_listener_installed = True
+        except Exception:  # noqa: BLE001 — hit counter is best-effort
+            log.debug("jax monitoring listener unavailable")
+    log.info("persistent JAX compile cache at %s", cache_dir)
+    return True
+
+
+def prewarm_ladder(codec: "DeviceCodec", M: np.ndarray,
+                   stripe_bytes: int = 4096, max_batch: int = 8) -> int:
+    """The ladder pre-warm hook: compile (and, with the persistent
+    cache armed, serialize) the power-of-two batch-ladder programs for
+    matrix ``M`` before traffic arrives, so geometry churn after a
+    restart replays them as compile-cache hits instead of paying the
+    cold-compile tax per novel batch size. Returns the number of
+    ladder rungs warmed."""
+    M = np.asarray(M)
+    k = M.shape[1]
+    warmed = 0
+    B = 1
+    while B <= max_batch:
+        Ds = [np.zeros((k, stripe_bytes), dtype=codec.gf.dtype)
+              for _ in range(B)]
+        codec.matmul_stripes_many(M, Ds)
+        warmed += 1
+        B *= 2
+    return warmed
 
 
 # Whole-plane baked XOR-network kernels scale with the generator's
@@ -674,14 +787,20 @@ def tile_label(plan: tuple) -> str:
 # the whole-plane route.
 _BAKED_XOR_BUDGET = 60_000
 
-# The panel tier on the interpret kernel (CPU tests) shares the
-# whole-plane budget instead of PANEL_XOR_BUDGET: interpret mode exists
-# for correctness coverage, and tracing + XLA:CPU-compiling a
-# multi-hundred-k-op unrolled network takes minutes per geometry there
-# (measured ~220 s for RS(200,56)) — the MXU route is bit-exact and
-# cheap to build, so wide interpret runs use it. Tests that need the
-# panel kernels at interpret force them via the explicit plan override.
-_PANEL_XOR_BUDGET_INTERPRET = _BAKED_XOR_BUDGET
+# The panel tier's raw-XOR ceiling on the interpret kernel (CPU tests).
+# Its OWN constant, deliberately NOT aliased to _BAKED_XOR_BUDGET even
+# though the values coincide today: the two budgets answer different
+# questions (_BAKED_XOR_BUDGET = "when does the whole-plane kernel stop
+# winning", this = "how big a network can interpret-mode afford to
+# trace at all"), so tuning the baked budget must never silently move
+# interpret-mode panel routing with it. Rationale for the value:
+# interpret mode exists for correctness coverage, and tracing +
+# XLA:CPU-compiling a multi-hundred-k-op unrolled network takes minutes
+# per geometry there (measured ~220 s for RS(200,56)) — the MXU route
+# is bit-exact and cheap to build, so wide interpret runs use it. Tests
+# that need the panel kernels at interpret force them via the explicit
+# plan override.
+_PANEL_XOR_BUDGET_INTERPRET = 60_000
 
 # The baked pipeline's pack/unpack stages hold (rows, 8, 2*TL) u32 tiles in
 # VMEM regardless of the XOR cost, so a matrix with many INPUT or OUTPUT
@@ -859,19 +978,43 @@ class DeviceCodec:
         return "baked"
 
     def panel_plan_for(self, M: np.ndarray):
-        """The verified (KB, RB, TL, temp_cap) panel plan for a
-        panel-routed matrix, or None when no candidate compiles (the
-        dispatch then falls back to the MXU route — a Mosaic stack OOM
-        must demote, not fail the encode). Cached per matrix; the plan
-        triple joins the dispatch cache key and the
-        ``noise_ec_kernel_tile_*`` telemetry labels."""
+        """The verified (KB, RB, TL, temp_cap, G) panel plan for a
+        panel-routed matrix, or None when no split compiles (the
+        dispatch then falls back to the MXU route). Cached per matrix;
+        the plan triple AND the sub-launch count G join the dispatch
+        cache key, the triple labels the ``noise_ec_kernel_tile_*``
+        telemetry.
+
+        G starts at the program-size model's choice
+        (``panel_plan`` / ``sublaunch_count``: estimated Mosaic op
+        count per sub-launch vs PANEL_SUBLAUNCH_XOR_BUDGET) and the
+        AOT probe confirms it. A Mosaic rejection ESCALATES G
+        (doubling, capped at PK = one K-block per launch) and
+        re-probes; only when even G = PK fails does the matrix demote
+        to the MXU route — the split path replaced the old
+        demote-on-first-rejection behavior."""
         bits_rows = self.bits_rows_for(M)
         m = self.gf.degree
         C = (2 * M.shape[1] * 8) if m == 16 else (M.shape[1] * 8)
         plan = panel_plan(bits_rows, C)
         if self.kernel == "pallas_interpret":
             return plan  # no scoped-vmem limit to probe against
-        return plan if _panel_probe_compiles(bits_rows, C, plan) else None
+        PK = max(1, -(-C // plan[0]))
+        while True:
+            if _panel_probe_compiles(bits_rows, C, plan):
+                return plan
+            G = plan[4]
+            if G >= PK:
+                log.warning(
+                    "panel plan %s rejected even at G = K-blocks; "
+                    "demoting matrix to the MXU route", plan,
+                )
+                return None
+            plan = plan[:4] + (min(PK, G * 2),)
+            log.info(
+                "panel probe escalating to %d sub-launches for a "
+                "%d-col network", plan[4], C,
+            )
 
     def _route_plan(self, M: np.ndarray):
         """(route, plan): the tier decision plus, for the panel tier,
@@ -886,13 +1029,14 @@ class DeviceCodec:
 
     def _key_shape(self, M: np.ndarray, shape: tuple) -> tuple:
         """Dispatch-cache key shape: panel-routed matrices append the
-        (KB, RB, TL) tile triple, so a plan change (auto-tuner update,
-        probe demotion) reads as a compile-route dispatch in the
-        telemetry instead of silently re-timing under the old key."""
+        (KB, RB, TL) tile triple AND the sub-launch count G, so a plan
+        change (auto-tuner update, probe escalation, demotion) reads as
+        a compile-route dispatch in the telemetry instead of silently
+        re-timing under the old key."""
         if self.kernel != "xla":
             route, plan = self._route_plan(M)
             if route == "panel":
-                return shape + ("panel",) + plan[:3]
+                return shape + ("panel",) + plan[:3] + (plan[4],)
         return shape
 
     def _m2_for_wide(self, M: np.ndarray):
@@ -1015,6 +1159,7 @@ class DeviceCodec:
         # reconstruct reuses one allocation instead of growing two.
         if route == "panel":
             dt.tile = tile_label(plan)
+            record_sublaunch_dispatch(dt.entry, plan_sublaunches(plan))
             fn = _panel_words_fn(
                 r, 8, self.bits_rows_for(M), plan,
                 self.kernel == "pallas_interpret", True,
@@ -1216,6 +1361,10 @@ class DeviceCodec:
         if route == "panel":
             if dt is not None:
                 dt.tile = tile_label(plan)
+            record_sublaunch_dispatch(
+                dt.entry if dt is not None else "matmul_words_bytesliced",
+                plan_sublaunches(plan),
+            )
             fn = _panel_words_fn(
                 r2, 8, self.bits_rows_for(M), plan,
                 self.kernel == "pallas_interpret",
@@ -1258,6 +1407,9 @@ class DeviceCodec:
                 interpret=self.kernel == "pallas_interpret",
             )
         elif route == "panel":
+            record_sublaunch_dispatch(
+                "matmul_words_bytesliced", plan_sublaunches(plan)
+            )
             fn = _panel_words_fn(
                 r2, 8, self.bits_rows_for(M), plan,
                 self.kernel == "pallas_interpret",
@@ -1393,6 +1545,9 @@ class DeviceCodec:
                 # blocked pack; the packed byte-sliced entries stay the
                 # wide-field fast path (3 rounds, m=8 quantum).
                 dt.tile = tile_label(plan)
+                record_sublaunch_dispatch(
+                    dt.entry, plan_sublaunches(plan)
+                )
                 fn = _panel_words_fn(
                     M.shape[0], self.gf.degree, self.bits_rows_for(M),
                     plan, self.kernel == "pallas_interpret", donate,
@@ -1447,6 +1602,9 @@ class DeviceCodec:
                 "kernel; use matmul_stripes/matmul_words (the MXU route)"
             )
         if route == "panel":
+            record_sublaunch_dispatch(
+                "matmul_planes", plan_sublaunches(plan)
+            )
             out = gf2_matmul_pallas_panel_rows(
                 self.bits_rows_for(M),
                 planes_to_tiled(planes),

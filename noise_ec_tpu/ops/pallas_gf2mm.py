@@ -344,6 +344,41 @@ def gf2_matmul_pallas_sparse(
 # interpret/CPU tier the budget is far lower — ops/dispatch.py).
 PANEL_XOR_BUDGET = 600_000
 
+# Per-SUB-LAUNCH raw-XOR budget. A single pallas_call carrying the whole
+# K axis bakes every panel's branch into ONE Mosaic program, and Mosaic
+# has a program-size limit independent of VMEM: the RS(200,56) panel
+# program (~361k raw XORs, ~132k factored ops) trips it on v5e even
+# though every grid step fits VMEM. Instead of demoting the matrix to
+# the dense MXU tier (whose int8 roofline at r=56 is ~110 GB/s), the
+# planner splits the K-BLOCK axis into G K-grid-major sub-launches —
+# each its own pallas_call over a contiguous K-block slice with the
+# same (KB, RB, TL) plan, chained by XOR accumulation into the
+# HBM-resident output (see gf2_matmul_pallas_panel_rows). G is picked
+# up front as ceil(raw / budget), capped at PK (one K-block per
+# launch); raw XORs are the deliberately RATIO-FREE currency here so
+# the G boundary is deterministic and pinnable (the factored op count
+# depends on per-panel Paar yield, which the planner only estimates).
+# The AOT compile probe confirms the choice and escalates G when
+# Mosaic still rejects (ops/dispatch.py panel_plan_for).
+PANEL_SUBLAUNCH_XOR_BUDGET = 130_000
+
+
+def sublaunch_count(raw_xors: int, PK: int) -> int:
+    """The program-size model's G: K-grid sub-launches for a panel
+    network of ``raw_xors`` over ``PK`` K-blocks. 1 = single launch
+    (the largest such plan is pinned in tests/test_panel.py, as is the
+    smallest G=2 split)."""
+    G = max(1, -(-raw_xors // PANEL_SUBLAUNCH_XOR_BUDGET))
+    return min(G, max(1, PK))
+
+
+def sublaunch_bounds(PK: int, G: int) -> list[int]:
+    """Even contiguous partition of PK K-blocks into G chunks:
+    boundaries[g]..boundaries[g+1] is sub-launch g's K-block slice.
+    Round-to-nearest keeps chunk sizes within one block of each other
+    and every chunk non-empty for G <= PK."""
+    return [round(g * PK / G) for g in range(G + 1)]
+
 
 def panel_vmem_bytes(KB: int, RB: int, TL: int, temps: int) -> int:
     """VMEM bytes of one panel-kernel grid step: double-buffered input
@@ -375,15 +410,18 @@ _PANEL_TL_FACTOR = {512: 1.0, 256: 1.08, 128: 1.15}
 
 @functools.lru_cache(maxsize=512)
 def panel_plan(bits_rows: tuple, C: int) -> tuple:
-    """Auto-tuned (KB, RB, TL, temp_cap) for the panel kernel.
+    """Auto-tuned (KB, RB, TL, temp_cap, G) for the panel kernel.
 
     Scored by estimated VPU bytes per input byte from the same VMEM
     cost model the whole-plane kernels use — factored network cost
     (ratio-estimated; the chosen plan's panels are factored exactly at
     build time under ``temp_cap``) plus the K-step accumulate traffic
     ((PK-1) XOR+write passes over the R output rows) — instead of the
-    single shrinking lane knob. The roofline telemetry attributes the
-    result per tile triple (``noise_ec_kernel_tile_*``,
+    single shrinking lane knob. ``G`` is the program-size model's
+    sub-launch count (:func:`sublaunch_count`): how many K-grid-major
+    pallas_call programs the network splits into so no single Mosaic
+    program exceeds PANEL_SUBLAUNCH_XOR_BUDGET. The roofline telemetry
+    attributes the result per tile triple (``noise_ec_kernel_tile_*``,
     obs/device.py), which is how a mis-scored plan shows up instead of
     hiding inside one aggregate kernel series. Raises ValueError when
     no tile triple fits VMEM (cannot happen for KB=RB=32, TL=128 under
@@ -426,7 +464,8 @@ def panel_plan(bits_rows: tuple, C: int) -> tuple:
         raise ValueError(
             f"no panel tile fits VMEM for R={R}, C={C}"
         )
-    return best[1]
+    KB = best[1][0]
+    return best[1] + (sublaunch_count(raw, -(-C // KB)),)
 
 
 def _make_panel_kernel(nets_flat: tuple, PK: int, KB: int, RB: int,
@@ -467,9 +506,24 @@ def _make_panel_kernel(nets_flat: tuple, PK: int, KB: int, RB: int,
     return kernel
 
 
+def _record_sublaunch_program() -> None:
+    """Count one freshly built sub-launch pallas_call program (the
+    _panel_call* builder bodies run on lru-cache miss only, so this is
+    the distinct-program count the compile-churn telemetry watches)."""
+    try:
+        from noise_ec_tpu.obs.registry import default_registry
+
+        default_registry().counter(
+            "noise_ec_kernel_sublaunch_programs_total"
+        ).labels().add(1)
+    except Exception:  # noqa: BLE001 — telemetry must not fail a build
+        pass
+
+
 @functools.lru_cache(maxsize=128)
 def _panel_call(nets_flat: tuple, PR: int, PK: int, Cp: int, W8p: int,
                 KB: int, RB: int, TL: int, temp_cap: int, interpret: bool):
+    _record_sublaunch_program()
     kernel = _make_panel_kernel(nets_flat, PK, KB, RB, TL, temp_cap)
     return pl.pallas_call(
         kernel,
@@ -485,6 +539,75 @@ def _panel_call(nets_flat: tuple, PR: int, PK: int, Cp: int, W8p: int,
     )
 
 
+def _make_panel_acc_kernel(nets_flat: tuple, PK: int, KB: int, RB: int,
+                           TL: int, temp_cap: int):
+    """The non-first sub-launch's kernel: same panel evaluation as
+    _make_panel_kernel, but the first K step of each (pr, i) tile XORs
+    the previous sub-launch's accumulator tile in instead of
+    initializing from zero — so the chain of G launches computes the
+    same sum as one launch over the whole K axis (XOR is abelian)."""
+    from noise_ec_tpu.ops.xor_factor import eval_bits_rows
+
+    def kernel(acc_ref, planes_ref, out_ref):
+        pr = pl.program_id(0)
+        pk = pl.program_id(2)
+        x = planes_ref[...]  # (KB, 8, TL)
+
+        def branch(net):
+            def f(xv):
+                outs = eval_bits_rows(
+                    net, KB,
+                    lambda c: xv[c],
+                    lambda: jnp.zeros((8, TL), dtype=jnp.uint32),
+                    max_temps=temp_cap,
+                )
+                return jnp.stack(outs)
+
+            return f
+
+        partial = jax.lax.switch(
+            pr * PK + pk, [branch(n) for n in nets_flat], x
+        )
+
+        @pl.when(pk == 0)
+        def _init():
+            out_ref[...] = acc_ref[...] ^ partial
+
+        @pl.when(pk != 0)
+        def _accumulate():
+            out_ref[...] = out_ref[...] ^ partial
+
+    return kernel
+
+
+@functools.lru_cache(maxsize=128)
+def _panel_call_acc(nets_flat: tuple, PR: int, PK: int, W8p: int,
+                    KB: int, RB: int, TL: int, temp_cap: int,
+                    interpret: bool):
+    """Accumulating sub-launch: (acc (PR*RB, 8, W8p), planes slice) ->
+    acc ^ partial. The accumulator is DONATED between launches via
+    ``input_output_aliases={0: 0}`` — XLA reuses its HBM buffer for the
+    output, so chaining G sub-launches costs zero extra HBM copies of
+    the output panel (the accumulator-donation rule, design.md §14)."""
+    _record_sublaunch_program()
+    kernel = _make_panel_acc_kernel(nets_flat, PK, KB, RB, TL, temp_cap)
+    return pl.pallas_call(
+        kernel,
+        grid=(PR, W8p // TL, PK),
+        in_specs=[
+            pl.BlockSpec((RB, 8, TL), lambda pr, i, pk: (pr, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((KB, 8, TL), lambda pr, i, pk: (pk, 0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((RB, 8, TL), lambda pr, i, pk: (pr, 0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((PR * RB, 8, W8p), jnp.uint32),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )
+
+
 def gf2_matmul_pallas_panel_rows(
     bits_rows: tuple[tuple[int, ...], ...],  # STATIC: baked per panel
     tiled_planes: jnp.ndarray,  # (C, 8, W8) uint32
@@ -495,24 +618,37 @@ def gf2_matmul_pallas_panel_rows(
     """Block-panel K-tiled GF(2) matmul (module comment above).
 
     Returns (R, 8, W8) uint32, byte-identical to the whole-plane sparse
-    kernel. ``plan`` overrides the auto-tuner's (KB, RB, TL, temp_cap)
-    — tests force small panels; dispatch passes its cached plan so the
-    telemetry tile key and the kernel agree.
+    kernel. ``plan`` overrides the auto-tuner's (KB, RB, TL, temp_cap,
+    G) — tests force small panels and sub-launch counts; dispatch
+    passes its cached plan so the telemetry tile key and the kernel
+    agree. A 4-tuple plan (the pre-split form) is accepted as G=1.
+
+    With G > 1 the K-block axis splits into G contiguous K-grid-major
+    SUB-LAUNCHES (:func:`sublaunch_bounds`): sub-launch 0 initializes
+    the HBM-resident output exactly like the single-launch kernel, and
+    each later sub-launch XOR-accumulates its K-slice's partial into
+    the accumulator in place (``_panel_call_acc``,
+    ``input_output_aliases={0: 0}`` — the accumulator's HBM is donated
+    launch to launch, no extra copy). Every sub-launch program carries
+    only its own K-slice's panels, so Mosaic's program-size limit
+    bounds one slice, not the whole network.
     """
     from noise_ec_tpu.ops.xor_factor import split_bits_rows_panels
 
     C, sub, W8 = tiled_planes.shape
     assert sub == 8, tiled_planes.shape
     R = len(bits_rows)
-    KB, RB, TL, temp_cap = plan if plan is not None else panel_plan(
-        bits_rows, C
-    )
+    if plan is None:
+        plan = panel_plan(bits_rows, C)
+    KB, RB, TL, temp_cap = plan[:4]
+    G = plan[4] if len(plan) > 4 else 1
     # Sub-tile payloads: shrink the lane tile to the padded lane count
     # (strictly less VMEM than planned, so the temp cap stays valid) —
     # a 128-lane probe under a TL=512 plan must not compute 4x padding.
     TL = min(TL, max(128, -(-W8 // 128) * 128))
     PR = -(-R // RB)
     PK = -(-C // KB)
+    G = max(1, min(G, PK))
     Cp = PK * KB
     W8p = -(-W8 // TL) * TL
     pad_c = Cp - C
@@ -522,10 +658,29 @@ def gf2_matmul_pallas_panel_rows(
             tiled_planes, ((0, pad_c), (0, 0), (0, pad_w))
         )
     panels = split_bits_rows_panels(bits_rows, Cp, KB, RB)
-    nets_flat = tuple(p for row in panels for p in row)
-    out = _panel_call(
-        nets_flat, PR, PK, Cp, W8p, KB, RB, TL, temp_cap, interpret
-    )(tiled_planes)
+    if G == 1:
+        nets_flat = tuple(p for row in panels for p in row)
+        out = _panel_call(
+            nets_flat, PR, PK, Cp, W8p, KB, RB, TL, temp_cap, interpret
+        )(tiled_planes)
+    else:
+        bounds = sublaunch_bounds(PK, G)
+        out = None
+        for g in range(G):
+            lo, hi = bounds[g], bounds[g + 1]
+            PKg = hi - lo
+            nets_g = tuple(p for row in panels for p in row[lo:hi])
+            planes_g = tiled_planes[lo * KB : hi * KB]
+            if g == 0:
+                out = _panel_call(
+                    nets_g, PR, PKg, PKg * KB, W8p, KB, RB, TL,
+                    temp_cap, interpret,
+                )(planes_g)
+            else:
+                out = _panel_call_acc(
+                    nets_g, PR, PKg, W8p, KB, RB, TL, temp_cap,
+                    interpret,
+                )(out, planes_g)
     if PR * RB != R or pad_w:
         out = out[:R, :, :W8]
     return out

@@ -249,6 +249,11 @@ class MeshRouter:
 
         interpret = kernel == "pallas_interpret"
         donate = donate and donation_supported()
+        # The plan carries the sub-launch count G (design.md §14): the
+        # per-shard panel pipeline chains its G K-grid sub-launches
+        # INSIDE the shard_map body, so the split never crosses the
+        # mesh boundary — shardings in and out are the same one program
+        # and the zero-reshard contract holds across sub-launches.
         key = ("words", kernel, r_out, bits_rows, n_dev, donate, plan)
 
         def build():
@@ -400,6 +405,15 @@ class MeshRouter:
                 self._note_input(arr, expected)
         out = fn(arr)
         self._record("shard_map", 4 * B * k * TW, n_dev)
+        if plan is not None:
+            from noise_ec_tpu.ops.dispatch import (
+                plan_sublaunches,
+                record_sublaunch_dispatch,
+            )
+
+            record_sublaunch_dispatch(
+                "mesh_words", plan_sublaunches(plan)
+            )
         return out, B, TW
 
     def matmul_words_batch(self, codec, M: np.ndarray, words, *,
@@ -460,6 +474,15 @@ class MeshRouter:
             self._note_input(arr, expected)
         corrected, bad = fn(arr)
         self._record("shard_map", 4 * B * m * TW, n_dev)
+        if route == "panel":
+            from noise_ec_tpu.ops.dispatch import (
+                plan_sublaunches,
+                record_sublaunch_dispatch,
+            )
+
+            record_sublaunch_dispatch(
+                "mesh_decode1", plan_sublaunches(plan)
+            )
         return corrected[:B, :TW], bad[:B, :TW]
 
     # ----------------------------------------------------- sym batch entry

@@ -133,7 +133,7 @@ def test_temp_model_boundary_cases():
     g120 = generator_matrix(gf, 120, 124, "cauchy")
     rows120 = bits_to_rows(expand_generator_bits(gf, g120[120:]))
     plan = panel_plan(rows120, 8 * 120)
-    KB, RB, TL, cap = plan
+    KB, RB, TL, cap = plan[:4]
     assert cap > 0
     assert panel_vmem_bytes(KB, RB, TL, cap) <= VMEM_BUDGET_BYTES
     panels = split_bits_rows_panels(
@@ -172,12 +172,24 @@ def test_tier_decision_routes_every_supported_geometry():
             if route == "panel":
                 KB, RB, TL, cap = panel_plan(
                     dev.bits_rows_for(M), dev.gf.degree * k
-                )
+                )[:4]
                 assert panel_vmem_bytes(KB, RB, TL, cap) <= VMEM_BUDGET_BYTES
     dev = DeviceCodec(field="gf256", kernel="pallas")
     G = generator_matrix(dev.gf, 200, 256, "cauchy")
     assert dev.route_for(G[200:]) == "panel"
     assert xor_cost(dev.bits_rows_for(G[200:])) <= PANEL_XOR_BUDGET
+    # The ISSUE-15 acceptance: the program-size model splits the
+    # ~361k-XOR RS(200,56) network across G > 1 K-grid sub-launches
+    # (one Mosaic program per K-slice) instead of leaving the single
+    # over-limit program to the probe's MXU demotion; the wide-field
+    # RS(100,30) network — RS(200,56)-sized in byte rows — splits too.
+    assert panel_plan(dev.bits_rows_for(G[200:]), 8 * 200)[4] > 1
+    dev16w = DeviceCodec(field="gf65536", kernel="pallas")
+    G16w = generator_matrix(dev16w.gf, 100, 130, "cauchy")
+    assert dev16w.route_for(G16w[100:]) == "panel"
+    assert panel_plan(
+        dev16w.bits_rows_for(G16w[100:]), 16 * 100
+    )[4] > 1
     # The fused corrupted-share decode fold rides the panel tier too.
     from noise_ec_tpu.matrix.linalg import gf_inv
 
@@ -289,6 +301,333 @@ def test_panel_geometry_sweep_no_recompile_churn(rng):
     sweep()
     sweep()
     assert total() == warm, "repeat panel geometry sweep re-compiled"
+
+
+# ------------------------------------------- K-grid sub-launch splitting
+
+
+def test_sublaunch_split_byte_identity(rng):
+    """Split-vs-single-launch byte identity (docs/design.md §14
+    "Sub-launch splitting"): forced G ∈ {2, 3, 4} over a geometry with
+    an uneven K tail (C=45 at KB=8 → PK=6 with a 5-row tail block, and
+    a K-block count that does not divide evenly into any G) must match
+    the single-launch kernel and the numpy planes reference byte for
+    byte — the accumulator chain changes the evaluation order only,
+    and XOR is abelian."""
+    bits = rng.integers(0, 2, size=(19, 45)).astype(np.uint8)
+    bits[7] = 0  # empty-row path through the accumulating kernel too
+    planes = rng.integers(0, 2**32, size=(45, 777), dtype=np.uint32)
+    want = gf2_matmul_planes(bits, planes)
+    tiled = planes_to_tiled(jnp.asarray(planes))
+    rows = bits_to_rows(bits)
+    single = np.asarray(tiled_to_planes(
+        gf2_matmul_pallas_panel_rows(
+            rows, tiled, plan=(8, 4, 128, 64, 1), interpret=True
+        ), 777,
+    ))
+    np.testing.assert_array_equal(single, want)
+    for G in (2, 3, 4):
+        out = gf2_matmul_pallas_panel_rows(
+            rows, tiled, plan=(8, 4, 128, 64, G), interpret=True
+        )
+        got = np.asarray(tiled_to_planes(out, 777))
+        np.testing.assert_array_equal(got, want)
+    # G past PK clamps to one K-block per launch instead of erroring;
+    # a legacy 4-tuple plan means G=1.
+    for plan in ((8, 4, 128, 64, 99), (8, 4, 128, 64)):
+        out = gf2_matmul_pallas_panel_rows(
+            rows, tiled, plan=plan, interpret=True
+        )
+        np.testing.assert_array_equal(
+            np.asarray(tiled_to_planes(out, 777)), want
+        )
+
+
+def test_sublaunch_program_size_model_boundaries():
+    """The program-size model's G boundary, pinned in the model's own
+    currency (raw XORs — deliberately ratio-free so this boundary is
+    deterministic): the largest G=1 network (raw == budget) stays a
+    single launch, one more XOR splits to G=2, and G is clamped to the
+    K-block count."""
+    from noise_ec_tpu.ops.pallas_gf2mm import (
+        PANEL_SUBLAUNCH_XOR_BUDGET,
+        sublaunch_bounds,
+        sublaunch_count,
+    )
+
+    B = PANEL_SUBLAUNCH_XOR_BUDGET
+    assert sublaunch_count(B, PK=64) == 1        # largest single launch
+    assert sublaunch_count(B + 1, PK=64) == 2    # smallest split
+    assert sublaunch_count(3 * B, PK=64) == 3
+    assert sublaunch_count(10**9, PK=7) == 7     # clamped to K-blocks
+    # Bounds: contiguous, exhaustive, every chunk non-empty.
+    for PK, G in ((7, 3), (6, 4), (12, 5), (3, 3)):
+        bounds = sublaunch_bounds(PK, G)
+        assert bounds[0] == 0 and bounds[-1] == PK
+        assert all(b2 > b1 for b1, b2 in zip(bounds, bounds[1:]))
+    # Through panel_plan itself: a synthetic network of exactly the
+    # budget's raw cost plans G=1, one extra term plans G=2 (the
+    # model's G rides the plan tuple, index 4).
+    R, T = 16, 8126
+    rows_flat = tuple(tuple(range(T)) for _ in range(R))
+    assert xor_cost(rows_flat) == R * (T - 1) == 130_000 == B
+    assert panel_plan(rows_flat, T)[4] == 1
+    rows_over = (tuple(range(T)),) * (R - 1) + (
+        tuple(range(T)), (0, 1),
+    )
+    assert xor_cost(rows_over) == B + 1
+    assert panel_plan(rows_over, T)[4] == 2
+
+
+def test_sublaunch_probe_escalation_and_final_demotion(monkeypatch):
+    """The demote-to-MXU branch fires only when even G = K-blocks fails
+    the probe: a Mosaic rejection first ESCALATES G (doubling, capped
+    at PK), and panel_plan_for returns the escalated plan as soon as a
+    split compiles."""
+    import noise_ec_tpu.ops.dispatch as dispatch_mod
+    from noise_ec_tpu.matrix.generators import generator_matrix as genm
+
+    dev = DeviceCodec(field="gf256", kernel="pallas")
+    M = genm(dev.gf, 120, 124, "cauchy")[120:]
+    assert dev.route_for(M) == "panel"
+    base = panel_plan(dev.bits_rows_for(M), 8 * 120)
+    PK = -(-8 * 120 // base[0])
+    assert PK >= 4  # the escalation below needs room to double
+    probed = []
+
+    def fake_probe(bits_rows, C, plan):
+        probed.append(plan[4])
+        return plan[4] >= 4  # Mosaic "accepts" only >= 4 sub-launches
+
+    monkeypatch.setattr(dispatch_mod, "_panel_probe_compiles", fake_probe)
+    plan = dev.panel_plan_for(M)
+    assert plan is not None and plan[4] == 4
+    assert probed == [base[4], 2, 4] or probed == [base[4], 4]
+    # Nothing compiles, even one K-block per launch: NOW demote.
+    probed.clear()
+    monkeypatch.setattr(
+        dispatch_mod, "_panel_probe_compiles", lambda *a: False
+    )
+    assert dev.panel_plan_for(M) is None
+    assert probed == []  # lambda records nothing; demotion = None
+    assert dev._route_plan(M) == ("mxu", None)
+
+
+def test_sublaunch_dispatch_telemetry_and_cache_key(rng, monkeypatch):
+    """A panel dispatch under a G-way plan is byte-identical through
+    the public entry, adds G to the sub-launch dispatch counter, and
+    G is part of the dispatch cache key (a G change reads as a
+    compile-route dispatch, not a silent re-time)."""
+    import noise_ec_tpu.ops.dispatch as dispatch_mod
+
+    k, r = 120, 4
+    dev = DeviceCodec(field="gf256", kernel="pallas_interpret")
+    G = generator_matrix(dev.gf, k, k + r, "cauchy")
+    assert dev.route_for(G[k:]) == "panel"
+    base = panel_plan(dev.bits_rows_for(G[k:]), 8 * k)
+    forced = base[:4] + (2,)
+    monkeypatch.setattr(
+        dispatch_mod, "panel_plan", lambda bits_rows, C: forced
+    )
+    key1 = dev._key_shape(G[k:], (k, 3001))
+    assert key1[-1] == 2  # G rides the cache key tail
+    D = rng.integers(0, 256, size=(k, 3001)).astype(np.uint8)
+    subs = default_registry().counter(
+        "noise_ec_kernel_sublaunch_dispatches_total"
+    ).labels(entry="matmul_stripes_pallas_interpret")
+    before = subs.value
+    got = dev.matmul_stripes(G[k:], D)
+    want = np.asarray(GoldenCodec(k, k + r).encode(D))
+    np.testing.assert_array_equal(got, want)
+    assert subs.value == before + 2
+    # Program-side count: the split built at least 2 distinct programs
+    # (initial + accumulating) across the run.
+    progs = default_registry().counter(
+        "noise_ec_kernel_sublaunch_programs_total"
+    ).labels()
+    assert progs.value >= 2
+    monkeypatch.setattr(
+        dispatch_mod, "panel_plan", lambda bits_rows, C: base[:4] + (3,)
+    )
+    key2 = dev._key_shape(G[k:], (k, 3001))
+    assert key2 != key1 and key2[-1] == 3
+
+
+def test_mesh_sublaunch_split_zero_reshard(rng, monkeypatch):
+    """The mesh tier under a G-way split plan: the sub-launch chain
+    runs INSIDE the per-shard shard_map body, so sharded wide-geometry
+    encode stays byte-identical and noise_ec_mesh_reshard_total does
+    not move — the zero-reshard contract holds across sub-launches."""
+    import noise_ec_tpu.ops.dispatch as dispatch_mod
+    from noise_ec_tpu.parallel.mesh import (
+        configure_mesh_router,
+        reset_mesh_router,
+    )
+
+    k, r = 120, 4
+    dev = DeviceCodec(field="gf256", kernel="pallas_interpret")
+    G = generator_matrix(dev.gf, k, k + r, "cauchy")
+    base = panel_plan(dev.bits_rows_for(G[k:]), 8 * k)
+    monkeypatch.setattr(
+        dispatch_mod, "panel_plan", lambda bits_rows, C: base[:4] + (2,)
+    )
+    router = configure_mesh_router(enable=True)
+    try:
+        assert router.enabled
+        B, TW = 8, 8192
+        words = rng.integers(
+            0, 1 << 32, size=(B, k, TW), dtype=np.uint64
+        ).astype(np.uint32)
+        reshard = default_registry().counter("noise_ec_mesh_reshard_total")
+        reshard0 = reshard.labels().value
+        subs = default_registry().counter(
+            "noise_ec_kernel_sublaunch_dispatches_total"
+        ).labels(entry="mesh_words")
+        subs0 = subs.value
+        parity = router.matmul_words_batch(dev, G[k:], words)
+        assert reshard.labels().value == reshard0
+        assert subs.value == subs0 + 2
+        want0 = dev.gf.matvec_stripes(
+            G[k:], words[0].view(np.uint8).reshape(k, -1)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(parity)[0].view(np.uint8).reshape(r, -1), want0
+        )
+    finally:
+        reset_mesh_router()
+
+
+# ------------------------------------------ persistent compile cache
+
+
+def test_compile_cache_repeat_sweep_zero_recompile(rng, tmp_path):
+    """The compile-churn guard with the persistent cache armed: enable
+    -compile-cache-dir's backing hook, then a repeated panel geometry
+    sweep must add ZERO compile-route dispatches — and the cache dir
+    must hold serialized executables for the sweep's programs."""
+    from noise_ec_tpu.ops.dispatch import enable_compile_cache
+
+    assert enable_compile_cache(str(tmp_path))
+    try:
+        compiles = default_registry().counter("noise_ec_jit_compiles_total")
+
+        def total():
+            return sum(c.value for _, c in compiles.children())
+
+        # A geometry + shape no other test touches: the cache-write
+        # assertion needs this sweep's FIRST dispatch to really compile
+        # (a jit-warm program from an earlier test would write nothing).
+        dev = DeviceCodec(field="gf256", kernel="pallas_interpret")
+        G = generator_matrix(dev.gf, 119, 123, "cauchy")
+        D = rng.integers(0, 256, size=(119, 2777)).astype(np.uint8)
+
+        def sweep():
+            dev.matmul_stripes(G[119:], D)
+
+        sweep()
+        warm = total()
+        sweep()
+        sweep()
+        assert total() == warm, "repeat sweep re-compiled with cache on"
+        assert any(tmp_path.iterdir()), "persistent cache wrote no programs"
+    finally:
+        # Un-arm: later tests must not keep serializing into tmp_path.
+        jax.config.update("jax_compilation_cache_dir", None)
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # noqa: BLE001 — best-effort teardown
+            pass
+
+
+def test_compile_cache_hit_counter():
+    """The jax.monitoring bridge: cache-hit events land in
+    noise_ec_compile_cache_hits_total; unrelated events do not."""
+    from noise_ec_tpu.ops.dispatch import _note_cache_event
+
+    hits = default_registry().counter(
+        "noise_ec_compile_cache_hits_total"
+    ).labels()
+    before = hits.value
+    _note_cache_event("/jax/compilation_cache/cache_hits")
+    assert hits.value == before + 1
+    _note_cache_event("/jax/compilation_cache/cache_misses")
+    _note_cache_event("/jax/pjit/compile")
+    assert hits.value == before + 1
+
+
+def test_prewarm_ladder_compiles_batch_rungs(rng):
+    """The ladder pre-warm hook compiles every power-of-two batch rung
+    for a geometry (1, 2, 4, 8) without error and reports the count —
+    the set the persistent cache replays after a restart."""
+    from noise_ec_tpu.ops.dispatch import prewarm_ladder
+
+    dev = DeviceCodec(field="gf256", kernel="pallas_interpret")
+    G = generator_matrix(dev.gf, 10, 14, "cauchy")
+    assert prewarm_ladder(dev, G[10:], stripe_bytes=256, max_batch=8) == 4
+    # Warmed: an immediate batch dispatch at a ladder size re-jits
+    # nothing (the in-process cache holds every rung's program).
+    compiles = default_registry().counter("noise_ec_jit_compiles_total")
+    warm = sum(c.value for _, c in compiles.children())
+    Ds = [rng.integers(0, 256, size=(10, 256)).astype(np.uint8)
+          for _ in range(4)]
+    outs = dev.matmul_stripes_many(G[10:], Ds)
+    assert sum(c.value for _, c in compiles.children()) == warm
+    want = np.asarray(GoldenCodec(10, 14).encode(Ds[0]))
+    np.testing.assert_array_equal(outs[0], want)
+
+
+# ----------------------------------------------- bench_gate panel bars
+
+
+def _bench_gate():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        import bench_gate
+    finally:
+        sys.path.pop(0)
+    return bench_gate
+
+
+def test_panel_rig_check_bars(tmp_path):
+    """panel_rig_check (the ISSUE-15 guard): on a rig with a MULTICHIP
+    record, the PR-10 bars bite — rs200_56 route off panel, encode
+    under 150 GB/s, or a wide-field decode ratio over 1.25 each flag;
+    a green run and a recordless dev box do not."""
+    bg = _bench_gate()
+    assert bg.newest_multichip_devices() == 8  # this repo records a rig
+    good = {
+        "rs200_56_route": "panel",
+        "rs200_56_sublaunches": 3,
+        "rs200_56_encode_gbps": 163.0,
+        "gf65536_vs_gf256_decode_ratio": 1.1,
+    }
+    assert bg.panel_rig_check(good) == []
+    assert len(bg.panel_rig_check({
+        "rs200_56_route": "mxu",
+        "rs200_56_encode_gbps": 38.4,
+        "gf65536_vs_gf256_decode_ratio": 1.6,
+    })) == 3
+    problems = bg.panel_rig_check(dict(good, rs200_56_encode_gbps=120.0))
+    assert len(problems) == 1 and "150" in problems[0]
+    problems = bg.panel_rig_check(
+        dict(good, gf65536_vs_gf256_decode_ratio=1.3)
+    )
+    assert len(problems) == 1 and "1.25" in problems[0]
+    # Missing keys (recorded pre-panel rounds) stay green; a dev box
+    # without a MULTICHIP record is exempt entirely.
+    assert bg.panel_rig_check({}) == []
+    assert bg.panel_rig_check(
+        {"rs200_56_route": "mxu"}, repo=tmp_path
+    ) == []
+    # The new stats keys never enter the regression compare: routes and
+    # sub-launch counts are identity, not performance.
+    assert bg.metric_direction("rs200_56_sublaunches") is None
+    assert bg.metric_direction("rs200_56_route") is None
 
 
 # --------------------------------------- packed GF(2^16) fused decode

@@ -32,7 +32,14 @@ north-star bar) — but until this tool nothing *noticed* when
 - on fresh runs, holds the LRC tier to its fetch-amplification bar
   (:func:`lrc_repair_check` — the ISSUE-13 guard: a single-loss heal on
   LRC reads >= 5x fewer shards than equal-overhead RS, i.e.
-  ``repair_fetch_amplification`` <= 0.2).
+  ``repair_fetch_amplification`` <= 0.2);
+- on fresh runs from a rig with a MULTICHIP record, holds the panel
+  tier to the ROADMAP item-1 bars (:func:`panel_rig_check` — the
+  ISSUE-15 guard: ``rs200_56_encode_gbps`` >= 150 through the K-grid
+  sub-launch panel pipeline, ``gf65536_vs_gf256_decode_ratio`` <= 1.25,
+  and ``rs200_56_route`` must not regress off ``panel`` — a silent
+  probe demotion to the MXU is exactly the 38.4 GB/s cliff the split
+  path exists to close).
 
 Modes:
 
@@ -128,6 +135,16 @@ LRC_FETCH_AMPLIFICATION_MAX = 0.2
 # pure-Python Ed25519 fallback caps them far below the bar.)
 WIRE_RIG_MSGS_PER_S = 50_000.0
 WIRE_RIG_MBPS_FACTOR = 4.0
+
+# The ISSUE-15 panel-tier rig bars (panel_rig_check, fresh runs on rigs
+# with a MULTICHIP record): the unconfirmed PR-10 bars from ROADMAP
+# item 1, now owned by the K-grid sub-launch pipeline — RS(200,56) must
+# encode >= 150 GB/s through the panel route (it sat at 38.4 on the MXU
+# demotion at r05) and wide-field decode must stay within 1.25x of
+# GF(2^8) at equal volume. Dev boxes without a MULTICHIP record are
+# exempt (interpret-mode panel routing is deliberately narrower).
+PANEL_RIG_RS200_GBPS = 150.0
+PANEL_RIG_DECODE_RATIO_MAX = 1.25
 
 
 def metric_direction(name: str) -> str | None:
@@ -305,6 +322,50 @@ def lrc_repair_check(stats: dict) -> list[str]:
             "acceptance bar)"
         ]
     return []
+
+
+def panel_rig_check(stats: dict, repo: Path = REPO) -> list[str]:
+    """ISSUE-15 acceptance bars for the wide-geometry panel tier, on
+    rigs only (module docstring): applied to FRESH runs when the
+    recorded MULTICHIP rounds prove real hardware. Three bars —
+    ``rs200_56_route`` off ``panel`` (a probe demotion to the MXU, the
+    regression the sub-launch split exists to prevent),
+    ``rs200_56_encode_gbps`` below 150, and
+    ``gf65536_vs_gf256_decode_ratio`` above 1.25."""
+    if newest_multichip_devices(repo) <= 1:
+        return []
+    problems = []
+    route = stats.get("rs200_56_route")
+    if isinstance(route, str) and route != "panel":
+        problems.append(
+            f"rs200_56_route is {route!r}, not 'panel' — the wide "
+            "geometry demoted off the K-grid sub-launch panel pipeline "
+            "(docs/design.md §14); check the compile-probe escalation "
+            "logs"
+        )
+    gbps = stats.get("rs200_56_encode_gbps")
+    try:
+        gbps = float(gbps)
+    except (TypeError, ValueError):
+        gbps = None
+    if gbps is not None and gbps < PANEL_RIG_RS200_GBPS:
+        problems.append(
+            f"rs200_56_encode_gbps {gbps} below the panel-tier rig bar "
+            f"{PANEL_RIG_RS200_GBPS:.0f} (ROADMAP item 1)"
+        )
+    ratio = stats.get("gf65536_vs_gf256_decode_ratio")
+    try:
+        ratio = float(ratio)
+    except (TypeError, ValueError):
+        return problems
+    if ratio > PANEL_RIG_DECODE_RATIO_MAX:
+        problems.append(
+            f"gf65536_vs_gf256_decode_ratio {ratio} above the "
+            f"{PANEL_RIG_DECODE_RATIO_MAX} bar — wide-field decode is "
+            "not riding the packed byte-sliced panel pipeline "
+            "(ROADMAP item 1)"
+        )
+    return problems
 
 
 def north_star_check(stats: dict) -> list[str]:
@@ -557,6 +618,7 @@ def main(argv: list[str] | None = None) -> int:
         problems.extend(wire_rig_check(current))
         problems.extend(cache_hot_check(current))
         problems.extend(lrc_repair_check(current))
+        problems.extend(panel_rig_check(current))
     if args.json:
         print(json.dumps(
             {"against": against_name, "findings": findings,
