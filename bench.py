@@ -848,6 +848,112 @@ def main() -> None:
         stats["object_get_hit_rate"] = round(
             d_hits / max(1.0, d_hits + d_miss), 4
         )
+
+        # --- tenant isolation: per-tenant GET p99 attribution off the
+        # labeled noise_ec_object_op_seconds{tenant,op,route} histogram
+        # (docs/object-service.md "Tenant attribution"). Two phases on
+        # the cached service above: a solo quiet tenant establishes the
+        # baseline p99, then the same quiet workload repeats while an
+        # unthrottled "talker" tenant hammers its own objects from
+        # another thread (the quiet side paces itself, so the talker
+        # takes ~10x the request share — a first-cut noisy-neighbor
+        # mix). Both p99s come from bucket-delta interpolation over the
+        # tenant-labeled series — the bench reads the same series an
+        # operator would — and tenant_isolation_p99_ratio =
+        # contended / solo rides the gate with lower-better semantics.
+        import threading as _th
+
+        op_fam = _reg().histogram("noise_ec_object_op_seconds")
+
+        def _tenant_get_counts(tenant: str):
+            """Summed (bounds, counts incl. +Inf) across routes for
+            one tenant's GETs."""
+            agg = None
+            bounds = None
+            for values, child in op_fam.children():
+                lbl = dict(zip(op_fam.label_names, values))
+                if lbl.get("tenant") != tenant or lbl.get("op") != "get":
+                    continue
+                snap = child.snapshot()
+                bounds = snap["bounds"]
+                counts = list(snap["counts"])
+                agg = (
+                    counts if agg is None
+                    else [a + c for a, c in zip(agg, counts)]
+                )
+            return bounds, agg
+
+        def _delta_p99(bounds, before, after, q=0.99):
+            """q-quantile of the observations BETWEEN two snapshots,
+            linearly interpolated inside the containing bucket (+Inf
+            clamps to the top finite bound, like Histogram.percentile)."""
+            if after is None:
+                return 0.0
+            deltas = (
+                [b - a for a, b in zip(before, after)]
+                if before is not None else list(after)
+            )
+            total = sum(deltas)
+            if total <= 0:
+                return 0.0
+            target = q * total
+            cum = 0.0
+            for i, c in enumerate(deltas):
+                if c <= 0:
+                    continue
+                if cum + c >= target:
+                    lo = bounds[i - 1] if i > 0 else 0.0
+                    hi = bounds[i] if i < len(bounds) else bounds[-1]
+                    return lo + (hi - lo) * (target - cum) / c
+                cum += c
+            return bounds[-1]
+
+        t_each = 1 << 20
+        for i in range(6):
+            for who in ("quiet", "talker"):
+                payload_i = rng.integers(
+                    0, 256, size=t_each, dtype=np.uint8
+                ).tobytes()
+                hot_objects.put(who, f"{who}{i}", payload_i)
+        t_draws = rng.zipf(1.1, size=400)
+
+        def _quiet_pass() -> None:
+            # A paced quiet tenant: the 1 ms think time is what hands
+            # the unthrottled talker its ~10x request share in phase 2.
+            for z in t_draws[:200]:
+                hot_objects.read("quiet", f"quiet{(int(z) - 1) % 6}")
+                time.sleep(0.001)
+
+        _, before1 = _tenant_get_counts("quiet")
+        _quiet_pass()
+        bounds_q, after1 = _tenant_get_counts("quiet")
+        p99_solo = _delta_p99(bounds_q, before1, after1)
+
+        stop_talker = _th.Event()
+
+        def _talk() -> None:
+            j = 0
+            while not stop_talker.is_set():
+                hot_objects.read("talker", f"talker{j % 6}")
+                j += 1
+
+        talker = _th.Thread(target=_talk, daemon=True)
+        talker.start()
+        try:
+            _quiet_pass()
+        finally:
+            stop_talker.set()
+            talker.join(timeout=10)
+        bounds_q, after2 = _tenant_get_counts("quiet")
+        p99_mixed = _delta_p99(bounds_q, after1, after2)
+        check_smoke(
+            after2 is not None and sum(after2) - sum(after1) >= 200,
+            "tenant-labeled histogram missed quiet GETs",
+        )
+        stats["object_get_p99_ms"] = round(p99_mixed * 1e3, 3)
+        stats["tenant_isolation_p99_ratio"] = round(
+            p99_mixed / max(p99_solo, 1e-9), 3
+        )
     except SmokeMismatch:
         raise  # deterministic correctness failure: fail the run
     except Exception as exc:  # noqa: BLE001 — secondary stat only

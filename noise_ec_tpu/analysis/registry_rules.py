@@ -316,6 +316,28 @@ SUBSYSTEM_DOCS: dict[str, dict] = {
                    "SHARD_BATCH", "-recv-shards", "_FrameRing",
                    "broadcast_many"),
     },
+    "federation": {
+        "doc": "docs/observability.md",
+        "prefixes": ("noise_ec_federate_",),
+        "extras": (),
+        "tokens": ("/fleet/metrics", "-federate", "parse_prometheus",
+                   "MetricsFederator", "GAUGE_POLICIES"),
+    },
+    "incident": {
+        "doc": "docs/observability.md",
+        "prefixes": ("noise_ec_incident_",),
+        "extras": (),
+        "tokens": ("/incident", "-incident-dir", "FlightRecorder",
+                   "--incident", "min_bundle_interval"),
+    },
+    "tenant-attribution": {
+        "doc": "docs/object-service.md",
+        "prefixes": (),
+        "extras": ("noise_ec_object_op_seconds",
+                   "noise_ec_object_tenant_shed_total"),
+        "tokens": ("Tenant attribution", "object_get_p99_ms",
+                   "tenant_isolation_p99_ratio"),
+    },
     "lrc": {
         "doc": "docs/lrc.md",
         "prefixes": ("noise_ec_lrc_", "noise_ec_convert_"),

@@ -259,6 +259,7 @@ class FleetLab:
         self.shed_retry_after = shed_retry_after
         self.peers: list[FleetPeer] = []
         self.hub: Optional[FleetHub] = None
+        self.federator = None  # built by build_federator()/attach()
         self.scorer = FleetScorer()
         self.errors: deque = deque(maxlen=256)
         self.error_count = 0
@@ -426,6 +427,17 @@ class FleetLab:
         report["errors"] = self.error_count
         report["backpressure_waits"] = _backpressure_waits()
         report["gets"] = dict(self.get_results)
+        if self.federator is not None:
+            try:
+                self.federator.scrape()
+                fams = self.federator.merged_families()
+                report["fleet_metrics"] = {
+                    "targets": len(self.federator.sources),
+                    "series": sum(len(f["samples"]) for f in fams),
+                }
+            except Exception as exc:  # noqa: BLE001 — federation is
+                # telemetry; a merge failure must not sink the report
+                self._record_error(exc)
         self.last_report = report
         return report
 
@@ -549,6 +561,7 @@ class FleetLab:
         default_registry().counter(
             "noise_ec_fleet_messages_total"
         ).labels(kind="get").add(1)
+        t0 = time.monotonic()
         try:
             data = peer.objects.read(tenant, name)
         except ShedError as exc:
@@ -559,6 +572,9 @@ class FleetLab:
             # delivery scoring owns loss accounting, not the GET mix
             self.get_results["missing"] += 1
         else:
+            # Scorer-side wall time for the same read the tenant-labeled
+            # op histogram observed — the independent per-tenant p99.
+            self.scorer.tenant_get(tenant, time.monotonic() - t0)
             ok = hashlib.blake2b(data, digest_size=16).digest() == digest
             self.get_results["ok" if ok else "bad"] += 1
 
@@ -652,6 +668,7 @@ class FleetLab:
                 receiver = self.peers[ridx]
                 if receiver.objects is None:
                     continue
+                t0 = time.monotonic()
                 try:
                     # shed=False: post-run verification must measure
                     # REPLICATION, not a receiver's late-window load
@@ -661,6 +678,11 @@ class FleetLab:
                     )
                 except Exception:  # noqa: BLE001 — not delivered
                     continue
+                # Verification reads land in the tenant-labeled op
+                # histogram too; keep the scorer's sample set aligned.
+                self.scorer.tenant_get(
+                    obj["tenant"], time.monotonic() - t0
+                )
                 digest = hashlib.blake2b(data, digest_size=16).digest()
                 if digest == obj["digest"]:
                     # Latency is not meaningful for a post-run read;
@@ -688,10 +710,68 @@ class FleetLab:
             ),
         }
 
+    def build_federator(self):
+        """The lab's :class:`~noise_ec_tpu.obs.federate.MetricsFederator`
+        over one scrape source per peer (built once; requires
+        ``start()``).
+
+        Lab peers share the ONE process registry, so each source serves
+        the same exposition document and the merged fleet view
+        multiplies every count by the number of reachable peers —
+        histogram *quantiles* are scale-invariant under that
+        multiplication, so fleet p50/p99 read off ``/fleet/metrics``
+        exactly as they would from genuinely separate nodes (the lab
+        limitation is counts, not latencies; docs/fleet.md).
+
+        Chaos couples in: each source fails with the profile's ``drop``
+        probability from its own seeded stream (``clean`` scrapes never
+        fail; ``lossy`` failures are deterministic per seed and bounded
+        by the per-target breaker)."""
+        if self.federator is not None:
+            return self.federator
+        if not self._started:
+            self.start()
+        from noise_ec_tpu.obs.federate import MetricsFederator
+
+        drop = self.profile.chaos.drop
+        sources = {
+            f"fleet://{peer.idx}": self._scrape_source(peer, drop)
+            for peer in self.peers
+        }
+        self.federator = MetricsFederator(
+            sources=sources, registry=default_registry(),
+            reset_timeout=0.05,
+        )
+        return self.federator
+
+    def _scrape_source(self, peer: FleetPeer, drop: float):
+        from noise_ec_tpu.obs.export import render_prometheus
+
+        rng = np.random.default_rng(
+            np.random.SeedSequence(
+                [self.seed & 0xFFFFFFFF, 0xFEDE, peer.idx]
+            )
+        )
+        ref = weakref.ref(peer)
+
+        def source() -> str:
+            p = ref()
+            if p is None or not p.up:
+                raise RuntimeError(f"peer {peer.idx} is down")
+            if drop > 0 and float(rng.random()) < drop:
+                raise RuntimeError(
+                    f"scrape of peer {peer.idx} dropped (chaos)"
+                )
+            return render_prometheus(default_registry())
+
+        return source
+
     def attach(self, server) -> None:
-        """Mount ``GET /fleet`` on a StatsServer and fold the live fleet
+        """Mount ``GET /fleet`` (and the federator's ``GET
+        /fleet/metrics``) on a StatsServer and fold the live fleet
         block into its ``/healthz`` details."""
         server.mount("GET", "/fleet", self._route_fleet)
+        self.build_federator().attach(server)
         prev = server.health_details
         ref = weakref.ref(self)
 
@@ -742,6 +822,9 @@ class FleetLab:
 
     def close(self) -> None:
         self._stop.set()
+        if self.federator is not None:
+            self.federator.close()
+            self.federator = None
         if self._churn_thread is not None:
             self._churn_thread.join(timeout=5)
             self._churn_thread = None

@@ -51,6 +51,11 @@ class FleetScorer:
         self.shed_events: list[dict] = []
         # msg_id -> {tenant, name, digest} for post-run GET verification
         self.objects: dict[int, dict] = {}
+        # tenant -> wall-clock GET samples: the scorer's own timing of
+        # the reads the service-side noise_ec_object_op_seconds
+        # histogram also times — the independent check the federation
+        # acceptance compares fleet-merged bucket p99s against.
+        self.tenant_gets: dict[str, list[float]] = {}
         self.repairs = {"ok": 0, "failed": 0}
         reg = default_registry()
         self._m_msgs = reg.counter("noise_ec_fleet_messages_total")
@@ -116,6 +121,13 @@ class FleetScorer:
             })
         self._m_shed.labels(reason=reason).add(1)
 
+    def tenant_get(self, tenant: str, seconds: float) -> None:
+        """One timed GET through a peer's service layer (run-mix reads
+        and post-run verification reads both count — the same calls the
+        tenant-labeled histogram observes)."""
+        with self._lock:
+            self.tenant_gets.setdefault(tenant, []).append(seconds)
+
     def repair_result(self, ok: bool) -> None:
         with self._lock:
             self.repairs["ok" if ok else "failed"] += 1
@@ -143,6 +155,7 @@ class FleetScorer:
             sent = {m: dict(r) for m, r in self.sent.items()}
             shed_events = list(self.shed_events)
             objects = dict(self.objects)
+            tenant_gets = {t: list(v) for t, v in self.tenant_gets.items()}
             repairs = dict(self.repairs)
         expected = delivered = lost = churned = 0
         latencies: list[float] = []
@@ -207,6 +220,10 @@ class FleetScorer:
             "per_sender_p99_ms": {
                 s: _ms(_pct(lats, 0.99))
                 for s, lats in sorted(per_sender.items())
+            },
+            "tenant_get_p99_ms": {
+                t: _ms(_pct(samples, 0.99))
+                for t, samples in sorted(tenant_gets.items())
             },
         }
         return report

@@ -282,6 +282,32 @@ def build_parser() -> argparse.ArgumentParser:
         help="poll interval for -trace-peers span collection "
         "(default 10)",
     )
+    p.add_argument(
+        "-federate",
+        default="",
+        metavar="URLS",
+        help="comma-separated peer metrics endpoints "
+        "(http://host:port) whose /metrics this node scrapes and "
+        "merges; the fleet-wide view serves on GET /fleet/metrics "
+        "(requires -metrics-port; docs/observability.md)",
+    )
+    p.add_argument(
+        "-federate-interval",
+        type=float,
+        default=10.0,
+        metavar="SECONDS",
+        help="background scrape interval for -federate (default 10)",
+    )
+    p.add_argument(
+        "-incident-dir",
+        default="",
+        metavar="PATH",
+        help="run the flight recorder: keep a byte-bounded ring of "
+        "per-second metric deltas and write an incident bundle "
+        "(JSON timeline + Perfetto trace) to PATH when the /healthz "
+        "SLO flips to degraded, or on GET /incident "
+        "(docs/observability.md)",
+    )
     return p
 
 
@@ -426,6 +452,35 @@ def main(argv: list[str] | None = None) -> int:
                      stats_server.url, args.xprof_dir)
     if args.stats_interval > 0:
         reporter = PeriodicReporter(args.stats_interval, stats_snapshot, log)
+
+    federator = None
+    federate_peers = [u for u in args.federate.split(",") if u]
+    if federate_peers and stats_server is not None:
+        from noise_ec_tpu.obs.federate import MetricsFederator
+
+        federator = MetricsFederator(peers=federate_peers)
+        federator.attach(stats_server)
+        federator.start(interval=max(args.federate_interval, 1.0))
+        log.info(
+            "federating metrics from %d peer(s) on %s/fleet/metrics",
+            len(federate_peers), stats_server.url,
+        )
+
+    recorder = None
+    if args.incident_dir:
+        from noise_ec_tpu.obs.recorder import FlightRecorder
+
+        recorder = FlightRecorder(
+            slo=default_slo(), incident_dir=args.incident_dir
+        )
+        recorder.start()
+        if stats_server is not None:
+            recorder.attach(stats_server)
+        log.info(
+            "flight recorder armed: incident bundles -> %s on SLO "
+            "flip%s", args.incident_dir,
+            " or GET /incident" if stats_server is not None else "",
+        )
 
     object_server = converter = None
     if args.object_port >= 0:
@@ -610,6 +665,10 @@ def main(argv: list[str] | None = None) -> int:
                     )
             except Exception as exc:  # noqa: BLE001 — telemetry teardown
                 log.error("trace export failed: %s", exc)
+        if recorder is not None:
+            recorder.close()
+        if federator is not None:
+            federator.close()
         if object_server is not None:
             object_server.close()
         if stats_server is not None:

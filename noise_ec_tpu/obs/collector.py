@@ -106,14 +106,18 @@ class TraceCollector:
         self.timeout = timeout
         self._rtt_hints = rtt_hints
         self.max_spans_per_node = max_spans_per_node
-        # Per-peer poll state: since cursor, clock estimate, node id.
+        # Per-peer poll state: since cursor, clock estimate, node id,
+        # tracer epoch (incarnation — detects a peer restart).
         self._cursors: dict[str, int] = {}
+        self._epochs: dict[str, int] = {}
         self._clocks: dict[str, PeerClock] = {}
         self._nodes: dict[str, dict] = {}  # peer url -> node metadata
-        # node id -> {seq -> stamped span dict} (seq dedups re-sent
-        # spans: next_since is read before the dump on the server, so
-        # overlap is possible by design and dropped here).
-        self._spans: dict[str, dict[int, dict]] = {}
+        # node id -> {(epoch, seq) -> stamped span dict}. seq dedups
+        # re-sent spans (next_since is read before the dump on the
+        # server, so overlap is possible by design); the epoch half
+        # keeps a restarted peer's re-used seqs distinct from the old
+        # incarnation's instead of silently dropping them.
+        self._spans: dict[str, dict[tuple[int, int], dict]] = {}
         self._offsets: dict[str, float] = {}  # node id -> best wall offset
         self._local_cursor = 0
         self._lock = threading.Lock()
@@ -152,13 +156,30 @@ class TraceCollector:
             except Exception as exc:  # noqa: BLE001 — peer down ≠ fatal
                 log.debug("trace poll of %s failed: %s", peer, exc)
                 continue
-            new += self._ingest_doc(peer, doc, t0, t1, hints)
+            epoch = int(doc.get("epoch", 0))
+            known = self._epochs.get(peer)
+            if since and known is not None and epoch != known:
+                # The peer restarted: its seq counter reset, so our
+                # cursor would skip every span the new incarnation
+                # recorded before this poll (their seqs sit below it).
+                # Re-fetch the full ring of the new incarnation now —
+                # the (epoch, seq) dedup keeps the old incarnation's
+                # spans without collisions.
+                log.debug("peer %s restarted (epoch %s -> %s); "
+                          "restarting cursor", peer, known, epoch)
+                try:
+                    doc, t0, t1 = self._fetch(f"{peer}/spans?since=0")
+                except Exception as exc:  # noqa: BLE001 — same contract
+                    log.debug("trace re-poll of %s failed: %s", peer, exc)
+                    continue
+                epoch = int(doc.get("epoch", 0))
+            new += self._ingest_doc(peer, doc, t0, t1, hints, epoch)
         new += self._ingest_local()
         return new
 
     def _ingest_doc(
         self, peer: str, doc: dict, t0: float, t1: float,
-        hints: dict[str, float],
+        hints: dict[str, float], epoch: int = 0,
     ) -> int:
         node_meta = doc.get("node") or {}
         node_id = node_meta.get("id") or peer
@@ -176,8 +197,11 @@ class TraceCollector:
                 self._clocks[peer] = sample
                 self._offsets[node_id] = sample.offset
             self._nodes[peer] = node_meta
+            self._epochs[peer] = epoch
             self._cursors[peer] = int(doc.get("next_since", 0))
-            return self._store_locked(node_id, doc.get("spans", ()))
+            return self._store_locked(
+                node_id, doc.get("spans", ()), epoch
+            )
 
     def _ingest_local(self) -> int:
         spans = self.tracer.dump(since=self._local_cursor)
@@ -185,18 +209,20 @@ class TraceCollector:
         with self._lock:
             if spans:
                 self._local_cursor = max(s["seq"] for s in spans)
-            return self._store_locked(node_id, spans)
+            return self._store_locked(
+                node_id, spans, getattr(self.tracer, "epoch", 0)
+            )
 
-    def _store_locked(self, node_id: str, spans) -> int:
+    def _store_locked(self, node_id: str, spans, epoch: int = 0) -> int:
         bucket = self._spans.setdefault(node_id, {})
         new = 0
         for s in spans:
-            seq = int(s.get("seq", 0))
-            if seq in bucket:
+            key = (epoch, int(s.get("seq", 0)))
+            if key in bucket:
                 continue  # overlap re-send (see server next_since note)
             d = dict(s)
             d["node"] = node_id
-            bucket[seq] = d
+            bucket[key] = d
             new += 1
         # Bound memory per node: oldest spans age out like a ring.
         while len(bucket) > self.max_spans_per_node:

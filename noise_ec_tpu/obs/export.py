@@ -20,7 +20,14 @@ from typing import Optional
 from noise_ec_tpu.obs.metrics import Counters
 from noise_ec_tpu.obs.registry import Registry, default_registry
 
-__all__ = ["escape_label_value", "render_counters", "render_prometheus"]
+__all__ = [
+    "escape_label_value",
+    "parse_prometheus",
+    "render_counters",
+    "render_parsed",
+    "render_prometheus",
+    "unescape_label_value",
+]
 
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _NAME_FIX = re.compile(r"[^a-zA-Z0-9_:]")
@@ -124,4 +131,187 @@ def render_prometheus(
         _render_family(fam, out)
     for prefix, counters in (extra_counters or {}).items():
         out.extend(render_counters(prefix, counters))
+    return "\n".join(out) + "\n"
+
+
+# --------------------------------------------------------------- parsing
+#
+# The inverse of the renderer above, shared by metrics federation
+# (obs/federate.py) and the round-trip tests: parse_prometheus keeps
+# sample values as the RAW strings the peer rendered, so
+# parse -> render_parsed reproduces the input byte for byte — the
+# property that pins escaping, +Inf buckets and integer formatting to
+# one codec instead of two drifting halves.
+
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def unescape_label_value(value: str) -> str:
+    """Inverse of :func:`escape_label_value`. Strict: an escape sequence
+    other than ``\\\\``, ``\\"`` or ``\\n`` raises ``ValueError`` —
+    a malformed peer document must fail the scrape, not corrupt the
+    merged view."""
+    if "\\" not in value:
+        return value
+    out: list[str] = []
+    i, n = 0, len(value)
+    while i < n:
+        ch = value[i]
+        if ch != "\\":
+            out.append(ch)
+            i += 1
+            continue
+        if i + 1 >= n:
+            raise ValueError(f"dangling backslash in label value {value!r}")
+        nxt = value[i + 1]
+        if nxt == "\\":
+            out.append("\\")
+        elif nxt == '"':
+            out.append('"')
+        elif nxt == "n":
+            out.append("\n")
+        else:
+            raise ValueError(
+                f"unknown escape \\{nxt} in label value {value!r}"
+            )
+        i += 2
+    return "".join(out)
+
+
+def _parse_labels(line: str, pos: int) -> tuple[tuple[tuple[str, str], ...], int]:
+    """Scan ``{name="value",...}`` starting at ``line[pos] == '{'``;
+    returns the (name, unescaped value) pairs in document order plus the
+    index just past the closing brace."""
+    assert line[pos] == "{"
+    pos += 1
+    pairs: list[tuple[str, str]] = []
+    while True:
+        if pos < len(line) and line[pos] == "}":
+            return tuple(pairs), pos + 1
+        eq = line.find("=", pos)
+        if eq < 0 or eq + 1 >= len(line) or line[eq + 1] != '"':
+            raise ValueError(f"malformed labels in sample line {line!r}")
+        name = line[pos:eq]
+        if not _NAME_OK.match(name):
+            raise ValueError(f"bad label name {name!r} in {line!r}")
+        # Scan the quoted value honouring backslash escapes.
+        i = eq + 2
+        raw: list[str] = []
+        while True:
+            if i >= len(line):
+                raise ValueError(f"unterminated label value in {line!r}")
+            ch = line[i]
+            if ch == "\\":
+                if i + 1 >= len(line):
+                    raise ValueError(f"dangling backslash in {line!r}")
+                raw.append(line[i:i + 2])
+                i += 2
+                continue
+            if ch == '"':
+                break
+            raw.append(ch)
+            i += 1
+        pairs.append((name, unescape_label_value("".join(raw))))
+        pos = i + 1
+        if pos < len(line) and line[pos] == ",":
+            pos += 1
+        elif pos < len(line) and line[pos] == "}":
+            return tuple(pairs), pos + 1
+        else:
+            raise ValueError(f"malformed labels in sample line {line!r}")
+
+
+def _parse_sample(line: str) -> tuple[str, tuple[tuple[str, str], ...], str]:
+    """One sample line -> (sample name, label pairs, raw value text).
+
+    The value is kept verbatim (including any trailing timestamp) so a
+    re-render is byte-identical."""
+    brace = line.find("{")
+    space = line.find(" ")
+    if brace >= 0 and (space < 0 or brace < space):
+        name = line[:brace]
+        labels, pos = _parse_labels(line, brace)
+        if pos >= len(line) or line[pos] != " ":
+            raise ValueError(f"missing value in sample line {line!r}")
+        value = line[pos + 1:]
+    else:
+        if space < 0:
+            raise ValueError(f"missing value in sample line {line!r}")
+        name = line[:space]
+        labels = ()
+        value = line[space + 1:]
+    if not _NAME_OK.match(name):
+        raise ValueError(f"bad metric name {name!r} in {line!r}")
+    if not value:
+        raise ValueError(f"empty value in sample line {line!r}")
+    return name, labels, value
+
+
+def parse_prometheus(text: str) -> list[dict]:
+    """Parse one exposition document into family dicts, in document
+    order: ``{"name", "type" (str|None), "help" (str|None), "samples":
+    [(sample_name, ((label, value), ...), raw_value_str), ...]}``.
+
+    Histogram child samples (``_bucket``/``_sum``/``_count``) attach to
+    their base family; a sample with no preceding HELP/TYPE gets an
+    untyped family of its own (render_parsed then emits no comment
+    lines for it). Malformed lines raise ``ValueError``.
+    """
+    families: list[dict] = []
+    by_name: dict[str, dict] = {}
+    cur: Optional[dict] = None
+
+    def _new(name: str, mtype: Optional[str], help_text: Optional[str]) -> dict:
+        fam = {"name": name, "type": mtype, "help": help_text, "samples": []}
+        families.append(fam)
+        by_name[name] = fam
+        return fam
+
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            name, _, help_text = line[len("# HELP "):].partition(" ")
+            cur = _new(name, None, help_text)
+            continue
+        if line.startswith("# TYPE "):
+            name, _, mtype = line[len("# TYPE "):].partition(" ")
+            mtype = mtype.strip()
+            if cur is not None and cur["name"] == name and cur["type"] is None:
+                cur["type"] = mtype
+            else:
+                cur = _new(name, mtype, None)
+            continue
+        if line.startswith("#"):
+            continue  # free comment — legal, carries nothing
+        name, labels, value = _parse_sample(line)
+        fam = None
+        for suffix in _HIST_SUFFIXES:
+            if name.endswith(suffix):
+                base = by_name.get(name[:-len(suffix)])
+                if base is not None and base["type"] == "histogram":
+                    fam = base
+                    break
+        if fam is None:
+            fam = by_name.get(name)
+        if fam is None:
+            fam = _new(name, None, None)
+        fam["samples"].append((name, labels, value))
+    return families
+
+
+def render_parsed(families: list[dict]) -> str:
+    """Render :func:`parse_prometheus` output back to exposition text —
+    the byte-exact inverse on documents this module produced."""
+    out: list[str] = []
+    for fam in families:
+        if fam.get("help") is not None:
+            out.append(f"# HELP {fam['name']} {fam['help']}")
+        if fam.get("type") is not None:
+            out.append(f"# TYPE {fam['name']} {fam['type']}")
+        for name, labels, value in fam["samples"]:
+            lbl = _labels_str(
+                tuple(k for k, _ in labels), tuple(v for _, v in labels)
+            )
+            out.append(f"{name}{lbl} {value}")
     return "\n".join(out) + "\n"

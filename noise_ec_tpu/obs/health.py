@@ -70,6 +70,18 @@ class SLOEvaluator:
         self.min_events = min_events
         self._events: deque = deque(maxlen=max_events)  # (t, ok, seconds)
         self._lock = threading.Lock()
+        # Verdict-flip listeners (the flight recorder's capture trigger):
+        # fired on the healthy -> degraded transition as observed by
+        # verdict() calls, outside the lock.
+        self._flip_listeners: list = []
+        self._last_healthy = True
+
+    def add_flip_listener(self, fn) -> None:
+        """Register ``fn(verdict_dict)`` to fire when :meth:`verdict`
+        observes the healthy -> degraded transition (not on every
+        degraded verdict, and not on recovery). Listener errors are
+        swallowed — telemetry must not break the health probe."""
+        self._flip_listeners.append(fn)
 
     def record(self, outcome: str, seconds: float,
                now: Optional[float] = None) -> None:
@@ -105,7 +117,7 @@ class SLOEvaluator:
             },
         }
         if n < self.min_events:
-            return out  # insufficient data reads healthy
+            return self._observe(out)  # insufficient data reads healthy
         ok_lat = sorted(s for _, ok, s in events if ok)
         rate = len(ok_lat) / n
         out["success_rate"] = round(rate, 6)
@@ -131,11 +143,30 @@ class SLOEvaluator:
                 f"{self.p99_target_seconds:g}s over the last "
                 f"{self.window_seconds:g}s ({n} events)"
             )
+        return self._observe(out)
+
+    def _observe(self, out: dict) -> dict:
+        """Track the healthy/degraded edge and fire flip listeners on
+        healthy -> degraded; the transition is claimed under the lock so
+        concurrent verdict() callers (healthz + recorder tick) fire the
+        listeners exactly once per flip."""
+        healthy = bool(out["healthy"])
+        with self._lock:
+            fire = self._last_healthy and not healthy
+            self._last_healthy = healthy
+        if fire:
+            for fn in list(self._flip_listeners):
+                try:
+                    fn(out)
+                except Exception:  # noqa: BLE001 — listener bugs must
+                    # not break the health probe
+                    pass
         return out
 
     def reset(self) -> None:
         with self._lock:
             self._events.clear()
+            self._last_healthy = True
 
 
 _default_slo = SLOEvaluator()

@@ -552,10 +552,10 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
     "noise_ec_object_read_route_total": (
         "counter",
         "Underlying stripe fetches on the GET path by serving tier "
-        "(cache = local decoded cache, peer = a warm peer's /objects "
-        "endpoint, decode = local shards — join or degraded "
-        "reconstruct); coalesced followers of one in-flight fetch do "
-        "not double-count",
+        "(cache = local decoded cache, local = trusted k-join from "
+        "local shards, peer = a warm peer's /objects endpoint, decode "
+        "= degraded reconstruct / anti-entropy); coalesced followers "
+        "of one in-flight fetch do not double-count",
         ("route",),
     ),
     "noise_ec_object_put_seconds": (
@@ -567,6 +567,21 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "histogram",
         "End-to-end GET/range latency through stripe reads and decode",
         (),
+    ),
+    "noise_ec_object_op_seconds": (
+        "histogram",
+        "Per-tenant object op latency, labeled by tenant (capped at "
+        "an 'other' bucket past the cardinality limit), op (put, get) "
+        "and route — for GET the most expensive serving tier touched "
+        "(cache < local < peer < decode), for PUT always encode; the "
+        "series the tenant_isolation_p99_ratio gate reads",
+        ("tenant", "op", "route"),
+    ),
+    "noise_ec_object_tenant_shed_total": (
+        "counter",
+        "Object ops shed by load control attributed to the requesting "
+        "tenant, labeled by tenant and reason (slo, hbm)",
+        ("tenant", "reason"),
     ),
     # --- host<->device data path (ops/coalesce.py, ops/dispatch.py
     # buffer pool; docs/design.md "host<->device data path" owns the
@@ -690,6 +705,53 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "Churn schedule transitions applied to fleet peers, labeled by "
         "event (kill, restart)",
         ("event",),
+    ),
+    # --- metrics federation (obs/federate.py, docs/observability.md
+    # "Metrics federation")
+    "noise_ec_federate_scrapes_total": (
+        "counter",
+        "Peer /metrics scrape attempts by the federator, labeled by "
+        "result (ok, error, skipped = per-peer breaker open)",
+        ("result",),
+    ),
+    "noise_ec_federate_scrape_errors_total": (
+        "counter",
+        "Failed peer /metrics scrapes, labeled by peer (capped at an "
+        "'other' bucket past the cardinality limit)",
+        ("peer",),
+    ),
+    "noise_ec_federate_peers": (
+        "gauge",
+        "Federation scrape targets by state (up = last scrape ok, "
+        "down = last scrape failed or breaker open), read at collect "
+        "time",
+        ("state",),
+    ),
+    "noise_ec_federate_series": (
+        "gauge",
+        "Samples in the last merged fleet exposition document",
+        (),
+    ),
+    "noise_ec_federate_scrape_seconds": (
+        "histogram",
+        "Wall time of one full federation scrape+merge cycle across "
+        "all targets",
+        (),
+    ),
+    # --- flight recorder (obs/recorder.py, docs/observability.md
+    # "Flight recorder")
+    "noise_ec_incident_bundles_total": (
+        "counter",
+        "Incident bundles written by the flight recorder, labeled by "
+        "trigger (flip = SLO verdict healthy->degraded, request = GET "
+        "/incident); rate-limit-suppressed captures are not counted",
+        ("trigger",),
+    ),
+    "noise_ec_incident_ring_bytes": (
+        "gauge",
+        "Serialized bytes currently held in the flight recorder ring "
+        "(bounded by its byte cap), read at collect time",
+        (),
     ),
     # --- wire hot loop (host/transport.py, docs/design.md §15)
     "noise_ec_wire_verify_batch_size": (

@@ -59,7 +59,9 @@ __all__ = ["PeriodicReporter", "SPANS_DOC_FIELDS", "StatsServer"]
 
 # Top-level keys of the /spans dump document; tools/check_metrics.py
 # lints that docs/observability.md documents each one.
-SPANS_DOC_FIELDS: tuple[str, ...] = ("node", "clock", "next_since", "spans")
+SPANS_DOC_FIELDS: tuple[str, ...] = (
+    "node", "clock", "epoch", "next_since", "spans",
+)
 
 log = logging.getLogger("noise_ec_tpu.obs")
 
@@ -275,6 +277,10 @@ class StatsServer:
         doc = {
             "node": self.tracer.node or {},
             "clock": clock_anchor(),
+            # Tracer incarnation: lets the collector detect a peer
+            # restart (seq counter reset) and restart its cursor
+            # instead of silently dropping the new incarnation's spans.
+            "epoch": self.tracer.epoch,
             "next_since": self.tracer.last_seq(),
             "spans": self.tracer.dump(
                 trace_id=trace, limit=limit, since=since

@@ -191,6 +191,11 @@ class Tracer:
                  registry: Optional[Registry] = None):
         self.enabled = True
         self.capacity = capacity
+        # Incarnation id: a fresh tracer (process restart) starts its
+        # seq counter over at 0, so ``/spans`` publishes this epoch and
+        # the collector keys its dedup/cursor state on (epoch, seq) —
+        # a restarted peer's re-used seqs are new spans, not duplicates.
+        self.epoch = time.time_ns()
         self._ring: deque = deque(maxlen=capacity)  # Span or ingested dict
         self._lock = threading.Lock()
         self._local = threading.local()

@@ -138,11 +138,21 @@ class ShedError(RuntimeError):
         self.retry_after = retry_after
 
 
+# GET serving tiers by cost (docs/object-service.md): a request's route
+# label is the most expensive tier any of its stripes touched.
+_ROUTE_RANK = {"cache": 0, "local": 1, "peer": 2, "decode": 3}
+
+
 class _ObjectMetrics:
     """Cached registry children for the noise_ec_object_* family."""
 
     _registered = False
     _instances: "weakref.WeakSet[ObjectStore]" = weakref.WeakSet()
+
+    # Distinct tenant label values recorded before collapsing to
+    # "other": a tenant sweep must not explode registry cardinality
+    # (mirrors the transport's per-peer bound).
+    TENANT_LABEL_CAP = 64
 
     def __init__(self):
         reg = default_registry()
@@ -166,8 +176,14 @@ class _ObjectMetrics:
             route: reg.counter(
                 "noise_ec_object_read_route_total"
             ).labels(route=route)
-            for route in ("cache", "peer", "decode")
+            for route in ("cache", "local", "peer", "decode")
         }
+        self._op_seconds = reg.histogram("noise_ec_object_op_seconds")
+        self._tenant_sheds = reg.counter(
+            "noise_ec_object_tenant_shed_total"
+        )
+        self._op_children: dict[tuple[str, str, str], object] = {}
+        self._tenant_labels: set[str] = set()
         cls = _ObjectMetrics
         if not cls._registered:
             cls._registered = True
@@ -193,11 +209,38 @@ class _ObjectMetrics:
     def reject(self, reason: str) -> None:
         self._rejects.labels(reason=reason).add(1)
 
-    def shed(self, reason: str) -> None:
+    def shed(self, reason: str, tenant: Optional[str] = None) -> None:
         self._sheds.labels(reason=reason).add(1)
+        if tenant is not None:
+            self._tenant_sheds.labels(
+                tenant=self._tenant_label(tenant), reason=reason
+            ).add(1)
 
     def tenant_bytes(self, tenant: str, value: int) -> None:
         self._tenant_bytes.labels(tenant=tenant).set(value)
+
+    def _tenant_label(self, tenant: str) -> str:
+        """The tenant label value, collapsed to "other" past the
+        cardinality cap (first-come keeps its own series)."""
+        if tenant in self._tenant_labels:
+            return tenant
+        if len(self._tenant_labels) >= self.TENANT_LABEL_CAP:
+            return "other"
+        self._tenant_labels.add(tenant)
+        return tenant
+
+    def op_seconds(self, tenant: str, op: str, route: str,
+                   seconds: float) -> None:
+        """Observe one op into the per-tenant attribution histogram
+        (children cached — this lands once per request, not per
+        stripe)."""
+        key = (self._tenant_label(tenant), op, route)
+        child = self._op_children.get(key)
+        if child is None:
+            child = self._op_children[key] = self._op_seconds.labels(
+                tenant=key[0], op=op, route=route
+            )
+        child.observe(seconds)
 
 
 class ObjectStore:
@@ -391,7 +434,7 @@ class ObjectStore:
             raise
         reason = self.shed_reason()
         if reason is not None:
-            self._metrics.shed(reason)
+            self._metrics.shed(reason, tenant.name)
             raise ShedError(reason, self.retry_after_seconds)
 
         k = tenant.k or self.default_k
@@ -483,7 +526,9 @@ class ObjectStore:
                 pinned.append(manifest_stripe)
             self.engine.pin_announce(pinned)
         self._metrics.put(tenant.name, size)
-        self._metrics.put_seconds.observe(time.monotonic() - t0)
+        elapsed = time.monotonic() - t0
+        self._metrics.put_seconds.observe(elapsed)
+        self._metrics.op_seconds(tenant.name, "put", "encode", elapsed)
         return self.store.get_manifest(doc["address"]) or doc
 
     def _manifest_stripe_locked(self, address: str) -> Optional[str]:
@@ -624,14 +669,16 @@ class ObjectStore:
         if shed and not self._fully_cached(address, i0, i1):
             reason = self.shed_reason()
             if reason is not None:
-                self._metrics.shed(reason)
+                self._metrics.shed(reason, tenant)
                 raise ShedError(reason, self.retry_after_seconds)
         # Per-request read state: served/cached stripe counts for the
-        # result label, shared/degraded flags, and the lazily taken
-        # one-lock store snapshot of the request's stripe set.
+        # result label, shared/degraded flags, the most expensive
+        # serving tier touched (the per-tenant attribution route label),
+        # and the lazily taken one-lock store snapshot of the request's
+        # stripe set.
         state: dict = {
             "served": 0, "cached": 0, "degraded": False, "shared": False,
-            "snaps": None,
+            "route": "cache", "snaps": None,
         }
 
         def chunks() -> Iterator[bytes]:
@@ -674,7 +721,11 @@ class ObjectStore:
                     self._live_reads -= 1
                 self._metrics.get(result)
                 self._metrics.get_bytes.add(sent)
-                self._metrics.get_seconds.observe(time.monotonic() - t0)
+                elapsed = time.monotonic() - t0
+                self._metrics.get_seconds.observe(elapsed)
+                self._metrics.op_seconds(
+                    tenant, "get", state["route"], elapsed
+                )
 
         return doc, total, chunks()
 
@@ -731,6 +782,8 @@ class ObjectStore:
         )
         if route == "cache":
             state["cached"] += 1
+        if _ROUTE_RANK.get(route, 3) > _ROUTE_RANK[state["route"]]:
+            state["route"] = route
         if shared:
             state["shared"] = True
         if degraded:
@@ -740,11 +793,12 @@ class ObjectStore:
     def _fetch_stripe(
         self, doc: dict, i: int, i1: int, state: dict, peer_route: bool
     ) -> tuple[bytes, str, bool]:
-        """The single-flight leader's miss path: local join when every
-        data slot is trusted (a memcpy — the cheapest surviving copy
-        after RAM), then a warm peer, then the degraded decode /
-        anti-entropy tier. Returns ``(logical bytes, route, degraded)``
-        and write-through-populates the cache on every success."""
+        """The single-flight leader's miss path: local join ("local"
+        route) when every data slot is trusted (a memcpy — the cheapest
+        surviving copy after RAM), then a warm peer, then the degraded
+        decode / anti-entropy tier. Returns ``(logical bytes, route,
+        degraded)`` and write-through-populates the cache on every
+        success."""
         address = doc["address"]
         key = doc["stripes"][i]
         size = int(doc["size"])
@@ -766,8 +820,8 @@ class ObjectStore:
                     shards[: meta.k]
                 )[: meta.object_len][:logical]
                 self._cache_store(address, i, blob, key)
-                self._metrics.routes["decode"].add(1)
-                return blob, "decode", False
+                self._metrics.routes["local"].add(1)
+                return blob, "local", False
         if peer_route:
             blob = self._peer_fetch(doc, i, logical)
             if blob is not None:

@@ -1,0 +1,275 @@
+"""Metrics federation: exposition parser round-trips, cross-node merge
+semantics, breaker-bounded scraping and the /fleet/metrics route
+(obs/federate.py, docs/observability.md "Metrics federation")."""
+
+from __future__ import annotations
+
+import json
+import random
+import urllib.request
+
+import pytest
+
+from noise_ec_tpu.obs.export import (
+    escape_label_value,
+    parse_prometheus,
+    render_parsed,
+    render_prometheus,
+    unescape_label_value,
+)
+from noise_ec_tpu.obs.federate import (
+    GAUGE_POLICIES,
+    MetricsFederator,
+    merge_documents,
+)
+from noise_ec_tpu.obs.registry import Registry
+from noise_ec_tpu.obs.server import StatsServer
+
+
+def _get(url: str) -> tuple[int, bytes]:
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.read()
+
+
+# -- parser -----------------------------------------------------------------
+
+
+def test_unescape_inverts_escape():
+    for raw in (
+        "plain", 'a"b', "a\\b", "a\nb", '\\"', "\\n", "\\\\",
+        'tcp://"evil"\n\\host:1', "trailing\\\\",
+    ):
+        assert unescape_label_value(escape_label_value(raw)) == raw
+
+
+def test_unescape_rejects_unknown_escape():
+    with pytest.raises(ValueError):
+        unescape_label_value("a\\tb")
+    with pytest.raises(ValueError):
+        unescape_label_value("dangling\\")
+
+
+def test_parse_prometheus_family_shapes():
+    reg = Registry()
+    reg.counter("noise_ec_transport_shards_in_total").labels(
+        peer='tcp://"evil"\n\\host:1'
+    ).add(3)
+    hist = reg.histogram("noise_ec_decode_seconds").labels()
+    hist.observe(0.001)
+    hist.observe(2.5)
+    reg.gauge("noise_ec_dispatch_queue_depth").set_callback(lambda: 7)
+    fams = parse_prometheus(render_prometheus(reg))
+    by_name = {f["name"]: f for f in fams}
+    ctr = by_name["noise_ec_transport_shards_in_total"]
+    assert ctr["type"] == "counter"
+    # The escaped peer address comes back as the raw string.
+    (sname, labels, raw), = ctr["samples"]
+    assert sname == "noise_ec_transport_shards_in_total"
+    assert dict(labels)["peer"] == 'tcp://"evil"\n\\host:1'
+    assert raw == "3"
+    h = by_name["noise_ec_decode_seconds"]
+    assert h["type"] == "histogram"
+    names = [s[0] for s in h["samples"]]
+    # _bucket/_sum/_count samples attach to the base family.
+    assert f"{h['name']}_bucket" in names
+    assert names[-2:] == [f"{h['name']}_sum", f"{h['name']}_count"]
+    inf = [s for s in h["samples"] if dict(s[1]).get("le") == "+Inf"]
+    assert len(inf) == 1 and inf[0][2] == "2"
+    assert by_name["noise_ec_dispatch_queue_depth"]["type"] == "gauge"
+
+
+def test_parse_prometheus_counter_bag_and_orphans():
+    from noise_ec_tpu.obs.metrics import Counters
+
+    bag = Counters()
+    bag.add("shards_in", 4)
+    text = render_prometheus(Registry(), {"noise_ec_plugin": bag})
+    fams = parse_prometheus(text)
+    fam = {f["name"]: f for f in fams}["noise_ec_plugin_shards_in"]
+    # TYPE-only counter-bag families carry no HELP and round-trip so.
+    assert fam["type"] == "counter" and fam["help"] is None
+    assert render_parsed(fams) == text
+    # An orphan sample (no HELP/TYPE at all) still parses, untyped.
+    orphan = parse_prometheus("stray_series 12\n")
+    assert orphan[0]["type"] is None
+    assert orphan[0]["samples"] == [("stray_series", (), "12")]
+
+
+def _random_exposition(seed: int) -> str:
+    """A seeded random-but-valid exposition through the real renderer:
+    hostile label values, multi-child families, histograms with mass in
+    and past the finite buckets."""
+    rng = random.Random(seed)
+    reg = Registry()
+    specials = ["plain", 'a"b', "a\\b", "a\nb", 'tcp://"x"\n\\h:1', ""]
+    ctr = reg.counter("noise_ec_transport_shards_in_total")
+    for _ in range(rng.randint(1, 5)):
+        ctr.labels(peer=rng.choice(specials) + str(rng.randint(0, 9))).add(
+            rng.randint(1, 10**6)
+        )
+    hist = reg.histogram("noise_ec_decode_seconds").labels()
+    for _ in range(rng.randint(1, 50)):
+        hist.observe(rng.random() * rng.choice([1e-6, 1e-3, 1.0, 1e6]))
+    g = reg.gauge("noise_ec_peer_circuit_state")
+    for _ in range(rng.randint(1, 4)):
+        g.labels(peer=rng.choice(specials)).set(rng.randint(0, 2))
+    return render_prometheus(reg)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_parse_render_round_trip_byte_identical(seed):
+    """render_parsed(parse_prometheus(doc)) == doc, byte for byte, on
+    seeded random documents — the parser is the exact inverse of the
+    exposition renderer (no hypothesis in the image; seeds stand in)."""
+    text = _random_exposition(seed)
+    assert render_parsed(parse_prometheus(text)) == text
+    # Idempotent under a second trip too.
+    again = render_parsed(parse_prometheus(text))
+    assert render_parsed(parse_prometheus(again)) == text
+
+
+# -- merge semantics --------------------------------------------------------
+
+
+def _node_doc(shards: int, circuit: int, obs: tuple[float, ...]) -> str:
+    reg = Registry()
+    reg.counter("noise_ec_transport_shards_in_total").labels(
+        peer="tcp://a:1"
+    ).add(shards)
+    reg.gauge("noise_ec_peer_circuit_state").labels(peer="tcp://a:1").set(
+        circuit
+    )
+    reg.gauge("noise_ec_dispatch_queue_depth").set_callback(lambda: 5)
+    hist = reg.histogram("noise_ec_decode_seconds").labels()
+    for v in obs:
+        hist.observe(v)
+    return render_prometheus(reg)
+
+
+def test_merge_counters_sum_and_gauge_policies():
+    assert GAUGE_POLICIES["noise_ec_peer_circuit_state"] == "max"
+    docs = {
+        "n0": _node_doc(3, 0, (0.001,)),
+        "n1": _node_doc(5, 2, (0.001,)),
+    }
+    fams = {f["name"]: f for f in merge_documents(docs)}
+    ctr = fams["noise_ec_transport_shards_in_total"]["samples"][0]
+    assert ctr[2] == "8"  # 3 + 5
+    assert dict(ctr[1])["node"] == "fleet"
+    # Worst-state policy: the fleet breaker state is the sickest node.
+    state = fams["noise_ec_peer_circuit_state"]["samples"][0]
+    assert state[2] == "2"
+    # Default gauge policy sums (fleet capacity view).
+    depth = fams["noise_ec_dispatch_queue_depth"]["samples"][0]
+    assert depth[2] == "10"
+
+
+def test_merge_histograms_bucket_wise():
+    docs = {
+        "n0": _node_doc(1, 0, (0.001, 0.001, 1e9)),
+        "n1": _node_doc(1, 0, (0.001,)),
+    }
+    fams = {f["name"]: f for f in merge_documents(docs)}
+    h = fams["noise_ec_decode_seconds"]
+    buckets = [
+        (dict(labels)["le"], raw)
+        for sname, labels, raw in h["samples"]
+        if sname.endswith("_bucket")
+    ]
+    # Cumulative counts add bucket-wise; +Inf last equals fleet count.
+    assert buckets[-1] == ("+Inf", "4")
+    les = [le for le, _ in buckets]
+    assert les.index("+Inf") == len(les) - 1
+    count = [s for s in h["samples"] if s[0].endswith("_count")][0]
+    assert count[2] == "4"
+    # le stays the LAST label on bucket lines after the node label.
+    text = render_parsed([h])
+    for line in text.splitlines():
+        if "_bucket{" in line:
+            assert line.rpartition("le=")[2].startswith('"')
+            assert 'node="fleet"' in line
+    # The merged document is itself a valid, round-trippable exposition.
+    assert render_parsed(parse_prometheus(text)) == text
+
+
+# -- federator scraping -----------------------------------------------------
+
+
+def test_federator_breaker_bounds_failures_and_serves_stale():
+    reg = Registry()
+    calls = {"good": 0, "bad": 0}
+    state = {"fail": False}
+
+    def good() -> str:
+        calls["good"] += 1
+        if state["fail"]:
+            raise OSError("scrape refused")
+        return _node_doc(2, 0, (0.001,))
+
+    def bad() -> str:
+        calls["bad"] += 1
+        raise OSError("always down")
+
+    fed = MetricsFederator(
+        sources={"fleet://good": good, "fleet://bad": bad},
+        registry=reg, failure_threshold=2, reset_timeout=60.0,
+    )
+    assert fed.scrape() == 1  # only good has a document
+    fed.scrape()
+    # bad tripped its breaker after 2 failures: later cycles skip it.
+    for _ in range(5):
+        fed.scrape()
+    assert calls["bad"] == 2
+    skipped = reg.counter("noise_ec_federate_scrapes_total").labels(
+        result="skipped"
+    )
+    assert skipped.value == 5
+    errors = reg.counter("noise_ec_federate_scrape_errors_total").labels(
+        peer="fleet://bad"
+    )
+    assert errors.value == 2
+    # good starts failing: its last good document is served stale.
+    state["fail"] = True
+    fed.scrape()
+    fams = {f["name"]: f for f in fed.merged_families()}
+    ctr = fams["noise_ec_transport_shards_in_total"]["samples"][0]
+    assert ctr[2] == "2"
+
+
+def test_federator_rejects_corrupt_documents():
+    reg = Registry()
+    fed = MetricsFederator(
+        sources={"fleet://corrupt": lambda: 'x{peer="unterminated} 1\n'},
+        registry=reg, failure_threshold=3, reset_timeout=60.0,
+    )
+    assert fed.scrape() == 0
+    err = reg.counter("noise_ec_federate_scrapes_total").labels(
+        result="error"
+    )
+    assert err.value == 1
+
+
+def test_fleet_metrics_route_serves_merged_view():
+    reg = Registry()
+    fed = MetricsFederator(
+        sources={
+            "fleet://0": lambda: _node_doc(3, 1, (0.001,)),
+            "fleet://1": lambda: _node_doc(4, 0, (0.002,)),
+        },
+        registry=reg,
+    )
+    srv = StatsServer(port=0, registry=reg)
+    try:
+        fed.attach(srv)
+        status, body = _get(srv.url + "/fleet/metrics")
+        assert status == 200
+        text = body.decode()
+        assert (
+            'noise_ec_transport_shards_in_total{peer="tcp://a:1",'
+            'node="fleet"} 7' in text.splitlines()
+        )
+        # The route's own families update: series gauge is non-zero.
+        assert reg.gauge("noise_ec_federate_series").labels().read() > 0
+    finally:
+        fed.close()
+        srv.close()

@@ -90,14 +90,16 @@ def test_write_through_hit_routes_and_byte_identity():
     ) == route_cache0 + n_stripes
 
     cache.clear()
-    route_decode0 = counter_value(
-        "noise_ec_object_read_route_total", route="decode"
+    route_local0 = counter_value(
+        "noise_ec_object_read_route_total", route="local"
     )
     cold = objects.read("acme", "x.bin")
     assert cold == payload  # byte-identical across routes
+    # Every shard is present and trusted, so the cold read joins
+    # locally (the "local" tier) — no degraded decode.
     assert counter_value(
-        "noise_ec_object_read_route_total", route="decode"
-    ) == route_decode0 + n_stripes
+        "noise_ec_object_read_route_total", route="local"
+    ) == route_local0 + n_stripes
     # The cold read write-through-repopulated the cache.
     assert objects.read("acme", "x.bin") == payload
     assert counter_value(

@@ -1,0 +1,242 @@
+"""Flight recorder: delta-ring accounting, SLO-flip capture, incident
+bundles and their offline report (obs/recorder.py,
+tools/trace_report.py --incident; docs/observability.md "Flight
+recorder")."""
+
+from __future__ import annotations
+
+import json
+import sys
+import urllib.request
+from pathlib import Path
+
+from noise_ec_tpu.obs.health import SLOEvaluator
+from noise_ec_tpu.obs.recorder import FlightRecorder, flatten_registry
+from noise_ec_tpu.obs.registry import Registry
+from noise_ec_tpu.obs.server import StatsServer
+from noise_ec_tpu.obs.trace import Tracer
+
+
+def _trace_report():
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        import trace_report
+    finally:
+        sys.path.pop(0)
+    return trace_report
+
+
+def _degrade(slo: SLOEvaluator) -> None:
+    """Push the evaluator over its error budget."""
+    for _ in range(max(slo.min_events, 10)):
+        slo.record("corrupt", 0.001)
+
+
+# -- ticking / ring ---------------------------------------------------------
+
+
+def test_tick_records_deltas_and_flatten_shape():
+    reg = Registry()
+    ctr = reg.counter("noise_ec_dispatch_overflows_total").labels()
+    hist = reg.histogram("noise_ec_decode_seconds").labels()
+    rec = FlightRecorder(registry=reg, tracer=Tracer(registry=Registry()))
+    rec.tick()  # baseline snapshot, no deltas yet
+    ctr.add(3)
+    hist.observe(0.25)
+    entry = rec.tick()
+    assert entry["deltas"]["noise_ec_dispatch_overflows_total"] == 3.0
+    # Histograms flatten to #count/#sum (buckets would dominate the ring).
+    assert entry["deltas"]["noise_ec_decode_seconds#count"] == 1.0
+    assert entry["deltas"]["noise_ec_decode_seconds#sum"] == 0.25
+    flat = flatten_registry(reg)
+    assert flat["noise_ec_dispatch_overflows_total"] == 3.0
+    assert "noise_ec_decode_seconds#count" in flat
+    # A quiet tick records no deltas.
+    assert rec.tick()["deltas"] == {}
+
+
+def test_ring_stays_under_byte_cap():
+    reg = Registry()
+    fam = reg.counter("noise_ec_transport_shards_in_total")
+    rec = FlightRecorder(
+        registry=reg, tracer=Tracer(registry=Registry()), max_bytes=4096
+    )
+    for i in range(200):
+        fam.labels(peer=f"tcp://p{i % 32}:1").add(i + 1)
+        rec.tick()
+    stats = rec.stats()
+    assert stats["entries"] > 1
+    assert rec.ring_bytes() <= 4096
+    # Eviction happened: 200 ticks cannot fit in 4 KiB.
+    assert stats["entries"] < 200
+    # The ring-bytes gauge reads the live accounting.
+    g = reg.gauge("noise_ec_incident_ring_bytes").labels()
+    assert g.read() == rec.ring_bytes()
+
+
+def test_tick_truncates_to_top_deltas():
+    reg = Registry()
+    fam = reg.counter("noise_ec_transport_shards_in_total")
+    rec = FlightRecorder(
+        registry=reg, tracer=Tracer(registry=Registry()), top_deltas=4
+    )
+    rec.tick()
+    for i in range(10):
+        fam.labels(peer=f"tcp://p{i}:1").add(i + 1)
+    entry = rec.tick()
+    assert len(entry["deltas"]) == 4
+    assert entry["deltas_truncated"] == 6
+    # Kept by |delta|: the four largest movers survive.
+    assert 'noise_ec_transport_shards_in_total{peer=tcp://p9:1}' in (
+        entry["deltas"]
+    )
+
+
+# -- SLO-flip capture -------------------------------------------------------
+
+
+def test_flip_captures_exactly_one_bundle(tmp_path):
+    reg = Registry()
+    slo = SLOEvaluator(window_seconds=1000.0, min_events=5)
+    rec = FlightRecorder(
+        registry=reg, slo=slo, tracer=Tracer(registry=Registry()),
+        incident_dir=str(tmp_path), min_bundle_interval=60.0,
+    )
+    for _ in range(5):
+        slo.record("ok", 0.001)
+    assert slo.verdict()["healthy"]
+    rec.tick()
+    _degrade(slo)
+    # The flip fires listeners once; repeated degraded verdicts (the
+    # healthz prober, the recorder tick) must not re-capture.
+    for _ in range(5):
+        assert not slo.verdict()["healthy"]
+    rec.tick()
+    bundles = sorted(tmp_path.glob("incident-*-flip.json"))
+    assert len(bundles) == 1
+    ctr = reg.counter("noise_ec_incident_bundles_total")
+    assert ctr.labels(trigger="flip").value == 1
+    doc = json.loads(bundles[0].read_text())
+    assert doc["version"] == 1
+    assert doc["trigger"] == "flip"
+    assert doc["verdict"]["healthy"] is False
+    assert "success rate" in doc["verdict"]["reason"]
+    assert doc["timeline"], "flip bundle must carry the pre-flip ring"
+    # Recovery + a second flip inside min_bundle_interval: the write is
+    # rate-limited away (a flapping SLO cannot fill a disk).
+    slo.reset()
+    for _ in range(5):
+        slo.record("ok", 0.001)
+    assert slo.verdict()["healthy"]
+    _degrade(slo)
+    assert not slo.verdict()["healthy"]
+    assert len(list(tmp_path.glob("incident-*.json"))) == 1
+    assert ctr.labels(trigger="flip").value == 1
+
+
+def test_capture_bundle_contents_and_spans_window(tmp_path):
+    reg = Registry()
+    tr = Tracer(registry=Registry())
+    rec = FlightRecorder(
+        registry=reg, tracer=tr, incident_dir=str(tmp_path),
+        min_bundle_interval=0.0,
+    )
+    rec.tick()
+    with tr.span("decode", key="incident-test"):
+        pass
+    bundle = rec.capture("request")
+    assert bundle["version"] == 1
+    assert bundle["trigger"] == "request"
+    assert [s["name"] for s in bundle["spans"]] == ["decode"]
+    assert bundle["recorder"]["ticks"] == 1
+    # The sibling Perfetto trace exists and loads.
+    trace_file = bundle["trace_file"]
+    assert trace_file is not None
+    doc = json.loads((tmp_path / trace_file).read_text())
+    assert doc["traceEvents"]
+
+
+def test_incident_route_serves_bundle():
+    reg = Registry()
+    rec = FlightRecorder(registry=reg, tracer=Tracer(registry=Registry()))
+    rec.tick()
+    srv = StatsServer(port=0, registry=reg)
+    try:
+        rec.attach(srv)
+        with urllib.request.urlopen(srv.url + "/incident", timeout=5) as r:
+            assert r.status == 200
+            doc = json.loads(r.read())
+        assert doc["trigger"] == "request"
+        assert len(doc["timeline"]) == 1
+    finally:
+        srv.close()
+
+
+# -- the offline report -----------------------------------------------------
+
+
+def _synthetic_bundle() -> dict:
+    """A hand-built incident: 3 healthy seconds, then 2 degraded ones
+    with a shed-counter burst, and one dominant decode span."""
+    t0 = 1000.0
+    timeline = []
+    for i in range(5):
+        healthy = i < 3
+        entry = {
+            "t": t0 + i,
+            "deltas": (
+                {"noise_ec_object_shed_total{reason=slo}": 40.0}
+                if not healthy else
+                {"noise_ec_object_get_bytes_total": 1.0}
+            ),
+            "last_seq": i,
+            "new_spans": 1,
+            "healthy": healthy,
+        }
+        if not healthy:
+            entry["reason"] = "success rate 0.5 below target 0.99"
+        timeline.append(entry)
+    spans = [
+        {"node": "tcp://n0:1#aa", "trace_id": "t0", "name": "decode",
+         "start": t0 + 3.0, "seconds": 0.9, "parent": None},
+        {"node": "tcp://n0:1#aa", "trace_id": "t0", "name": "verify",
+         "start": t0 + 3.9, "seconds": 0.05, "parent": None},
+    ]
+    return {
+        "version": 1, "trigger": "flip", "written_at": t0 + 5.0,
+        "node": "tcp://n0:1#aa",
+        "verdict": {"healthy": False,
+                    "reason": "success rate 0.5 below target 0.99"},
+        "timeline": timeline, "spans": spans,
+        "recorder": {"ticks": 5, "tick_seconds": 0.001, "entries": 5,
+                     "ring_bytes": 512, "deltas_truncated_total": 0},
+        "trace_file": None,
+    }
+
+
+def test_trace_report_incident_mode(tmp_path, capsys):
+    """--incident on a synthetic bundle: verdict-flip timeline, top
+    deltas and dominant stage, unit-pinned."""
+    tr = _trace_report()
+    path = tmp_path / "incident.json"
+    path.write_text(json.dumps(_synthetic_bundle()))
+    assert tr.main(["--incident", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "5 timeline entries, 2 spans" in out
+    assert "1 healthy->degraded flip(s) in window" in out
+    # The degraded run is attributed with its reason.
+    assert "DEGRADED" in out and "success rate 0.5 below target" in out
+    # Top delta: the shed burst (2 degraded seconds x 40) outranks the
+    # 3 x 1 byte-counter drip.
+    top = [ln for ln in out.splitlines() if "noise_ec_object_shed_total" in ln]
+    assert top and top[0].strip().startswith("+80")
+    assert tr.render_incident.__doc__  # it is the documented entry point
+    assert "dominant: decode on tcp://n0:1#aa" in out
+
+
+def test_trace_report_incident_render_empty_ring():
+    tr = _trace_report()
+    out = tr.render_incident({"version": 1, "trigger": "request",
+                              "node": "n", "timeline": [], "spans": []})
+    assert "(empty ring)" in out
+    assert "no spans captured in window" in out

@@ -460,13 +460,20 @@ def test_wait_writable_is_noop_on_event_loop_thread():
 # ------------------------------------------------------ acceptance soak
 
 
-def test_chaos_soak_eventual_delivery_and_health_flip(lockgraph):
+def test_chaos_soak_eventual_delivery_and_health_flip(lockgraph, tmp_path):
     """The acceptance soak (ISSUE 4): two TCP nodes through the chaos
     proxy — 5% drop, 1% corrupt, one scheduled 2 s directional
     partition, one forced connection reset — deliver 100% of a
     200-message broadcast via reconnect + NACK repair + announce, accept
     zero wrong objects, and /healthz flips 503 → 200 as the partition
-    heals and the SLO window slides."""
+    heals and the SLO window slides. The flight recorder rides the whole
+    soak: the flip auto-captures exactly ONE incident bundle (rate limit
+    holds against re-flips), the delta ring stays under its byte cap,
+    and the recorder's self-measured tick cost stays under 1% of wall
+    time (the "always-on" claim, docs/observability.md)."""
+    import json
+
+    from noise_ec_tpu.obs.recorder import FlightRecorder
     from noise_ec_tpu.obs.server import StatsServer
     from urllib.request import urlopen
 
@@ -521,6 +528,15 @@ def test_chaos_soak_eventual_delivery_and_health_flip(lockgraph):
     server = StatsServer(
         slo=slo, health_details=b.supervisor.health_summary
     )
+    # The always-on flight recorder: subscribed to the soak's SLO, so
+    # the partition's healthy -> degraded flip freezes the ring into a
+    # bundle with no poller in the loop.
+    recorder = FlightRecorder(
+        slo=slo, incident_dir=str(tmp_path), max_bytes=256 * 1024,
+        min_bundle_interval=300.0, interval=0.5,
+    )
+    recorder.start()
+    t_wall0 = time.perf_counter()
 
     def healthz() -> int:
         try:
@@ -591,8 +607,42 @@ def test_chaos_soak_eventual_delivery_and_health_flip(lockgraph):
             time.sleep(0.25)
             status = healthz()
         assert status == 200, slo.verdict()
+
+        # --- flight recorder rode the soak (ISSUE 16): exactly one
+        # bundle on the flip (re-flips rate-limited), ring bounded,
+        # overhead within the 1% always-on budget.
+        wall = time.perf_counter() - t_wall0
+        recorder.close()
+        bundles = sorted(tmp_path.glob("incident-*-flip.json"))
+        assert len(bundles) == 1, [p.name for p in tmp_path.iterdir()]
+        assert counter_value(
+            "noise_ec_incident_bundles_total", trigger="flip"
+        ) >= 1
+        doc = json.loads(bundles[0].read_text())
+        assert doc["trigger"] == "flip"
+        assert doc["verdict"]["healthy"] is False
+        assert doc["timeline"], "the pre-flip ring must ride the bundle"
+        # The bundle loads in the offline reporter.
+        import sys as _sys
+        from pathlib import Path as _Path
+
+        _sys.path.insert(
+            0, str(_Path(__file__).resolve().parent.parent / "tools")
+        )
+        try:
+            import trace_report
+        finally:
+            _sys.path.pop(0)
+        report = trace_report.render_incident(doc)
+        assert "healthy->degraded flip(s) in window" in report
+        stats_rec = recorder.stats()
+        assert stats_rec["ring_bytes"] <= 256 * 1024
+        assert stats_rec["tick_seconds"] <= 0.01 * wall, (
+            stats_rec, wall,
+        )
     finally:
         stop_poll.set()
+        recorder.close()
         server.close()
         proxy.close()
         a.close()

@@ -230,6 +230,44 @@ def test_trace_report_loads_span_dump_files(tmp_path):
     assert "dominant:" in out
 
 
+def test_collector_tolerates_peer_restart_mid_collect():
+    """A peer that restarts mid-collection serves a NEW tracer epoch
+    with its seq counter back at 0. The collector's stale ``?since=``
+    cursor would silently hide the new incarnation's spans; the epoch
+    change makes it re-fetch from 0 in the same poll, and the
+    (epoch, seq) dedup key keeps both incarnations' spans without
+    collisions."""
+    tr1 = Tracer(registry=Registry())
+    tr1.set_node("tcp://restart:1", b"\xab" * 32)
+    with tr1.span("decode", key="before-restart"):
+        pass
+    srv = StatsServer(port=0, registry=Registry(), tracer=tr1)
+    try:
+        coll = TraceCollector([srv.url], tracer=Tracer())
+        assert coll.poll() == 1
+        # Restart: a fresh tracer (new epoch, seqs restart at 0) behind
+        # the same endpoint and node identity.
+        tr2 = Tracer(registry=Registry())
+        tr2.set_node("tcp://restart:1", b"\xab" * 32)
+        assert tr2.epoch != tr1.epoch
+        with tr2.span("verify", key="after-restart"):
+            pass
+        srv.tracer = tr2
+        assert coll.poll() == 1  # the post-restart span, not zero
+        spans = coll.merged_spans()
+        # Both incarnations' spans are present exactly once — the new
+        # seq=1 did not overwrite the old seq=1.
+        assert sorted(s["name"] for s in spans) == ["decode", "verify"]
+        assert {s["trace_id"] for s in spans} == {
+            "before-restart", "after-restart",
+        }
+        # The cursor re-anchored on the new incarnation: nothing moves.
+        assert coll.poll() == 0
+        assert len(coll.merged_spans()) == 2
+    finally:
+        srv.close()
+
+
 # -- SLO evaluator + /healthz -----------------------------------------------
 
 

@@ -111,6 +111,11 @@ HOST_PREFIXES = (
     # prefix — it is an exact shard count ratio, deterministic round
     # over round, and gets the tight device tolerance.
     "convert_",
+    # tenant_isolation_p99_ratio is a noisy-neighbor contention ratio
+    # measured through the Python service layer under a live talker
+    # thread — the noisiest stat in the file; host tolerance, and its
+    # "_ratio" suffix already flips it to lower-better.
+    "tenant_",
 )
 
 # The ISSUE-12 hot-read acceptance bars (cache_hot_check, fresh runs):

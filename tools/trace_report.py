@@ -11,11 +11,18 @@ for the p50/p99 traces prints the critical path — per-(node, stage)
 the share of the end-to-end interval each consumed, the uncovered
 "idle/network" remainder, and the single dominant (node, stage).
 
+With ``--incident BUNDLE`` the report reads a flight-recorder incident
+bundle (obs/recorder.py) instead: the verdict-flip timeline (which
+seconds were healthy, where the verdict flipped and why), the top
+metric deltas inside the captured window, and the dominant span stage
+over the bundle's spans — "what changed in the seconds before the 503".
+
 Usage:
 
     python tools/trace_report.py dump_a.json dump_b.json
     python tools/trace_report.py --peers http://127.0.0.1:9464,http://127.0.0.1:9465
     python tools/trace_report.py --quantiles 0.5,0.9,0.99 dump.json
+    python tools/trace_report.py --incident incident-...-flip.json
 
 File arguments may be ``/spans`` dump documents (``{"node", "spans",
 ...}`` — spans are stamped with the document's node id) or plain JSON
@@ -185,6 +192,87 @@ def render_report(
     return "\n".join(lines) + "\n"
 
 
+def render_incident(bundle: dict, top: int = 10) -> str:
+    """The text report for one flight-recorder incident bundle:
+    verdict-flip timeline, top metric deltas in the window, dominant
+    span stage."""
+    timeline = bundle.get("timeline") or []
+    spans = bundle.get("spans") or []
+    verdict = bundle.get("verdict") or {}
+    lines = [
+        f"incident bundle v{bundle.get('version', '?')} "
+        f"({bundle.get('trigger', '?')}) on {bundle.get('node', '?')}: "
+        f"{len(timeline)} timeline entries, {len(spans)} spans"
+    ]
+    if verdict:
+        state = "healthy" if verdict.get("healthy") else "degraded"
+        reason = verdict.get("reason")
+        lines.append(
+            f"verdict at capture: {state}"
+            + (f" ({reason})" if reason else "")
+        )
+
+    # Verdict-flip timeline: collapse the per-second entries into runs
+    # of equal health state so a 300-entry ring reads as a few lines.
+    lines.append("")
+    lines.append("verdict timeline:")
+    t0 = float(timeline[0]["t"]) if timeline else 0.0
+    runs: list[list] = []  # [state, first_offset, last_offset, reason]
+    for entry in timeline:
+        state = entry.get("healthy")
+        off = float(entry["t"]) - t0
+        if runs and runs[-1][0] == state:
+            runs[-1][2] = off
+        else:
+            runs.append([state, off, off, entry.get("reason")])
+    if not runs:
+        lines.append("   (empty ring)")
+    for state, lo, hi, reason in runs:
+        label = {True: "healthy", False: "DEGRADED"}.get(state, "unknown")
+        lines.append(
+            f"   t+{lo:7.1f}s .. t+{hi:7.1f}s  {label}"
+            + (f"  ({reason})" if reason else "")
+        )
+    flips = sum(
+        1 for a, b in zip(runs, runs[1:]) if a[0] is True and b[0] is False
+    )
+    lines.append(f"   {flips} healthy->degraded flip(s) in window")
+
+    # Top deltas: net movement of each metric across the whole window.
+    net: dict[str, float] = {}
+    for entry in timeline:
+        for key, delta in (entry.get("deltas") or {}).items():
+            net[key] = net.get(key, 0.0) + float(delta)
+    ranked = sorted(net.items(), key=lambda kv: -abs(kv[1]))[:top]
+    lines.append("")
+    lines.append(f"top {len(ranked)} metric deltas over the window:")
+    for key, delta in ranked:
+        lines.append(f"   {delta:+14.6g}  {key}")
+    if not ranked:
+        lines.append("   (no metric movement recorded)")
+
+    # Dominant stage: self-time breakdown across every span in the
+    # bundle window, treated as one interval set (critical_path per
+    # trace would fragment the answer across hundreds of tiny traces).
+    lines.append("")
+    if spans:
+        cp = critical_path(spans)
+        lines.append("span stages in window (self time):")
+        for st in cp["stages"][:top]:
+            lines.append(
+                f"   {st['stage']:<12} {st['node']:<32} "
+                f"{st['seconds'] * 1e3:9.3f} ms"
+            )
+        dom = cp["dominant"]
+        if dom is not None:
+            lines.append(
+                f"   dominant: {dom['stage']} on {dom['node']}"
+            )
+    else:
+        lines.append("no spans captured in window")
+    return "\n".join(lines) + "\n"
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="trace-report",
@@ -199,7 +287,18 @@ def main(argv=None) -> int:
         "-quantiles", "--quantiles", default="0.5,0.99",
         help="comma-separated quantiles to report (default 0.5,0.99)",
     )
+    p.add_argument(
+        "-incident", "--incident", default="",
+        help="flight-recorder incident bundle JSON: report the "
+        "verdict-flip timeline, top metric deltas and dominant span "
+        "stage instead of the trace critical path",
+    )
     args = p.parse_args(argv)
+    if args.incident:
+        with open(args.incident, encoding="utf-8") as f:
+            bundle = json.load(f)
+        print(render_incident(bundle), end="")
+        return 0
     spans: list[dict] = []
     if args.peers:
         from noise_ec_tpu.obs.collector import TraceCollector
