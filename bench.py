@@ -1066,6 +1066,95 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — secondary stat only
         stats["fleet_error"] = str(exc)[:80]
 
+    # --- placement ring: targeted-delivery fanout vs broadcast, and
+    # the churn-rebalance amplification drill (docs/placement.md). The
+    # same 24-peer object-only run twice — broadcast baseline, then
+    # domains@8 targeted — shares the manifest-broadcast component, so
+    # the per-put wire-send difference isolates the DATA-shard fanout:
+    # placement_fanout_ratio = targeted data sends per put over the
+    # n-shards ideal (the peers-to-n contract; gate bar 1.5x). Then a
+    # whole-domain kill on the targeted fleet: rebalance_amplification
+    # = bytes the rebalancers moved over the exact ownership-delta
+    # bytes the ring reports (ring.moved) — ~1.0 means the rebalancer
+    # moved only the delta. Both gated lower-better by bench_gate.
+    try:
+        from noise_ec_tpu.fleet import FleetLab, FleetProfile
+
+        p_base = (
+            "peers=24,fanout=4,msgs=40,object=1,object_bytes=8192,"
+            "stripe_bytes=4096,k=4,n=8,chaos=clean"
+        )
+        pb_lab = FleetLab(FleetProfile.parse(p_base), seed=7)
+        pb_lab.start()
+        pb_report = pb_lab.run()
+        pb_lab.close()
+        pt_prof = FleetProfile.parse(p_base + ",domains@8")
+        pt_lab = FleetLab(pt_prof, seed=7)
+        pt_lab.start()
+        try:
+            pt_report = pt_lab.run()
+            check_smoke(
+                pb_report["delivery"]["rate"] >= 0.999
+                and pt_report["delivery"]["rate"] >= 0.999,
+                f"placement bench delivery broadcast="
+                f"{pb_report['delivery']} targeted={pt_report['delivery']}",
+            )
+            stripes_per_put = 2  # 8192-byte objects over 4096 stripes
+            n_sh, fan = pt_prof.n, pt_prof.fanout
+            per_put_b = pb_report["wire_sends"] / max(
+                1, pb_report["objects"]["puts"]
+            )
+            per_put_t = pt_report["wire_sends"] / max(
+                1, pt_report["objects"]["puts"]
+            )
+            data_t = per_put_t - per_put_b + stripes_per_put * n_sh * fan
+            stats["placement_fanout_ratio"] = round(
+                max(data_t, 0.0) / (stripes_per_put * n_sh), 3
+            )
+            # Churn drill: settle steady-state deltas first so the
+            # measured bytes are the kill's delta alone.
+            pt_lab.rebalance_until_converged()
+            alive_before = {
+                f"fleet://{p.idx}" for p in pt_lab.peers if p.up
+            }
+            pt_lab.kill_domain("d7")
+            alive_after = {
+                f"fleet://{p.idx}" for p in pt_lab.peers if p.up
+            }
+            metas: dict = {}
+            for p in pt_lab.peers:
+                if p.store is None:
+                    continue
+                for s_key in p.store.keys():
+                    if s_key in metas:
+                        continue
+                    try:
+                        metas[s_key] = p.store.snapshot(s_key)[0]
+                    except Exception:  # noqa: BLE001 — evicted mid-walk
+                        continue
+            ideal_bytes = 0
+            for s_key, s_meta in metas.items():
+                moved_slots = pt_lab.ring.moved(
+                    s_key, s_meta.n, alive_before, alive_after,
+                    k=s_meta.k, code=s_meta.code,
+                )
+                ideal_bytes += len(moved_slots) * s_meta.shard_len
+            moved_before = sum(
+                rb.bytes_moved for rb in pt_lab.rebalancers.values()
+            )
+            rb_stats = pt_lab.rebalance_until_converged()
+            moved_bytes = rb_stats["bytes_moved"] - moved_before
+            if ideal_bytes > 0:
+                stats["rebalance_amplification"] = round(
+                    moved_bytes / ideal_bytes, 3
+                )
+        finally:
+            pt_lab.close()
+    except SmokeMismatch:
+        raise  # deterministic correctness failure: fail the run
+    except Exception as exc:  # noqa: BLE001 — secondary stat only
+        stats["placement_error"] = str(exc)[:80]
+
     # --- live-path coalescing: N concurrent senders whose same-geometry
     # encodes ride one node's CoalescingDispatcher (ops/coalesce.py) vs
     # the same N dispatches issued sequentially, one device call each.

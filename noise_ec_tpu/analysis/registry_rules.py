@@ -338,6 +338,16 @@ SUBSYSTEM_DOCS: dict[str, dict] = {
         "tokens": ("Tenant attribution", "object_get_p99_ms",
                    "tenant_isolation_p99_ratio"),
     },
+    "placement": {
+        "doc": "docs/placement.md",
+        "prefixes": ("noise_ec_placement_",),
+        "extras": (),
+        "tokens": ("Topology.parse", "-topology", "domains@",
+                   "killdomain@", "PlacementRing", "TargetedDelivery",
+                   "Rebalancer", "straw2", "placement_fanout_ratio",
+                   "rebalance_amplification", "prev_stripes",
+                   "SHARD_BATCH"),
+    },
     "lrc": {
         "doc": "docs/lrc.md",
         "prefixes": ("noise_ec_lrc_", "noise_ec_convert_"),

@@ -45,6 +45,15 @@ Grammar (docs/fleet.md):
 ``churn_peers=C``      peers subject to the churn schedule (0 = ~5%)
 ``churn@S:I:D[:J]``    passed through to ``ChaosProfile.parse``
 ``partition@...`` / ``reset@...`` / ``kill@...``  likewise
+``domains@D``          partition the fleet's peers into D failure
+                       domains ("d0".."d{D-1}", round-robin) and place
+                       object stripes through the placement ring
+                       (docs/placement.md); D must cover the active
+                       geometry (>= n for RS, >= groups + globals for
+                       LRC) — rejected at parse time otherwise
+``killdomain@T:NAME``  chaos: at T seconds, kill EVERY peer in failure
+                       domain NAME at once (the rack-failure drill;
+                       requires ``domains@``)
 """
 
 from __future__ import annotations
@@ -104,6 +113,13 @@ class FleetProfile:
     # LRC local-group count for the repair mix (the ``lrc@G`` token);
     # 0 = repair storms run on plain RS stripes.
     lrc_groups: int = 0
+    # Failure domains (the ``domains@D`` token): 0 = no placement ring,
+    # broadcast delivery exactly as before. D > 0 partitions peers
+    # round-robin into domains "d0".."d{D-1}" and routes object stripes
+    # through the placement ring (docs/placement.md).
+    domains: int = 0
+    # (at_seconds, domain_name) whole-domain kills (``killdomain@``).
+    domain_kills: tuple = ()
     chaos_name: str = "clean"
     churn_peers: int = 0   # 0 = ~5% of the fleet when churn is scheduled
     chaos: ChaosProfile = field(default_factory=ChaosProfile)
@@ -133,6 +149,24 @@ class FleetProfile:
                     )
                 kwargs["lrc_groups"] = g
                 continue
+            if tok.startswith("domains@"):
+                d = int(tok[len("domains@"):])
+                if d < 1:
+                    raise ValueError(
+                        f"domains@ count must be >= 1, got {d}"
+                    )
+                kwargs["domains"] = d
+                continue
+            if tok.startswith("killdomain@"):
+                spec = tok[len("killdomain@"):]
+                at_text, sep, name = spec.partition(":")
+                if not sep or not name:
+                    raise ValueError(
+                        f"killdomain@ wants T:NAME, got {spec!r}"
+                    )
+                kills = kwargs.setdefault("domain_kills", [])
+                kills.append((float(at_text), name.strip()))
+                continue
             if "=" not in tok:
                 raise ValueError(f"unparseable fleet token {tok!r}")
             key, _, val = tok.partition("=")
@@ -158,6 +192,8 @@ class FleetProfile:
         chaos = (
             ChaosProfile.parse(chaos_text) if chaos_text else ChaosProfile()
         )
+        if "domain_kills" in kwargs:
+            kwargs["domain_kills"] = tuple(kwargs["domain_kills"])
         prof = cls(chaos_name=chaos_name, chaos=chaos, **kwargs)
         prof.validate()
         return prof
@@ -188,6 +224,39 @@ class FleetProfile:
                 raise ValueError(
                     f"lrc@{self.lrc_groups} leaves no global parity "
                     f"(k={self.k}, n={self.n})"
+                )
+        if self.domains:
+            # Parse-time geometry cover (the tenant-grammar pattern):
+            # the ring places each stripe's shards on DISTINCT domains,
+            # so fewer domains than the geometry needs can never place.
+            from noise_ec_tpu.placement.ring import required_domains
+
+            code = f"lrc:{self.lrc_groups}" if self.lrc_groups else "rs"
+            need = required_domains(self.k, self.n, code)
+            if self.domains < need:
+                raise ValueError(
+                    f"domains@{self.domains} cannot cover the active "
+                    f"geometry (k={self.k}, n={self.n}, code={code}: "
+                    f"needs >= {need} failure domains)"
+                )
+            if self.domains > self.peers:
+                raise ValueError(
+                    f"domains@{self.domains} exceeds peers={self.peers}"
+                )
+        valid_domains = {f"d{i}" for i in range(self.domains)}
+        for at, name in self.domain_kills:
+            if not self.domains:
+                raise ValueError(
+                    "killdomain@ requires a domains@D token"
+                )
+            if at < 0:
+                raise ValueError(
+                    f"killdomain@ time must be >= 0, got {at}"
+                )
+            if name not in valid_domains:
+                raise ValueError(
+                    f"killdomain@ names unknown domain {name!r} "
+                    f"(domains@{self.domains} declares d0..d{self.domains - 1})"
                 )
         if self.msgs < 1:
             raise ValueError(f"msgs must be >= 1, got {self.msgs}")

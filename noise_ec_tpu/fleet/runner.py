@@ -148,6 +148,42 @@ class FleetPeer:
         if lab is not None:
             lab.hub.fan_out(self, msg.marshal())
 
+    # ---- placement surfaces (docs/placement.md): the directed slice a
+    # TargetedDelivery probes for. Handles are peer indices; tokens are
+    # the ring topology's "fleet://idx" addresses.
+
+    def send_many_to(self, handle, msgs) -> bool:
+        lab = self._lab()
+        if lab is None:
+            return False
+        return lab.hub.send_direct(
+            self, int(handle), [m.marshal() for m in msgs]
+        )
+
+    def placement_directory(self) -> dict:
+        lab = self._lab()
+        if lab is None:
+            return {}
+        return {
+            f"fleet://{p.idx}": p.idx
+            for p in lab.peers
+            if p.up and p.idx != self.idx
+        }
+
+    def placement_fetch(self, handle, key) -> dict:
+        """Owner-slot fetch for the gather read path: a direct snapshot
+        of the target peer's store (the lab's stand-in for a directed
+        fetch RPC). Raises for a down/storeless peer — the gather
+        degrades per-owner."""
+        lab = self._lab()
+        if lab is None:
+            raise RuntimeError("lab is gone")
+        peer = lab.peers[int(handle)]
+        if not peer.up or peer.store is None:
+            raise RuntimeError(f"peer {handle} is down")
+        _, shards, _ = peer.store.snapshot(key)
+        return {i: b for i, b in enumerate(shards) if b is not None}
+
     def _on_message(self, message: bytes, sender: PeerID) -> None:
         if len(message) < _HDR_LEN or message[:4] != _HDR:
             return  # an object stripe / manifest, not a scored chat
@@ -169,6 +205,11 @@ class FleetHub:
         self.links: dict[tuple[int, int], ChaosLink] = {}
         self.frame_errors = 0
         self.dropped = 0  # submit_wait timeouts (counted as overflow too)
+        # Per-receiver wire sends (pre-chaos): broadcast fan_out and
+        # directed send_direct both count, so targeted delivery's
+        # peers×→n× cut reads straight off this (bench.py's
+        # placement_fanout_ratio).
+        self.sends = 0
         self._t0 = time.monotonic()
 
     def now(self) -> float:
@@ -187,6 +228,7 @@ class FleetHub:
             receiver = lab.peers[ridx]
             if not receiver.up:
                 continue
+            self.sends += 1
             link = self.links[(sender.idx, ridx)]
             for buf, delay in link.admit(wire, now):
                 if not self.dispatch.submit_wait(
@@ -194,6 +236,39 @@ class FleetHub:
                     self._deliver, receiver, buf, sender.id, delay,
                 ):
                     self.dropped += 1
+
+    def send_direct(self, sender: FleetPeer, ridx: int, wires) -> bool:
+        """Directed delivery to ONE peer — the placement layer's
+        targeted cohort path and the rebalancer's shard mover. Links to
+        non-neighbor targets are created lazily with the SAME seeded
+        chaos pipeline (conn_id keeps the (sender, receiver) derivation
+        fan_out uses), so targeted traffic faces identical fault odds.
+        Returns False when the receiver is down (or the lab is gone)."""
+        lab = self._lab()
+        if lab is None:
+            return False
+        receiver = lab.peers[ridx]
+        if not receiver.up:
+            return False
+        link = self.links.get((sender.idx, ridx))
+        if link is None:
+            conn_id = sender.idx * len(lab.peers) + ridx
+            link = self.links.setdefault(
+                (sender.idx, ridx),
+                ChaosLink(lab.profile.chaos, lab.seed, conn_id, "a2b"),
+            )
+        now = self.now()
+        ok = True
+        for wire in wires:
+            self.sends += 1
+            for buf, delay in link.admit(wire, now):
+                if not self.dispatch.submit_wait(
+                    struct.pack("<II", sender.idx, ridx),
+                    self._deliver, receiver, buf, sender.id, delay,
+                ):
+                    self.dropped += 1
+                    ok = False
+        return ok
 
     def _deliver(self, receiver: FleetPeer, buf: bytes, sender_pid: PeerID,
                  delay: float) -> None:
@@ -246,6 +321,8 @@ class FleetLab:
         dispatch_workers: int = 4,
         link_window: int = 512,
         shed_retry_after: float = 2.0,
+        rebalance_rate_bytes_per_s: float = 4 << 20,
+        rebalance_burst_bytes: int = 8 << 20,
     ):
         if size is not None:
             profile = dataclasses.replace(profile, peers=size)
@@ -257,8 +334,16 @@ class FleetLab:
         self.dispatch_workers = dispatch_workers
         self.link_window = link_window
         self.shed_retry_after = shed_retry_after
+        self.rebalance_rate_bytes_per_s = rebalance_rate_bytes_per_s
+        self.rebalance_burst_bytes = rebalance_burst_bytes
         self.peers: list[FleetPeer] = []
         self.hub: Optional[FleetHub] = None
+        # Placement ring state (profile ``domains@D``; docs/placement.md):
+        # one shared Topology + PlacementRing, a TargetedDelivery per
+        # peer plugin, and a Rebalancer per store-carrying peer.
+        self.topology = None
+        self.ring = None
+        self.rebalancers: dict[int, object] = {}
         self.federator = None  # built by build_federator()/attach()
         self.scorer = FleetScorer()
         self.errors: deque = deque(maxlen=256)
@@ -330,8 +415,18 @@ class FleetLab:
                 self.hub.links[(peer.idx, ridx)] = ChaosLink(
                     prof.chaos, self.seed, conn_id, "a2b"
                 )
+        if prof.domains:
+            self._build_placement()
         if prof.chaos.churns:
             self._schedule_churn()
+        if prof.domain_kills:
+            events = list(self._churn_events)
+            for at, name in prof.domain_kills:
+                for token in self.topology.peers_of(name):
+                    events.append(
+                        (at, "kill", int(token.rsplit("//", 1)[1]))
+                    )
+            self._churn_events = sorted(events)
         log.info(
             "fleet lab: %d peers, fanout %d, %d links, chaos=%s%s",
             prof.peers, prof.fanout, len(self.hub.links), prof.chaos_name,
@@ -339,6 +434,65 @@ class FleetLab:
             "peer(s)" if self._churn_events else "",
         )
         return self
+
+    def _build_placement(self) -> None:
+        """Partition the peers round-robin into the profile's failure
+        domains ("d0".."d{D-1}": domain j holds peers j, j+D, ...), build
+        ONE shared :class:`PlacementRing` (every node must compute the
+        same maps), wire a TargetedDelivery per plugin and a Rebalancer
+        per store-carrying peer, and register the per-domain
+        ``noise_ec_placement_shards`` gauges."""
+        from noise_ec_tpu.placement import (
+            PlacementRing, TargetedDelivery, Topology,
+        )
+        from noise_ec_tpu.placement.rebalance import (
+            Rebalancer, register_domain_gauges,
+        )
+
+        prof = self.profile
+        domains = tuple(
+            (
+                f"d{j}",
+                tuple(
+                    f"fleet://{i}" for i in range(j, prof.peers, prof.domains)
+                ),
+            )
+            for j in range(prof.domains)
+        )
+        weights = {tok: 1.0 for _, toks in domains for tok in toks}
+        self.topology = Topology(domains=domains, weights=weights)
+        self.ring = PlacementRing(self.topology, seed=self.seed)
+        for peer in self.peers:
+            token = f"fleet://{peer.idx}"
+            peer.plugin.placement = TargetedDelivery(
+                self.ring, self_token=token
+            )
+            if peer.store is not None:
+                self.rebalancers[peer.idx] = Rebalancer(
+                    peer.store, self.ring,
+                    self_token=token,
+                    send=self._rebalance_send(peer),
+                    rate_bytes_per_s=self.rebalance_rate_bytes_per_s,
+                    burst_bytes=self.rebalance_burst_bytes,
+                    self_public_key=peer.keys.public_key,
+                )
+        ref = weakref.ref(self)
+        register_domain_gauges(
+            lambda d: _placement_census(ref, d), self.topology.names()
+        )
+
+    def _rebalance_send(self, peer: FleetPeer):
+        """The rebalancer's directed transport: topology token →
+        peer index → the hub's chaos-faithful ``send_direct``."""
+        ref = weakref.ref(peer)
+
+        def send(token: str, msgs) -> bool:
+            p = ref()
+            if p is None or not p.up:
+                return False
+            return p.send_many_to(int(token.rsplit("//", 1)[1]), msgs)
+
+        return send
 
     def _schedule_churn(self) -> None:
         prof = self.profile
@@ -427,6 +581,13 @@ class FleetLab:
         report["errors"] = self.error_count
         report["backpressure_waits"] = _backpressure_waits()
         report["gets"] = dict(self.get_results)
+        report["wire_sends"] = self.hub.sends
+        if self.ring is not None:
+            self.scorer.note_placement({
+                "domains": self.profile.domains,
+                "census": self.placement_census(),
+            })
+            report["placement"] = dict(self.scorer.placement)
         if self.federator is not None:
             try:
                 self.federator.scrape()
@@ -641,6 +802,89 @@ class FleetLab:
         sender._lrc_keys = [key]
         return key
 
+    # ---- placement/rebalance drivers (tests and bench call these)
+
+    def kill_domain(self, name: str) -> int:
+        """Kill EVERY peer in failure domain ``name`` at once (the
+        ``killdomain@`` drill, callable directly); returns how many
+        peers went down. Killed peers count as churned in scoring
+        (kill_times), exactly like ``churn@`` kills."""
+        if self.topology is None:
+            raise RuntimeError("kill_domain needs a domains@ profile")
+        downed = 0
+        for token in self.topology.peers_of(name):
+            peer = self.peers[int(token.rsplit("//", 1)[1])]
+            if peer.up:
+                peer.up = False
+                peer.kill_times.append(time.monotonic())
+                self._churn_kill.add(1)
+                downed += 1
+        return downed
+
+    def restart_domain(self, name: str) -> int:
+        """Bring every peer in domain ``name`` back up."""
+        if self.topology is None:
+            raise RuntimeError("restart_domain needs a domains@ profile")
+        restarted = 0
+        for token in self.topology.peers_of(name):
+            peer = self.peers[int(token.rsplit("//", 1)[1])]
+            if not peer.up:
+                peer.up = True
+                self._churn_restart.add(1)
+                restarted += 1
+        return restarted
+
+    def placement_census(self) -> dict:
+        """``{domain: in-place shard count}`` across the UP peers — the
+        numbers the per-domain gauges export and rebalance convergence
+        settles (docs/placement.md)."""
+        if self.ring is None:
+            return {}
+        from noise_ec_tpu.placement.rebalance import domain_census
+
+        holdings = [
+            (f"fleet://{p.idx}", p.store)
+            for p in self.peers
+            if p.up and p.store is not None
+        ]
+        return domain_census(self.ring, holdings)
+
+    def rebalance_cycle(self) -> dict:
+        """One rebalance pass across every up store-carrying peer: sync
+        each Rebalancer's alive view to the lab's authoritative up set,
+        run its cycle, and drain the resulting moves. Returns the
+        aggregated cycle stats."""
+        agg = {"examined": 0, "moved": 0, "deferred": 0, "dropped": 0}
+        alive = {f"fleet://{p.idx}" for p in self.peers if p.up}
+        for idx, rb in self.rebalancers.items():
+            if not self.peers[idx].up:
+                continue
+            rb.set_alive(alive)
+            stats = rb.run_cycle()
+            for key in agg:
+                agg[key] += stats.get(key, 0)
+        self._wait_drained(10.0)
+        return agg
+
+    def rebalance_until_converged(self, max_cycles: int = 8) -> dict:
+        """Run rebalance cycles until one completes with nothing moved
+        or deferred (converged) or the cycle budget runs out; returns
+        the LAST cycle's aggregate plus the cycle count and the total
+        bytes every rebalancer has moved."""
+        stats: dict = {}
+        cycles = 0
+        for _ in range(max_cycles):
+            stats = self.rebalance_cycle()
+            cycles += 1
+            if not stats["moved"] and not stats["deferred"]:
+                break
+        stats["cycles"] = cycles
+        stats["bytes_moved"] = sum(
+            rb.bytes_moved for rb in self.rebalancers.values()
+        )
+        self.scorer.note_placement({"rebalance": dict(stats)})
+        return stats
+
     def _wait_drained(self, timeout: float) -> None:
         deadline = time.monotonic() + timeout
         idle_since = None
@@ -830,6 +1074,16 @@ class FleetLab:
             self._churn_thread = None
         if self.hub is not None:
             self.hub.dispatch.shutdown(wait=True)
+
+
+def _placement_census(ref, domain: str) -> float:
+    lab = ref()
+    if lab is None or lab.ring is None:
+        return 0.0
+    try:
+        return float(lab.placement_census().get(domain, 0))
+    except Exception:  # noqa: BLE001 — a scrape must never raise
+        return 0.0
 
 
 def _count_peers(ref, up: bool) -> int:

@@ -57,6 +57,10 @@ class FleetScorer:
         # acceptance compares fleet-merged bucket p99s against.
         self.tenant_gets: dict[str, list[float]] = {}
         self.repairs = {"ok": 0, "failed": 0}
+        # Placement/rebalance roll-up (fleet runs with a domains@ ring):
+        # the lab folds its census + rebalance cycle stats in here so the
+        # report carries the convergence story (docs/placement.md).
+        self.placement: dict = {}
         reg = default_registry()
         self._m_msgs = reg.counter("noise_ec_fleet_messages_total")
         self._m_msgs_children: dict[str, object] = {}
@@ -132,6 +136,12 @@ class FleetScorer:
         with self._lock:
             self.repairs["ok" if ok else "failed"] += 1
 
+    def note_placement(self, stats: dict) -> None:
+        """Merge placement/rebalance stats into the report's
+        ``placement`` block (last write per key wins)."""
+        with self._lock:
+            self.placement.update(stats)
+
     # ------------------------------------------------------------- reporting
 
     def snapshot(self) -> dict:
@@ -157,6 +167,7 @@ class FleetScorer:
             objects = dict(self.objects)
             tenant_gets = {t: list(v) for t, v in self.tenant_gets.items()}
             repairs = dict(self.repairs)
+            placement = dict(self.placement)
         expected = delivered = lost = churned = 0
         latencies: list[float] = []
         per_sender: dict[int, list[float]] = {}
@@ -211,6 +222,7 @@ class FleetScorer:
             },
             "by_kind": by_kind,
             "objects": {"puts": len(objects)},
+            "placement": placement,
             "repair": repairs,
             "latency_ms": {
                 "count": len(latencies),

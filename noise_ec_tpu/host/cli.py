@@ -299,6 +299,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="background scrape interval for -federate (default 10)",
     )
     p.add_argument(
+        "-topology",
+        default="",
+        metavar="SPEC",
+        help="failure-domain topology for placement-ring shard "
+        "delivery: 'domain=rack1:peerA,peerB;domain=rack2:peerC' "
+        "(docs/placement.md). Every node in the deployment must be "
+        "given the SAME spec — the ring is deterministic, so "
+        "identical topologies compute identical shard->peer maps. "
+        "Unset = full broadcast exactly as before",
+    )
+    p.add_argument(
         "-incident-dir",
         default="",
         metavar="PATH",
@@ -401,6 +412,52 @@ def main(argv: list[str] | None = None) -> int:
     # expected program lands in (or replays from) the on-disk cache.
     plugin.prewarm(ladder=8 if compile_cache_armed else 0)
     net.add_plugin(plugin)
+
+    rebalancer = None
+    if args.topology:
+        from noise_ec_tpu.placement import (
+            PlacementRing, Rebalancer, TargetedDelivery, Topology,
+        )
+        from noise_ec_tpu.placement.rebalance import register_domain_gauges
+
+        topology = Topology.parse(args.topology)
+        # Seed pinned to 0: every node given the same -topology MUST
+        # compute the same shard->peer map, or targeted delivery and
+        # gather disagree about owners.
+        ring = PlacementRing(topology, seed=0)
+        plugin.placement = TargetedDelivery(
+            ring, self_token=net.id.address
+        )
+        log.info(
+            "placement ring active: %d failure domains, %d peers "
+            "(docs/placement.md)",
+            len(topology.names()), len(topology.all_peers()),
+        )
+        if store is not None:
+            def _rebalance_send(token, msgs, _net=net):
+                pk = _net.placement_directory().get(token)
+                return pk is not None and _net.send_many_to(pk, msgs)
+
+            rebalancer = Rebalancer(
+                store, ring,
+                self_token=net.id.address,
+                send=_rebalance_send,
+                self_public_key=keys.public_key,
+                repair=engine,
+            ).start()
+            register_domain_gauges(
+                lambda d, _rb=rebalancer: float(
+                    _rb.census()
+                    if ring.topology.domain_of(net.id.address) == d
+                    else 0
+                ),
+                topology.names(),
+            )
+            if net.supervisor is not None:
+                def _on_membership(address, up, _rb=rebalancer):
+                    (_rb.note_up if up else _rb.note_down)(address)
+
+                net.supervisor.add_membership_listener(_on_membership)
 
     net.listen()  # background accept loop (go net.Listen(), main.go:169)
     log.info("listening for peers on %s", net.id.address)
@@ -642,6 +699,8 @@ def main(argv: list[str] | None = None) -> int:
             fleet_lab.close()
         if converter is not None:
             converter.close()
+        if rebalancer is not None:
+            rebalancer.close()
         if scrubber is not None:
             scrubber.close()
         if engine is not None:

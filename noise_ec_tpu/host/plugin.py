@@ -146,6 +146,12 @@ class ShardPlugin:
         # pool/decode/verify path, which is what makes the repair
         # engine's anti-entropy exchange ride the plain SHARD opcode.
         self.store = store
+        # Optional placement policy (placement.TargetedDelivery): when
+        # wired, targeted sends consult the ring and the receive path
+        # store-absorbs shards whose assigned failure domain is ours —
+        # additively, never consuming, so broadcast semantics (chat,
+        # manifests) are untouched. None = pure broadcast, the default.
+        self.placement = None
         self.pool = ShardPool(
             ttl_seconds=pool_ttl_seconds,
             max_pools=pool_max_pools,
@@ -455,12 +461,24 @@ class ShardPlugin:
     def shard_and_broadcast(
         self, network, input_bytes: bytes,
         *, geometry: Optional[tuple[int, int]] = None,
+        targeted: bool = False,
     ) -> list[Shard]:
         """Encode ``input_bytes`` and broadcast one message per shard to all
         peers (main.go:201-210). Returns the shards for callers that want
         them (the reference discards them). ``geometry`` pins an explicit
         (k, n) instead of the plugin's mutable default — the object
-        service's per-namespace geometry rides this."""
+        service's per-namespace geometry rides this.
+
+        ``targeted`` opts the cohort into ring-directed placement
+        (docs/placement.md): when a :class:`TargetedDelivery` policy is
+        wired (``self.placement``), each shard goes ONLY to its assigned
+        owner — one SHARD_BATCH cohort frame per destination peer,
+        peers× wire fan-out cut to n×. Only the object service's data
+        stripes pass ``targeted=True``; chat and manifest broadcasts
+        stay full-fan-out so every node can index and the REPL is
+        unchanged. With no placement policy (or a transport without the
+        directed surface) the call is byte-identical to the broadcast
+        path."""
         shards = self.prepare_shards(
             network.id, network.keys, input_bytes, geometry=geometry
         )
@@ -478,16 +496,21 @@ class ShardPlugin:
             key=trace_key(shards[0].file_signature),
             shards=len(shards),
         ):
-            # One cohort call: the TCP transport coalesces the whole
-            # broadcast into a single SHARD_BATCH frame per peer flush
-            # (one signature, one verify, one sendmsg — design.md §15);
-            # transports without the hook keep per-shard semantics.
-            many = getattr(network, "broadcast_many", None)
-            if many is not None:
-                many(shards)
-            else:
-                for shard in shards:
-                    network.broadcast(shard)
+            placed = None
+            if targeted and self.placement is not None:
+                placed = self.placement.send(network, shards)
+            if placed is None:
+                # One cohort call: the TCP transport coalesces the whole
+                # broadcast into a single SHARD_BATCH frame per peer
+                # flush (one signature, one verify, one sendmsg —
+                # design.md §15); transports without the hook keep
+                # per-shard semantics.
+                many = getattr(network, "broadcast_many", None)
+                if many is not None:
+                    many(shards)
+                else:
+                    for shard in shards:
+                        network.broadcast(shard)
         self.counters.add("shards_out", len(shards))
         self.counters.add("bytes_out", sum(len(s.shard_data) for s in shards))
         return shards
@@ -1507,6 +1530,24 @@ class ShardPlugin:
         if msg.stream_chunk_count:
             return self._receive_stream(ctx, msg)
         key = msg.file_signature.hex()  # mempool key, main.go:55
+        if (
+            self.placement is not None
+            and self.store is not None
+            and self.placement.absorbs(msg)
+            and self.store.note_placement_shard(msg)
+        ):
+            # A targeted placement shard for a slot whose failure domain
+            # is ours (checked BEFORE the general absorb — this is the
+            # only branch allowed to CREATE a stripe entry): anchor it in
+            # the store and CONSUME it — pooling a below-k targeted
+            # cohort would only arm the NACK timer and pull the whole
+            # stripe back over the wire, undoing the fanout savings.
+            # Broadcast stripes still complete: a domain owns at most one
+            # local group of any stripe, so >= k other slots reach the
+            # pool — note_shard absorbs them additively (placement-born
+            # stripes report unconsumed) rather than starving it.
+            self.counters.add("placement_absorbed_shards", 1)
+            return None
         if self.store is not None and self.store.note_shard(msg):
             # The store consumed it (BEFORE the dedup window — an
             # anti-entropy response arrives precisely for objects we

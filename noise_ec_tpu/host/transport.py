@@ -1433,6 +1433,66 @@ class TCPNetwork:
         )
         return True
 
+    def send_many_to(self, public_key: bytes, msgs) -> bool:
+        """Send a shard cohort to ONE registered peer as SHARD_BATCH
+        frames — the placement layer's targeted-delivery surface
+        (docs/placement.md): same cohort splitting, signing and batch
+        accounting as ``broadcast_many``, but one destination instead
+        of the whole peer table. Returns False when no registered peer
+        holds ``public_key``."""
+        msgs = list(msgs)
+        if not msgs:
+            return True
+        with self._lock:
+            peer = self.peers.get(bytes(public_key))
+            if peer is None:
+                return False
+            writer = peer.writer
+            address = peer.pid.address
+        metrics = transport_metrics()
+        start = 0
+        while start < len(msgs):
+            group = []
+            group_bytes = 0
+            while start < len(msgs) and (
+                not group
+                or group_bytes + msgs[start].size() <= _MAX_BATCH_FRAME
+            ):
+                group_bytes += msgs[start].size() + 4
+                group.append(msgs[start])
+                start += 1
+            with span(
+                "wire_encode", key=trace_key(group[0].file_signature)
+            ):
+                if len(group) == 1:
+                    parts, nbytes = self._frame_parts(
+                        _OP_SHARD, group[0].marshal_parts()
+                    )
+                else:
+                    parts, nbytes = self._frame_parts(
+                        _OP_SHARD_BATCH, _encode_shard_batch_parts(group)
+                    )
+            if len(group) > 1:
+                wire_metrics().batch_out(len(group))
+            metrics.record_out(address, nbytes, count=len(group))
+            with self._lock:
+                self._posted_bytes[writer] = (
+                    self._posted_bytes.get(writer, 0) + nbytes
+                )
+            self._writer_loop(writer).call_soon_threadsafe(
+                self._enqueue_frames, writer, parts, 1, nbytes
+            )
+        return True
+
+    def placement_directory(self) -> dict:
+        """``{address token: public key}`` for every registered peer —
+        how the placement ring's topology tokens (peer addresses) map to
+        ``send_many_to`` handles. Snapshot semantics: membership may
+        change after return, and a send to a departed peer just returns
+        False."""
+        with self._lock:
+            return {p.pid.address: pk for pk, p in self.peers.items()}
+
     def wait_writable(
         self,
         soft_cap: Optional[int] = None,

@@ -61,6 +61,9 @@ PIPELINE_STAGES: tuple[str, ...] = (
     # Hot->archival conversion (docs/lrc.md): one span per converted
     # object (gather -> re-encode -> manifest swap -> GC).
     "convert",
+    # Placement churn rebalance (docs/placement.md): one span per
+    # ownership-delta cycle over the local store.
+    "rebalance",
 )
 
 # name -> (type, help, label names). The single source of truth for every
@@ -821,6 +824,26 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "counter",
         "Pools dropped, labeled by reason (ttl, explicit, overflow)",
         ("reason",),
+    ),
+    # --- placement ring (noise_ec_tpu/placement/, docs/placement.md)
+    "noise_ec_placement_shards": (
+        "gauge",
+        "Shards held inside their ring-assigned failure domain, labeled "
+        "by domain — settles to exact ring ownership as rebalance "
+        "converges",
+        ("domain",),
+    ),
+    "noise_ec_placement_moves_total": (
+        "counter",
+        "Rebalancer shard movements, labeled by reason (delta, deferred, "
+        "dropped, migrate)",
+        ("reason",),
+    ),
+    "noise_ec_placement_fanout_saved_total": (
+        "counter",
+        "Per-peer shard deliveries avoided by targeted placement sends "
+        "versus a full broadcast of the same cohort",
+        (),
     ),
 }
 

@@ -75,6 +75,28 @@ class PeerSupervisor:
         fam = reg.counter("noise_ec_reconnect_total")
         self._reconnect_ok = fam.labels(result="ok")
         self._reconnect_failed = fam.labels(result="failed")
+        # Membership listeners: fn(address, up) fired on every observed
+        # peer transition (connection lost -> down, re-dial success ->
+        # up). The placement rebalancer rides this to diff ring
+        # ownership on churn (docs/placement.md); advisory — a listener
+        # exception never breaks supervision.
+        self._membership_listeners: list = []
+
+    def add_membership_listener(self, fn) -> None:
+        """Register ``fn(address: str, up: bool)`` for peer up/down
+        transitions this supervisor observes."""
+        with self._lock:
+            self._membership_listeners.append(fn)
+
+    def _notify_membership(self, address: str, up: bool) -> None:
+        with self._lock:
+            listeners = list(self._membership_listeners)
+        for fn in listeners:
+            try:
+                fn(address, up)
+            except Exception as exc:  # noqa: BLE001 — advisory hook
+                log.warning("membership listener failed for %s: %s",
+                            address, exc)
 
     # ------------------------------------------------------------ breakers
 
@@ -118,6 +140,7 @@ class PeerSupervisor:
             self.breaker(address).record_failure()
         log.info("peer %s lost (%s); supervising re-dial",
                  address, reason or "connection closed")
+        self._notify_membership(address, False)
         self._schedule(address)
 
     def close(self) -> None:
@@ -187,6 +210,7 @@ class PeerSupervisor:
             with self._lock:
                 self._attempts.pop(address, None)
             log.info("re-dial of %s succeeded", address)
+            self._notify_membership(address, True)
 
     # --------------------------------------------------------------- health
 

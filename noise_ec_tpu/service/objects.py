@@ -140,7 +140,7 @@ class ShedError(RuntimeError):
 
 # GET serving tiers by cost (docs/object-service.md): a request's route
 # label is the most expensive tier any of its stripes touched.
-_ROUTE_RANK = {"cache": 0, "local": 1, "peer": 2, "decode": 3}
+_ROUTE_RANK = {"cache": 0, "local": 1, "peer": 2, "gather": 3, "decode": 4}
 
 
 class _ObjectMetrics:
@@ -176,7 +176,7 @@ class _ObjectMetrics:
             route: reg.counter(
                 "noise_ec_object_read_route_total"
             ).labels(route=route)
-            for route in ("cache", "local", "peer", "decode")
+            for route in ("cache", "local", "peer", "gather", "decode")
         }
         self._op_seconds = reg.histogram("noise_ec_object_op_seconds")
         self._tenant_sheds = reg.counter(
@@ -464,8 +464,13 @@ class ObjectStore:
 
         def flush(payload: bytes) -> None:
             pad = (-len(payload)) % k
+            # Data stripes opt into ring-targeted placement
+            # (docs/placement.md: one cohort per owner instead of a
+            # full broadcast); the MANIFEST below stays broadcast so
+            # every node can index the object.
             shards = self.plugin.shard_and_broadcast(
-                self.network, payload + bytes(pad), geometry=(k, n)
+                self.network, payload + bytes(pad), geometry=(k, n),
+                targeted=True,
             )
             stripe_keys.append(trace_key(shards[0].file_signature))
             if warm is not None:
@@ -828,6 +833,23 @@ class ObjectStore:
                 self._cache_store(address, i, blob, key)
                 self._metrics.routes["peer"].add(1)
                 return blob, "peer", False
+        placement = getattr(self.plugin, "placement", None)
+        if placement is not None:
+            # Targeted placement scattered this stripe across its ring
+            # owners, so no single node may hold k shards: gather them
+            # (docs/placement.md). A refused or short gather falls
+            # through to the decode/anti-entropy tier unchanged.
+            padded = placement.gather(
+                self.store, self.network, key,
+                k=int(doc["k"]), n=int(doc["n"]),
+                field=str(doc.get("field", "gf256")),
+                code=str(doc.get("code", "rs")),
+            )
+            if padded is not None:
+                blob = bytes(memoryview(padded)[:logical])
+                self._cache_store(address, i, blob, key)
+                self._metrics.routes["gather"].add(1)
+                return blob, "gather", False
         padded, degraded = self._read_stripe(key)
         blob = (
             padded if len(padded) == logical

@@ -72,9 +72,68 @@ from noise_ec_tpu.obs.registry import default_registry
 from noise_ec_tpu.obs.trace import node_attrs, span
 from noise_ec_tpu.store.stripe import StripeStore
 
-__all__ = ["ConversionEngine", "ConversionPolicy"]
+__all__ = [
+    "ConversionEngine",
+    "ConversionPolicy",
+    "derive_stripe_sig",
+    "finish_prev_stripes_gc",
+]
 
 log = logging.getLogger("noise_ec_tpu.store")
+
+
+def derive_stripe_sig(
+    namespace: bytes, address: str, code: str, capacity: int, idx: int,
+    *, salt: int = 0,
+) -> bytes:
+    """Deterministic generation-stripe signature: blake2b over
+    (namespace, address, code, capacity, index[, salt]). The same
+    inputs always reproduce the same key, which is what makes a
+    crashed conversion/rebalance re-run idempotent — ``put_object``
+    replacement lands on identical keys. ``salt`` (the placement
+    epoch for rebalance moves) is omitted from the preimage when 0 so
+    conversion signatures are byte-identical to the historical form."""
+    return hashlib.blake2b(
+        namespace + address.encode() + b"\0"
+        + code.encode() + b"\0"
+        + capacity.to_bytes(8, "little")
+        + idx.to_bytes(8, "little")
+        + (salt.to_bytes(8, "little") if salt else b""),
+        digest_size=32,
+    ).digest()
+
+
+def finish_prev_stripes_gc(
+    store: StripeStore, address: str, doc: dict, *, repair=None
+) -> None:
+    """Evict source stripes no surviving manifest references (the same
+    refcount walk DELETE uses), unpin them from the announce loop, then
+    clear the ``prev_stripes`` marker — the idempotent tail of a
+    generation swap (conversion or placement rebalance), re-runnable
+    after a crash."""
+    old_keys = [str(s) for s in doc.get("prev_stripes") or ()]
+    new_keys = {str(s) for s in doc.get("stripes") or ()}
+    doomed = [k for k in dict.fromkeys(old_keys) if k not in new_keys]
+    if doomed:
+        refs: set = set()
+        cursor = None
+        while True:
+            page, cursor = store.list_manifests(cursor=cursor, limit=256)
+            for _, other in page:
+                refs.update(str(s) for s in other.get("stripes") or ())
+                ms = other.get("manifest_stripe")
+                if ms:
+                    refs.add(str(ms))
+            if cursor is None:
+                break
+        doomed = [k for k in doomed if k not in refs]
+        for key in doomed:
+            store.evict(key)
+        if doomed and repair is not None:
+            repair.unpin_announce(doomed)
+    done = dict(doc)
+    done.pop("prev_stripes", None)
+    store.put_manifest(address, done)
 
 _FIELD_ORDER = {"gf256": 256, "gf65536": 65536}
 
@@ -536,13 +595,9 @@ class ConversionEngine:
         keys = []
         for idx in range(0, max(1, -(-len(whole) // capacity))):
             chunk = whole[idx * capacity : (idx + 1) * capacity]
-            sig = hashlib.blake2b(
-                b"noise-ec-convert\0" + address.encode() + b"\0"
-                + pol.code.encode() + b"\0"
-                + capacity.to_bytes(8, "little")
-                + idx.to_bytes(8, "little"),
-                digest_size=32,
-            ).digest()
+            sig = derive_stripe_sig(
+                b"noise-ec-convert\0", address, pol.code, capacity, idx
+            )
             keys.append(self.store.put_object(
                 sig, chunk, pol.k, pol.n,
                 field=pol.field, code=pol.code,
@@ -552,34 +607,6 @@ class ConversionEngine:
     # ----------------------------------------------------------------- gc
 
     def _finish_gc(self, address: str, doc: dict) -> None:
-        """Evict source stripes no surviving manifest references (the
-        same refcount walk DELETE uses), unpin them from the announce
-        loop, then clear the ``prev_stripes`` marker — the idempotent
-        tail of a conversion, re-runnable after a crash."""
-        old_keys = [str(s) for s in doc.get("prev_stripes") or ()]
-        new_keys = {str(s) for s in doc.get("stripes") or ()}
-        doomed = [k for k in dict.fromkeys(old_keys) if k not in new_keys]
-        if doomed:
-            refs: set = set()
-            cursor = None
-            while True:
-                page, cursor = self.store.list_manifests(
-                    cursor=cursor, limit=256
-                )
-                for _, other in page:
-                    refs.update(
-                        str(s) for s in other.get("stripes") or ()
-                    )
-                    ms = other.get("manifest_stripe")
-                    if ms:
-                        refs.add(str(ms))
-                if cursor is None:
-                    break
-            doomed = [k for k in doomed if k not in refs]
-            for key in doomed:
-                self.store.evict(key)
-            if doomed and self.repair is not None:
-                self.repair.unpin_announce(doomed)
-        done = dict(doc)
-        done.pop("prev_stripes", None)
-        self.store.put_manifest(address, done)
+        finish_prev_stripes_gc(
+            self.store, address, doc, repair=self.repair
+        )

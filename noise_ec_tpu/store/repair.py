@@ -650,9 +650,21 @@ class RepairEngine:
         if peers is not None and not peers:
             return 0  # nobody listening; the next interval retries
         announced = 0
-        recent, _ = self.store.recent_keys(
-            self.announce_window_seconds, self.announce_max_stripes
-        )
+        # Follow the cursor to the END of the recency window: one page
+        # per recent_keys call (the per-page cap keeps each store-lock
+        # hold bounded), but a store with more than announce_max_stripes
+        # fresh stripes announces ALL of them, not just page 1.
+        recent: list = []
+        cursor = None
+        while True:
+            page, cursor = self.store.recent_keys(
+                self.announce_window_seconds,
+                self.announce_max_stripes,
+                cursor=cursor,
+            )
+            recent.extend(page)
+            if cursor is None or not page:
+                break
         # Pinned keys (namespace replication targets) ride every
         # announce beyond the recency window; dict.fromkeys dedups while
         # keeping the newest-first recents ahead of the standing set.

@@ -105,6 +105,13 @@ class _Stripe:
     # from disk stamp load time — after a restart they ARE news to peers
     # that churned while we were down.
     created_at: float = field(default_factory=time.monotonic)
+    # Placement-born (docs/placement.md): the entry was CREATED by a
+    # targeted placement shard, not a local put or an announced
+    # interest. ``note_shard`` absorbs into such stripes ADDITIVELY
+    # (returns False so the plugin's pool still sees broadcast
+    # traffic) — consuming would starve the reassembly pool of any
+    # stripe whose early slots land in this node's failure domain.
+    placement: bool = False
 
     def present(self) -> list[int]:
         return [i for i, s in enumerate(self.shards) if s is not None]
@@ -605,16 +612,18 @@ class StripeStore:
         Returns True iff the shard was *consumed* (absorbed, matched a
         stored duplicate, or rejected as inconsistent with the verified
         stripe) — the plugin then skips the pool/decode path: the object
-        is already durable here. Never raises: a store problem must not
-        break plugin delivery.
+        is already durable here. Placement-born stripes absorb
+        ADDITIVELY instead (stored but False — see ``_Stripe.placement``)
+        so broadcast stripes still complete through the pool. Never
+        raises: a store problem must not break plugin delivery.
         """
         try:
-            return self._note_shard(msg)
+            return self._note_shard(msg, additive=True)
         except Exception as exc:  # noqa: BLE001 — advisory path only
             log.warning("store note_shard failed: %s", exc)
             return False
 
-    def _note_shard(self, msg) -> bool:
+    def _note_shard(self, msg, *, additive: bool = False) -> bool:
         key = trace_key(msg.file_signature)
         with self._lock:
             stripe = self._stripes.get(key)
@@ -640,6 +649,9 @@ class StripeStore:
             )
             shards = list(stripe.shards)
             unverified = set(stripe.unverified)
+            # additive=True + placement-born: store the shard but report
+            # False so the pool path still runs (docstring).
+            pass_through = additive and stripe.placement
         engine = self._engine()
         if not slot_empty:
             # A shard we already hold: the interest signal anti-entropy
@@ -648,7 +660,7 @@ class StripeStore:
             # scrub adjudicates our own copy against parity).
             if engine is not None:
                 engine.on_remote_interest(key)
-            return duplicate
+            return duplicate and not pass_through
         blob = bytes(msg.shard_data)
         trusted = [
             i for i, s in enumerate(shards)
@@ -687,6 +699,76 @@ class StripeStore:
         self._persist_shard(key, num)
         if engine is not None:
             engine.enqueue_auto(key)
+        return not pass_through
+
+    def note_placement_shard(self, msg) -> bool:
+        """Absorb a TARGETED placement shard (docs/placement.md) — a
+        shard the placement ring routed to this node even though no
+        local stripe anchors it yet. Unlike :meth:`note_shard`, an
+        unknown key CREATES the stripe entry: meta derives from the
+        wire geometry (``object_len = k * shard_len`` — the padded
+        capacity; the manifest carries the logical size) and the slot
+        lands unverified until >= k shards accumulate and the repair
+        engine (or a gather's reconstruct-and-compare) vouches for it.
+        Known keys delegate to the normal absorb. Advisory like
+        ``note_shard``: never raises, True iff the shard was stored or
+        rejected against a verified stripe."""
+        try:
+            with self._lock:
+                known = trace_key(msg.file_signature) in self._stripes
+            if known:
+                return self._note_shard(msg)
+            return self._note_placement_shard(msg)
+        except Exception as exc:  # noqa: BLE001 — advisory path only
+            log.warning("store note_placement_shard failed: %s", exc)
+            return False
+
+    def _note_placement_shard(self, msg) -> bool:
+        k = int(msg.minimum_needed_shards)
+        n = int(msg.total_shards)
+        num = int(msg.shard_number)
+        blob = bytes(msg.shard_data)
+        if (
+            not 1 <= k <= n
+            or not 0 <= num < n
+            or not blob
+            or getattr(msg, "stream_chunk_count", 0)
+        ):
+            return False
+        meta = StripeMeta(
+            file_signature=bytes(msg.file_signature),
+            k=k,
+            n=n,
+            shard_len=len(blob),
+            object_len=k * len(blob),
+            field="gf256",
+        )
+        stripe = _Stripe(
+            meta=meta,
+            shards=[blob if i == num else None for i in range(n)],
+            unverified={num},
+            placement=True,
+        )
+        stored = False
+        with self._lock:
+            if meta.key in self._stripes:
+                # Raced with another arrival: fall through to absorb.
+                pass
+            elif len(self._stripes) >= self.max_stripes:
+                return False
+            else:
+                self._stripes[meta.key] = stripe
+                self.shard_bytes += len(blob)
+                self._metrics.absorbed.add(1)
+                stored = True
+        if not stored:
+            return self._note_shard(msg)
+        # Persist and enqueue OUTSIDE the lock: both re-enter it
+        # (snapshot / classify), and self._lock is not reentrant.
+        self._persist_stripe(stripe)
+        engine = self._engine()
+        if engine is not None:
+            engine.enqueue_auto(meta.key)
         return True
 
     # ------------------------------------------------------- persistence
@@ -736,6 +818,7 @@ class StripeStore:
                 "sender_address": m.sender_address,
                 "sender_public_key": m.sender_public_key.hex(),
                 "unverified": sorted(stripe.unverified),
+                "placement": stripe.placement,
             }
         os.makedirs(self._stripe_dir(key), exist_ok=True)
         self._atomic_write(
@@ -813,6 +896,7 @@ class StripeStore:
                     int(i) for i in doc.get("unverified", [])
                     if 0 <= int(i) < meta.n
                 },
+                placement=bool(doc.get("placement", False)),
             )
             with self._lock:
                 self._replace_locked(key, stripe)

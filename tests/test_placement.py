@@ -1,0 +1,726 @@
+"""Placement ring tests (docs/placement.md): topology grammar, the
+cross-process determinism and consistent-hashing move bounds of the
+ring, the LRC group-in-one-domain invariant, the token-bucket-bounded
+rebalancer and its crash contracts, fleet `domains@`/`killdomain@`
+grammar, and the fleet acceptance drills — whole-domain kill with
+zero loss and byte-identical GETs, the peers×→n× wire cut, and the
+no-topology broadcast fallback."""
+
+import hashlib
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from noise_ec_tpu.host.wire import Shard
+from noise_ec_tpu.obs.registry import default_registry
+from noise_ec_tpu.placement import (
+    PlacementRing,
+    Rebalancer,
+    TargetedDelivery,
+    TokenBucket,
+    Topology,
+)
+from noise_ec_tpu.placement.ring import required_domains
+from noise_ec_tpu.store import StripeStore
+
+
+def counter_total(name: str) -> float:
+    """Sum over every child of a counter family (0 when unused)."""
+    return sum(
+        child.value
+        for _, child in default_registry().counter(name).children()
+    )
+
+
+TOPO8 = Topology(
+    domains=tuple(
+        (f"d{j}", tuple(f"peer://{j}.{i}" for i in range(4)))
+        for j in range(8)
+    ),
+    weights={},
+)
+
+
+# -------------------------------------------------------------- grammar
+
+
+def test_topology_parse_grammar():
+    topo = Topology.parse(
+        "domain=rack1:tcp://a:3000,tcp://b:3000;"
+        "domain=rack2: tcp://c:3000*2.0 ;;"
+    )
+    assert topo.names() == ("rack1", "rack2")
+    assert topo.peers_of("rack1") == ("tcp://a:3000", "tcp://b:3000")
+    assert topo.domain_of("tcp://c:3000") == "rack2"
+    assert topo.domain_of("tcp://nobody:1") is None
+    assert topo.weights["tcp://c:3000"] == 2.0
+    assert topo.weights["tcp://a:3000"] == 1.0
+    assert len(topo.all_peers()) == 3
+    with pytest.raises(KeyError):
+        topo.peers_of("rack9")
+
+
+@pytest.mark.parametrize("bad,match", [
+    ("rack1:tcp://a:1", "bad topology declaration"),
+    ("domain=rack1", "missing its"),
+    ("domain=:tcp://a:1", "missing its"),
+    ("domain=r:tcp://a:1;domain=r:tcp://b:1", "duplicate domain"),
+    ("domain=r1:tcp://a:1;domain=r2:tcp://a:1", "two domains"),
+    ("domain=r1:tcp://a:1*0", "must be > 0"),
+    ("domain=r1:,", "declares no peers"),
+    ("", "declares no domains"),
+])
+def test_topology_parse_rejects(bad, match):
+    with pytest.raises(ValueError, match=match):
+        Topology.parse(bad)
+
+
+def test_required_domains_per_code():
+    assert required_domains(4, 8) == 8  # RS: one domain per shard
+    # lrc:g needs one domain per group cell + one per global parity.
+    assert required_domains(8, 12, "lrc:2") == 2 + 2
+    assert required_domains(8, 14, "lrc:4") == 4 + 2
+
+
+def test_ring_rejects_bad_config():
+    with pytest.raises(ValueError, match="vnodes"):
+        PlacementRing(TOPO8, vnodes=0)
+    with pytest.raises(ValueError, match="unknown selector"):
+        PlacementRing(TOPO8, selector="rendezvous")
+    ring = PlacementRing(TOPO8)
+    with pytest.raises(ValueError, match="needs k"):
+        ring.owners("k0", 12, code="lrc:2")
+    with pytest.raises(ValueError, match="bad LRC geometry"):
+        ring.owners("k0", 12, k=7, code="lrc:2")  # g does not divide k
+
+
+# -------------------------------------------- determinism + distinctness
+
+
+def test_ring_determinism_across_processes():
+    """Same topology + seed ⇒ identical shard→peer maps in a separate
+    interpreter (the no-placement-gossip contract: every node computes
+    the ring independently and they must all agree)."""
+    spec = ";".join(
+        f"domain=d{j}:" + ",".join(f"tcp://h{j}x{i}:9" for i in range(3))
+        for j in range(8)
+    )
+    keys = [f"stripe-{i:04x}" for i in range(32)]
+    script = (
+        "import json, sys\n"
+        "from noise_ec_tpu.placement import PlacementRing, Topology\n"
+        "spec, sel = sys.argv[1], sys.argv[2]\n"
+        "ring = PlacementRing(Topology.parse(spec), seed=42, selector=sel)\n"
+        "keys = json.load(sys.stdin)\n"
+        "json.dump({k: ring.owners(k, 8, k=4) for k in keys}, sys.stdout)\n"
+    )
+    for selector in ("ring", "straw2"):
+        local = PlacementRing(
+            Topology.parse(spec), seed=42, selector=selector
+        )
+        expect = {k: local.owners(k, 8, k=4) for k in keys}
+        out = subprocess.run(
+            [sys.executable, "-c", script, spec, selector],
+            input=json.dumps(keys), capture_output=True, text=True,
+            check=True, timeout=120,
+        )
+        assert json.loads(out.stdout) == expect, selector
+
+
+@pytest.mark.parametrize("selector", ["ring", "straw2"])
+def test_ring_places_rs_shards_on_distinct_domains(selector):
+    ring = PlacementRing(TOPO8, seed=3, selector=selector)
+    for i in range(64):
+        key = f"obj-{i}"
+        owners = ring.owners(key, 8, k=4)
+        domains = [TOPO8.domain_of(tok) for tok in owners]
+        assert None not in owners
+        assert len(set(domains)) == 8, (key, domains)
+        assert ring.owner_domains(key, 8) == domains
+    # More shards than domains: tail slots stay UNPLACED, the ring
+    # never doubles a domain up — parity absorbs the gap.
+    owners = ring.owners("wide", 10, k=4)
+    assert owners[8:] == [None, None]
+    assert all(tok is not None for tok in owners[:8])
+
+
+def test_ring_lrc_groups_land_inside_one_domain():
+    """The Azure-LRC constraint: each local group's cell (data shards +
+    its local parity) shares ONE domain so a group heal never leaves
+    the rack; global parities spread over further distinct domains."""
+    ring = PlacementRing(TOPO8, seed=9)
+    for k, n, g in [(8, 12, 2), (8, 14, 4), (6, 10, 3)]:
+        code = f"lrc:{g}"
+        group = k // g
+        for i in range(24):
+            key = f"lrc-{k}-{g}-{i}"
+            domains = ring.owner_domains(key, n, k=k, code=code)
+            assert None not in domains, (key, domains)
+            cells = []
+            for j in range(g):
+                cell = {
+                    domains[s] for s in range(j * group, (j + 1) * group)
+                }
+                cell.add(domains[k + j])  # local parity j closes cell j
+                assert len(cell) == 1, (key, j, domains)
+                cells.append(cell.pop())
+            glob = domains[k + g:]
+            # Cells and globals occupy pairwise-distinct domains.
+            assert len(set(cells) | set(glob)) == g + len(glob)
+            # Owners agree with the domain layout.
+            owners = ring.owners(key, n, k=k, code=code)
+            for slot, tok in enumerate(owners):
+                assert TOPO8.domain_of(tok) == domains[slot]
+
+
+@pytest.mark.parametrize("selector", ["ring", "straw2"])
+def test_ring_leave_and_join_move_bound(selector):
+    """The consistent-hashing bound: one peer leaving moves EXACTLY the
+    slots it owned — nothing else re-homes — and that share is ~1/|domain
+    peers| of the domain's assignments. A re-join restores the original
+    map bit-for-bit (determinism again)."""
+    topo = Topology(
+        domains=(
+            ("da", tuple(f"a{i}" for i in range(10))),
+            ("db", tuple(f"b{i}" for i in range(10))),
+        ),
+        weights={},
+    )
+    ring = PlacementRing(topo, seed=1, selector=selector)
+    everyone = set(topo.all_peers())
+    keys = [f"m-{i}" for i in range(400)]
+    before = {k: ring.owners(k, 2, alive=everyone) for k in keys}
+    leaver = "a3"
+    shrunk = everyone - {leaver}
+    after = {k: ring.owners(k, 2, alive=shrunk) for k in keys}
+    moved = 0
+    for k in keys:
+        for slot, (old, new) in enumerate(zip(before[k], after[k])):
+            if old != new:
+                assert old == leaver, (k, slot, old, new)
+                moved += 1
+        assert ring.moved(k, 2, everyone, shrunk) == [
+            (slot, o, n) for slot, (o, n)
+            in enumerate(zip(before[k], after[k])) if o != n
+        ]
+    # ~1/10 of da's 400 slot assignments, with generous variance slack.
+    assert 0 < moved < 2.5 * len(keys) / 10, moved
+    rejoined = {k: ring.owners(k, 2, alive=everyone) for k in keys}
+    assert rejoined == before
+
+
+def test_ring_dead_domain_leaves_slot_unplaced():
+    """A whole-domain outage drops the domain from the order; with as
+    many domains as shards that leaves slots unplaced (None) rather
+    than doubling up a survivor — the distinctness invariant holds
+    under failure too."""
+    ring = PlacementRing(TOPO8, seed=5)
+    dead = set(TOPO8.peers_of("d2"))
+    alive = set(TOPO8.all_peers()) - dead
+    for i in range(32):
+        owners = ring.owners(f"x-{i}", 8, alive=alive)
+        assert owners.count(None) == 1, owners
+        placed = [tok for tok in owners if tok is not None]
+        assert not set(placed) & dead
+        assert len({TOPO8.domain_of(t) for t in placed}) == 7
+
+
+# ---------------------------------------------------------- token bucket
+
+
+def test_token_bucket_defers_and_refills():
+    now = [0.0]
+    bucket = TokenBucket(100.0, 1000, clock=lambda: now[0])
+    assert bucket.take(1000)  # full burst available
+    assert not bucket.take(1)  # dry: defer, never block
+    now[0] += 2.0  # 200 bytes refill
+    assert bucket.take(200)
+    assert not bucket.take(1)
+    now[0] += 1000.0  # refill clamps at burst
+    assert bucket.take(1000)
+    assert not bucket.take(1)
+    with pytest.raises(ValueError):
+        TokenBucket(0, 100)
+    with pytest.raises(ValueError):
+        TokenBucket(100, 0)
+
+
+# ----------------------------------------------------------- rebalancer
+
+
+def _rebalance_rig(*, rate=4 << 20, burst=8 << 20, clock=None):
+    """Three-domain rig: origin A holds full stripes; B1/B2 and C are
+    the remote owners. ``send`` delivers into the destination store's
+    placement absorb (the same idempotent path the wire uses)."""
+    topo = Topology(
+        domains=(("da", ("A",)), ("db", ("B1", "B2")), ("dc", ("C",))),
+        weights={},
+    )
+    ring = PlacementRing(topo, seed=2)
+    stores = {tok: StripeStore() for tok in topo.all_peers()}
+    wire = {"sends": 0}
+
+    def send(token, msgs):
+        wire["sends"] += len(msgs)
+        return all(
+            stores[token].note_placement_shard(m) for m in msgs
+        )
+
+    kwargs = {} if clock is None else {"clock": clock}
+    rb = Rebalancer(
+        stores["A"], ring, self_token="A", send=send,
+        rate_bytes_per_s=rate, burst_bytes=burst, **kwargs,
+    )
+    rng = np.random.default_rng(6)
+    keys = [
+        stores["A"].put_object(
+            hashlib.blake2b(b"pl%d" % i, digest_size=64).digest(),
+            rng.bytes(4096), 2, 3,
+        )
+        for i in range(6)
+    ]
+    return topo, ring, stores, rb, keys, wire
+
+
+def test_rebalancer_moves_only_the_delta_and_memoizes():
+    topo, ring, stores, rb, keys, wire = _rebalance_rig()
+    stats = rb.run_cycle()
+    assert stats["examined"] == len(keys)
+    assert stats["deferred"] == 0
+    # Every non-self-owned slot moved to exactly its ring owner.
+    expect = 0
+    for key in keys:
+        for slot, tok in enumerate(ring.owners(key, 3, k=2)):
+            if tok == "A":
+                continue
+            expect += 1
+            meta, shards, _ = stores[tok].snapshot(key)
+            assert shards[slot] is not None, (key, slot, tok)
+    assert stats["moved"] == expect == wire["sends"]
+    assert rb.bytes_moved == expect * 2048
+    # Converged: the memo makes the next cycle a no-op.
+    assert rb.run_cycle()["moved"] == 0
+    assert wire["sends"] == expect
+    # One peer down inside db: only db-owned slots whose pick was the
+    # dead peer re-home, onto the surviving db member.
+    rb.note_down("B1")
+    alive = set(topo.all_peers()) - {"B1"}
+    delta = sum(
+        len(ring.moved(k, 3, set(topo.all_peers()), alive, k=2))
+        for k in keys
+    )
+    stats2 = rb.run_cycle()
+    assert stats2["moved"] == delta > 0
+    for key in keys:
+        for slot, tok in enumerate(ring.owners(key, 3, k=2, alive=alive)):
+            if tok in (None, "A"):
+                continue
+            _, shards, _ = stores[tok].snapshot(key)
+            assert shards[slot] is not None
+
+
+def test_rebalancer_token_bucket_bounds_each_cycle():
+    """A dry bucket defers the remainder to later cycles instead of
+    flooding: per-cycle bytes stay under burst + one refill, and the
+    deferred counter shows the backoff; convergence still completes as
+    the bucket refills."""
+    now = [0.0]
+    _, _, stores, rb, keys, wire = _rebalance_rig(
+        rate=2048.0, burst=2048, clock=lambda: now[0]
+    )
+    deferred_total = 0
+    cycles = 0
+    while cycles < 40:
+        moved_before = rb.bytes_moved
+        stats = rb.run_cycle()
+        assert rb.bytes_moved - moved_before <= 2048 * 2
+        deferred_total += stats["deferred"]
+        cycles += 1
+        if not stats["moved"] and not stats["deferred"]:
+            break
+        now[0] += 1.0  # one second: one shard's worth of refill
+    assert deferred_total > 0  # the bound actually engaged
+    assert counter_total("noise_ec_placement_moves_total") > 0
+    # Converged despite the bound: every remote owner holds its slot.
+    assert rb.run_cycle() == {
+        "examined": len(keys), "moved": 0, "deferred": 0, "dropped": 0,
+    }
+
+
+def test_rebalancer_crash_mid_move_restart_converges_without_orphans():
+    """The crash contract: the send memo is in-memory only, so a
+    rebalancer that dies mid-cycle forgets and re-pushes — absorbs are
+    idempotent, the restarted mover converges to exactly the ring
+    assignment, and no destination holds a slot the ring does not name
+    there (no orphans)."""
+    class Boom(Exception):
+        pass
+
+    topo, ring, stores, rb, keys, wire = _rebalance_rig()
+    crashes = iter([None, None, "boom"])
+
+    def fault():
+        if next(crashes, None):
+            raise Boom()
+
+    rb.fault_mid_move = fault
+    with pytest.raises(Boom):
+        rb.run_cycle()
+    moved_before_crash = rb.bytes_moved
+    assert moved_before_crash == 2 * 2048  # died on the third move
+    # "Restart": a fresh Rebalancer with an empty memo re-runs.
+    rb2 = Rebalancer(
+        stores["A"], ring, self_token="A",
+        send=lambda tok, msgs: all(
+            stores[tok].note_placement_shard(m) for m in msgs
+        ),
+    )
+    stats = rb2.run_cycle()
+    assert stats["deferred"] == 0
+    assert rb2.run_cycle()["moved"] == 0  # converged
+    # Exactly the assignment, nothing extra anywhere: each remote
+    # store holds precisely the slots the ring names for it.
+    for tok in ("B1", "B2", "C"):
+        for key in stores[tok].keys():
+            _, shards, _ = stores[tok].snapshot(key)
+            held = {i for i, b in enumerate(shards) if b is not None}
+            owned = {
+                slot for slot, owner
+                in enumerate(ring.owners(key, 3, k=2)) if owner == tok
+            }
+            assert held == owned, (tok, key, held, owned)
+
+
+def test_rebalancer_background_thread_wakes_on_membership():
+    import time as _time
+
+    _, ring, stores, rb, keys, wire = _rebalance_rig()
+    rb.start(interval_seconds=30.0)  # only wakes matter in this test
+    try:
+        deadline = _time.monotonic() + 10
+        while wire["sends"] == 0 and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+        assert wire["sends"] > 0  # initial dirt drained without a tick
+        sends_settled = wire["sends"]
+        rb.note_down("B1")  # membership wake, not the 30 s tick
+        deadline = _time.monotonic() + 10
+        while wire["sends"] == sends_settled and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+        assert wire["sends"] > sends_settled
+    finally:
+        rb.close()
+    assert rb._thread is not None and not rb._thread.is_alive()
+
+
+def test_migrate_manifest_crash_contract():
+    """Whole-object re-homing rides convert.py's contract: a crash
+    before the swap reproduces identical stripe keys on re-run (no
+    duplicates), a crash after it leaves only the prev_stripes marker
+    that the next call converges — never an orphan stripe — and the
+    object's bytes survive byte-identical."""
+    class Boom(Exception):
+        pass
+
+    def die():
+        raise Boom()
+
+    ADDR = hashlib.blake2b(b"obj", digest_size=16).hexdigest()
+    store = StripeStore()
+    rng = np.random.default_rng(13)
+    payload = rng.bytes(10000)
+    topo = Topology(domains=(("da", ("A",)),), weights={})
+    rb = Rebalancer(
+        store, PlacementRing(topo, seed=0), self_token="A",
+        send=lambda tok, msgs: True,
+    )
+    capacity, k, n = 4096, 4, 6
+    old_keys = []
+    for idx in range(3):
+        chunk = payload[idx * capacity:(idx + 1) * capacity]
+        chunk += bytes((-len(chunk)) % k)
+        sig = hashlib.blake2b(b"src%d" % idx, digest_size=64).digest()
+        old_keys.append(store.put_object(sig, chunk, k, n))
+    store.put_manifest(ADDR, {
+        "stripes": old_keys, "size": len(payload),
+        "stripe_bytes": capacity, "k": k, "n": n,
+        "field": "gf256", "code": "rs",
+    })
+
+    def read_back():
+        doc = store.get_manifest(ADDR)
+        parts = []
+        for idx, key in enumerate(doc["stripes"]):
+            logical = min(capacity, len(payload) - idx * capacity)
+            parts.append(store.read(key)[:logical])
+        return b"".join(parts)
+
+    # Crash BEFORE the swap: old manifest intact, re-run overwrites the
+    # deterministically-derived new stripes in place (same count).
+    rb.fault_before_swap = die
+    with pytest.raises(Boom):
+        rb.migrate_manifest(ADDR, epoch=7)
+    assert store.get_manifest(ADDR)["stripes"] == old_keys
+    keys_after_crash = set(store.keys())
+    rb.fault_before_swap = None
+    # Crash AFTER the swap: marker left, sources still present.
+    rb.fault_after_swap = die
+    with pytest.raises(Boom):
+        rb.migrate_manifest(ADDR, epoch=7)
+    doc = store.get_manifest(ADDR)
+    assert doc["prev_stripes"] == old_keys
+    assert doc["placement_epoch"] == 7
+    assert set(store.keys()) == keys_after_crash  # same keys: no dupes
+    rb.fault_after_swap = None
+    # The next call converges the marker and GCs the orphan sources.
+    assert rb.migrate_manifest(ADDR, epoch=7)
+    doc = store.get_manifest(ADDR)
+    assert "prev_stripes" not in doc
+    assert set(doc["stripes"]) == set(store.keys())
+    for key in old_keys:
+        assert key not in store.keys()
+    assert read_back() == payload
+    # Idempotent at the target epoch.
+    assert rb.migrate_manifest(ADDR, epoch=7)
+    assert counter_total("noise_ec_placement_moves_total") >= 3
+
+
+# ------------------------------------------------- fleet profile grammar
+
+
+def test_fleet_profile_domains_grammar():
+    from noise_ec_tpu.fleet import FleetProfile
+
+    prof = FleetProfile.parse(
+        "peers=16,fanout=4,object=1,k=4,n=8,domains@8,killdomain@2:d3"
+    )
+    assert prof.domains == 8
+    assert prof.domain_kills == ((2.0, "d3"),)
+
+
+@pytest.mark.parametrize("spec,match", [
+    # RS n=8 needs 8 distinct domains; 7 can never place every stripe.
+    ("peers=16,k=4,n=8,domains@7", "cannot cover"),
+    ("peers=6,fanout=2,k=4,n=8,domains@8", "exceeds peers"),
+    ("peers=16,k=4,n=8,domains@0", "must be >= 1"),
+    ("peers=16,k=4,n=8,killdomain@1:d0", "requires a domains@"),
+    ("peers=16,k=4,n=8,domains@8,killdomain@1:d9", "unknown domain"),
+    ("peers=16,k=4,n=8,domains@8,killdomain@-1:d0", "must be >= 0"),
+    ("peers=16,k=4,n=8,domains@8,killdomain@1", "wants T:NAME"),
+])
+def test_fleet_profile_domains_grammar_rejects(spec, match):
+    from noise_ec_tpu.fleet import FleetProfile
+
+    with pytest.raises(ValueError, match=match):
+        FleetProfile.parse(spec)
+
+
+# --------------------------------------------------- fleet acceptance
+
+
+def _drive_objects(lab, *, count, rng):
+    """Submit ``count`` object puts round-robin over the up peers and
+    return the scorer's (tenant, name, digest) ledger."""
+    si = 0
+    submitted = 0
+    while submitted < count:
+        peer = lab.peers[si % len(lab.peers)]
+        si += 1
+        if not peer.up or peer.objects is None:
+            continue
+        if lab.submit_object(peer, rng) is not None:
+            submitted += 1
+    lab._wait_drained(20.0)
+    with lab._obj_lock:
+        return list(lab._put_objects)
+
+
+def test_fleet_killdomain_zero_loss_byte_identical_get(lockgraph):
+    """The tier-1 placement acceptance bar: with declared failure
+    domains, killing EVERY peer of one domain at once loses zero
+    objects — every up peer that replicated the manifest still serves
+    every object byte-identical (no stripe ever had two shards in one
+    domain, so the kill costs at most one shard per stripe, well
+    inside parity)."""
+    from noise_ec_tpu.fleet import FleetLab, FleetProfile
+
+    prof = FleetProfile.parse(
+        "peers=16,fanout=4,msgs=1,object=1,object_bytes=8192,"
+        "stripe_bytes=4096,k=4,n=8,chaos=clean,domains@8"
+    )
+    lab = FleetLab(prof, seed=21)
+    lab.start()
+    try:
+        assert lab.ring is not None
+        rng = np.random.default_rng(4)
+        objects = _drive_objects(lab, count=12, rng=rng)
+        assert len(objects) == 12
+        downed = lab.kill_domain("d3")
+        assert downed == 2  # 16 peers round-robin over 8 domains
+        verified = 0
+        for tenant, name, digest in objects:
+            for peer in lab.peers:
+                if not peer.up or peer.objects is None:
+                    continue
+                try:
+                    data = peer.objects.read(tenant, name, shed=False)
+                except Exception:  # noqa: BLE001 — this peer never got
+                    continue  # the manifest (bounded-degree overlay)
+                assert hashlib.blake2b(
+                    data, digest_size=16
+                ).digest() == digest, (tenant, name, peer.idx)
+                verified += 1
+        # Zero loss: every object verified somewhere, and widely.
+        assert verified >= len(objects), verified
+        # The drill counts as churn in scoring, like churn@ kills.
+        assert counter_total("noise_ec_fleet_churn_events_total") >= 2
+    finally:
+        lab.close()
+
+
+def test_fleet_targeted_delivery_cuts_wire_to_n_not_peers(lockgraph):
+    """The peers×→n× wire cut on a 50-peer fleet, asserted via
+    counters: the same seeded object-only run twice — broadcast
+    baseline vs domains@8 targeted — shares the manifest-broadcast
+    component, so the wire-send difference isolates the data-stripe
+    fanout; targeted data sends land near the n-shards ideal instead
+    of n×fanout, and the saved deliveries counter records the win."""
+    from noise_ec_tpu.fleet import FleetLab, FleetProfile
+
+    base = (
+        "peers=50,fanout=6,msgs=30,object=1,object_bytes=8192,"
+        "stripe_bytes=4096,k=4,n=8,chaos=clean"
+    )
+    reports = {}
+    for tag, spec in [("bcast", base), ("ring", base + ",domains@8")]:
+        saved0 = counter_total("noise_ec_placement_fanout_saved_total")
+        lab = FleetLab(FleetProfile.parse(spec), seed=17)
+        lab.start()
+        try:
+            reports[tag] = lab.run()
+        finally:
+            lab.close()
+        reports[tag]["saved"] = (
+            counter_total("noise_ec_placement_fanout_saved_total") - saved0
+        )
+    assert reports["bcast"]["delivery"]["rate"] >= 0.999
+    assert reports["ring"]["delivery"]["rate"] >= 0.999
+    assert reports["bcast"]["saved"] == 0  # no ring, nothing targeted
+    assert reports["ring"]["saved"] > 0
+    puts_b = reports["bcast"]["objects"]["puts"]
+    puts_t = reports["ring"]["objects"]["puts"]
+    assert puts_b > 0 and puts_t > 0
+    per_put_b = reports["bcast"]["wire_sends"] / puts_b
+    per_put_t = reports["ring"]["wire_sends"] / puts_t
+    # 8192-byte objects over 4096-byte stripes: 2 data stripes/put.
+    stripes, n_sh, fanout = 2, 8, 6
+    ideal = stripes * n_sh
+    data_t = per_put_t - per_put_b + ideal * fanout
+    ratio = data_t / ideal
+    # Broadcast pays n×fanout per put; targeted must land near n (the
+    # bench_gate bars placement_fanout_ratio at 1.5× ideal).
+    assert ratio < 1.5, (ratio, per_put_b, per_put_t)
+    assert per_put_t < per_put_b
+    # The report carries the placement census block for scoring.
+    assert reports["ring"]["placement"]["domains"] == 8
+
+
+def test_fleet_churn_rebalance_converges_with_bounded_cycles(lockgraph):
+    """Whole-domain kill then rebalance: the movers converge within the
+    cycle budget even under a tight token bucket (deferred remainders
+    carry over), the census settles onto surviving domains only, and
+    the moved bytes stay within a small multiple of the exact
+    ownership delta the ring reports."""
+    from noise_ec_tpu.fleet import FleetLab, FleetProfile
+
+    prof = FleetProfile.parse(
+        "peers=16,fanout=4,msgs=1,object=1,object_bytes=8192,"
+        "stripe_bytes=4096,k=4,n=8,chaos=clean,domains@8"
+    )
+    lab = FleetLab(
+        prof, seed=29,
+        rebalance_rate_bytes_per_s=256 << 10,
+        rebalance_burst_bytes=64 << 10,
+    )
+    lab.start()
+    try:
+        rng = np.random.default_rng(8)
+        _drive_objects(lab, count=10, rng=rng)
+        first = lab.rebalance_until_converged(max_cycles=24)
+        assert first["moved"] == 0 and first["deferred"] == 0
+        alive_before = {f"fleet://{p.idx}" for p in lab.peers if p.up}
+        lab.kill_domain("d5")
+        alive_after = {f"fleet://{p.idx}" for p in lab.peers if p.up}
+        metas = {}
+        for p in lab.peers:
+            if p.store is None:
+                continue
+            for key in p.store.keys():
+                if key not in metas:
+                    metas[key] = p.store.snapshot(key)[0]
+        ideal = sum(
+            len(lab.ring.moved(
+                key, meta.n, alive_before, alive_after,
+                k=meta.k, code=meta.code,
+            )) * meta.shard_len
+            for key, meta in metas.items()
+        )
+        moved0 = sum(rb.bytes_moved for rb in lab.rebalancers.values())
+        stats = lab.rebalance_until_converged(max_cycles=24)
+        assert stats["moved"] == 0 and stats["deferred"] == 0
+        moved = stats["bytes_moved"] - moved0
+        assert ideal > 0 and moved > 0
+        # Per-node movers share no memo, so independent holders can
+        # push the same re-homed slot — bounded, not unbounded.
+        assert moved <= 4 * ideal, (moved, ideal)
+        census = lab.placement_census()
+        assert census.get("d5", 0) == 0  # nothing counted on the dead
+        assert sum(census.values()) > 0
+    finally:
+        lab.close()
+
+
+# --------------------------------------------- no-topology fallback
+
+
+def test_no_topology_targeted_send_is_identical_to_broadcast():
+    """``targeted=True`` with no directed transport surface (and with
+    no placement policy at all) degrades to the exact broadcast the
+    pre-placement plugin made — same frames, byte for byte."""
+    from noise_ec_tpu.host.crypto import KeyPair
+    from noise_ec_tpu.host.plugin import ShardPlugin
+    from noise_ec_tpu.host.transport import (
+        LoopbackHub, LoopbackNetwork, format_address,
+    )
+
+    payload = np.random.default_rng(3).bytes(4096)
+
+    def capture(placement):
+        hub = LoopbackHub()
+        node = LoopbackNetwork(
+            hub, format_address("tcp", "localhost", 4411),
+            keys=KeyPair.from_seed(bytes(32)),
+        )
+        plugin = ShardPlugin(backend="numpy")
+        node.add_plugin(plugin)
+        frames = []
+        node.broadcast_many = lambda msgs: frames.extend(
+            m.marshal() for m in msgs
+        )
+        if placement:
+            topo = Topology.parse("domain=d0:tcp://localhost:4411")
+            plugin.placement = TargetedDelivery(
+                PlacementRing(topo, seed=0),
+                self_token="tcp://localhost:4411",
+            )
+            # LoopbackNetwork has no placement_directory/send_many_to:
+            # the policy's send() must bail and fall back.
+            assert plugin.placement.send(node, []) is None
+        plugin.shard_and_broadcast(
+            node, payload, geometry=(4, 8), targeted=True
+        )
+        return frames
+
+    assert capture(False) == capture(True)

@@ -116,6 +116,11 @@ HOST_PREFIXES = (
     # thread — the noisiest stat in the file; host tolerance, and its
     # "_ratio" suffix already flips it to lower-better.
     "tenant_",
+    # Placement-ring fleet stats (targeted-delivery fanout, rebalance
+    # amplification) run a whole in-process fleet through the Python
+    # service layer — host tolerance; their "_ratio"/"_amplification"
+    # suffixes flip them to lower-better.
+    "placement_",
 )
 
 # The ISSUE-12 hot-read acceptance bars (cache_hot_check, fresh runs):
@@ -150,6 +155,13 @@ WIRE_RIG_MBPS_FACTOR = 4.0
 # exempt (interpret-mode panel routing is deliberately narrower).
 PANEL_RIG_RS200_GBPS = 150.0
 PANEL_RIG_DECODE_RATIO_MAX = 1.25
+
+# The ISSUE-17 placement acceptance bar (placement_rig_check, fresh
+# runs): targeted delivery must keep per-message data-shard wire sends
+# within 1.5x of the n-shard ideal — above it the ring is leaking
+# broadcast traffic and the peers-to-n fanout cut is not real
+# (docs/placement.md).
+PLACEMENT_FANOUT_RATIO_MAX = 1.5
 
 
 def metric_direction(name: str) -> str | None:
@@ -327,6 +339,40 @@ def lrc_repair_check(stats: dict) -> list[str]:
             "acceptance bar)"
         ]
     return []
+
+
+def placement_rig_check(stats: dict) -> list[str]:
+    """ISSUE-17 acceptance bars for the placement ring, fresh runs only
+    (recorded rounds before the placement subsystem genuinely lack the
+    keys). Two bars — ``placement_fanout_ratio`` (targeted-delivery
+    data sends per message over the n-shard ideal, docs/placement.md)
+    must stay <= 1.5x ideal, and ``rebalance_amplification`` (bytes the
+    rebalancer moved over the ideal ownership-delta bytes) is gated
+    lower-better by its suffix; here it only has to be finite and
+    positive to prove the churn drill converged."""
+    problems = []
+    try:
+        ratio = float(stats["placement_fanout_ratio"])
+    except (KeyError, TypeError, ValueError):
+        ratio = None
+    if ratio is not None and ratio > PLACEMENT_FANOUT_RATIO_MAX:
+        problems.append(
+            f"placement_fanout_ratio {ratio} above the "
+            f"{PLACEMENT_FANOUT_RATIO_MAX} bar — targeted delivery is "
+            "sending data shards beyond their ring owners "
+            "(docs/placement.md; the peers-to-n fanout contract)"
+        )
+    try:
+        amp = float(stats["rebalance_amplification"])
+    except (KeyError, TypeError, ValueError):
+        return problems
+    if not amp > 0:
+        problems.append(
+            f"rebalance_amplification {amp} is not a positive ratio — "
+            "the churn rebalance drill did not move (or did not "
+            "measure) the ownership delta"
+        )
+    return problems
 
 
 def panel_rig_check(stats: dict, repo: Path = REPO) -> list[str]:
@@ -624,6 +670,7 @@ def main(argv: list[str] | None = None) -> int:
         problems.extend(cache_hot_check(current))
         problems.extend(lrc_repair_check(current))
         problems.extend(panel_rig_check(current))
+        problems.extend(placement_rig_check(current))
     if args.json:
         print(json.dumps(
             {"against": against_name, "findings": findings,
