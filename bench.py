@@ -849,6 +849,59 @@ def main() -> None:
             d_hits / max(1.0, d_hits + d_miss), 4
         )
 
+        # --- request-tracing overhead: the same hot zipfian GET mix
+        # with the tail sampler ARMED (default sample_n) vs tracing
+        # disabled entirely, alternated so cache state is identical for
+        # both legs. trace_overhead_pct rides tools/bench_gate.py
+        # trace_overhead_check (<= 3%) on fresh runs; trace_keep_rate
+        # is the armed legs' kept share off the
+        # noise_ec_trace_requests_total{decision} deltas (clean-path
+        # requests sample 1-in-sample_n, so this sits near 1/sample_n
+        # plus the slow/error tail).
+        from noise_ec_tpu.obs.trace import default_tracer as _dt
+
+        tracer = _dt()
+        req_fam = _reg().counter("noise_ec_trace_requests_total")
+
+        def _trace_decisions() -> dict[str, float]:
+            return {
+                values[0]: float(child.value)
+                for values, child in req_fam.children()
+            }
+
+        def _hot_pass() -> float:
+            t0 = time.perf_counter()
+            for z in zipf_draws[32:]:
+                name_z = f"hot{(int(z) - 1) % n_obj}"
+                _, _, chunks_z = hot_objects.get_range("bench", name_z)
+                for _ in chunks_z:
+                    pass
+            return time.perf_counter() - t0
+
+        was_enabled = tracer.enabled
+        t_off = t_armed = float("inf")
+        before_d = _trace_decisions()
+        for _ in range(3):
+            tracer.enabled = False
+            t_off = min(t_off, _hot_pass())
+            tracer.enabled = True
+            t_armed = min(t_armed, _hot_pass())
+        after_d = _trace_decisions()
+        tracer.enabled = was_enabled
+        stats["trace_overhead_pct"] = round(
+            max(0.0, (t_armed - t_off) / t_off * 100.0), 2
+        )
+        req_total = sum(
+            after_d.get(k, 0.0) - before_d.get(k, 0.0) for k in after_d
+        )
+        req_kept = sum(
+            after_d.get(k, 0.0) - before_d.get(k, 0.0)
+            for k in after_d if k.startswith("kept")
+        )
+        stats["trace_keep_rate"] = (
+            round(req_kept / req_total, 4) if req_total else 0.0
+        )
+
         # --- tenant isolation: per-tenant GET p99 attribution off the
         # labeled noise_ec_object_op_seconds{tenant,op,route} histogram
         # (docs/object-service.md "Tenant attribution"). Two phases on

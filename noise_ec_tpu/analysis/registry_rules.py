@@ -338,6 +338,15 @@ SUBSYSTEM_DOCS: dict[str, dict] = {
         "tokens": ("Tenant attribution", "object_get_p99_ms",
                    "tenant_isolation_p99_ratio"),
     },
+    "request-tracing": {
+        "doc": "docs/observability.md",
+        "prefixes": ("noise_ec_trace_",),
+        "extras": (),
+        "tokens": ("Request tracing", "X-NoiseEC-Trace", "request_trace",
+                   "trace_id=", "--op", "hold_max_bytes", "sample_n",
+                   "trace_overhead_pct", "trace_keep_rate",
+                   "span-coverage"),
+    },
     "placement": {
         "doc": "docs/placement.md",
         "prefixes": ("noise_ec_placement_",),

@@ -29,6 +29,14 @@ Each rule encodes an invariant this codebase already paid to learn
   explicit ``bytes()`` copy. (``get_buffer`` returning the writable
   tail is the BufferedProtocol contract — the loop owns that view for
   exactly one fill — and is exempt.)
+
+- **span-coverage** — every handler mounted on an ``/objects`` route
+  (the object-service route table on the stats server) must open a
+  request span (``trace_request(...)`` / ``request(...)``) in its
+  body: an untraced route is invisible to the tail sampler, carries no
+  exemplars and never joins the collector-merged fleet view. A
+  deliberately untraced route takes a
+  ``# noise-ec: allow(span-coverage)`` suppression on its mount line.
 """
 
 from __future__ import annotations
@@ -40,6 +48,7 @@ from noise_ec_tpu.analysis.core import (
     Finding,
     SourceFile,
     call_name,
+    const_str,
     dotted,
     rule,
 )
@@ -497,6 +506,91 @@ def check_donation(sf: SourceFile):
                     "before donating",
                 )
                 break  # one finding per donated name
+
+
+# ------------------------------------------------------------ span coverage
+
+
+# The route prefix the object-service request-tracing contract covers
+# (docs/observability.md "Request tracing"): handlers on these routes
+# are the request roots the tail sampler, exemplars and collector merge
+# all key off.
+_TRACED_ROUTE_PREFIX = "/objects"
+# Call names that open a request scope: the module-level helper under
+# either of its import spellings, and the tracer method.
+_REQUEST_OPENERS = {"request", "trace_request"}
+
+
+def _opens_request_span(fn: ast.AST) -> bool:
+    """True when ``fn``'s own body (nested defs excluded — a scope
+    opened inside a closure does not cover the handler) calls a request
+    opener, bare or as a method (``tracer.request``)."""
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Call) \
+                and call_name(node) in _REQUEST_OPENERS:
+            return True
+    return False
+
+
+@rule(
+    "span-coverage",
+    scope="file",
+    invariant="every handler mounted on an /objects route opens a "
+              "request span (trace_request/request) in its body",
+    motivation="PR 18 (tail-sampled request tracing: an untraced route "
+               "records no request root, so it is invisible to the "
+               "sampler, carries no exemplars and never joins the "
+               "collector-merged fleet trace)",
+)
+def check_span_coverage(sf: SourceFile):
+    module_defs = {
+        n.name: n for n in sf.tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    # Innermost enclosing class per node (inner classes walk later and
+    # overwrite the outer assignment).
+    cls_of: dict[int, ast.ClassDef] = {}
+    for cls in ast.walk(sf.tree):
+        if isinstance(cls, ast.ClassDef):
+            for sub in ast.walk(cls):
+                cls_of[id(sub)] = cls
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call) or call_name(node) != "mount":
+            continue
+        if len(node.args) < 3:
+            continue
+        path = const_str(node.args[1])
+        if path is None or not path.startswith(_TRACED_ROUTE_PREFIX):
+            continue
+        hexpr = node.args[2]
+        handler = None
+        if isinstance(hexpr, ast.Attribute) \
+                and isinstance(hexpr.value, ast.Name) \
+                and hexpr.value.id == "self":
+            cls = cls_of.get(id(node))
+            if cls is not None:
+                for item in cls.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) \
+                            and item.name == hexpr.attr:
+                        handler = item
+                        break
+        elif isinstance(hexpr, ast.Name):
+            handler = module_defs.get(hexpr.id)
+        if handler is None:
+            continue  # dynamic handler — unresolvable statically
+        if _opens_request_span(handler):
+            continue
+        hname = getattr(handler, "name", "?")
+        yield Finding(
+            "span-coverage", sf.rel, node.lineno,
+            f"handler {hname}() mounted on traced route {path!r} opens "
+            "no request span — the route is invisible to the tail "
+            "sampler and the collector-merged trace; wrap the handler "
+            "body in trace_request(op, ...) or suppress with "
+            "# noise-ec: allow(span-coverage) for a deliberately "
+            "untraced route",
+        )
 
 
 # ---------------------------------------------------------------- zero-copy

@@ -40,7 +40,18 @@ from noise_ec_tpu.host.wire import Shard
 from noise_ec_tpu.obs.health import SLOEvaluator, record_e2e
 from noise_ec_tpu.obs.metrics import Counters, Timer
 from noise_ec_tpu.obs.registry import default_registry
-from noise_ec_tpu.obs.trace import span, trace_key
+from noise_ec_tpu.obs.trace import current_trace_id, span, trace_key
+
+
+def _request_attrs(ctx=None) -> dict:
+    """``{"request_trace": <id>}`` when the work runs inside a traced
+    user request — on the send path read from the thread-local request
+    scope, on the receive path from the delivery ``Ctx`` (the SHARD_BATCH
+    frame's propagated trace block). The attr is what lets a collector
+    merge signature-keyed pipeline spans into the originating request's
+    fleet-wide trace; ``{}`` keeps untraced spans byte-identical."""
+    rt = getattr(ctx, "trace", None) if ctx is not None else current_trace_id()
+    return {"request_trace": rt} if rt else {}
 
 __all__ = [
     "ShardPlugin",
@@ -495,6 +506,7 @@ class ShardPlugin:
             "broadcast",
             key=trace_key(shards[0].file_signature),
             shards=len(shards),
+            **_request_attrs(),
         ):
             placed = None
             if targeted and self.placement is not None:
@@ -531,7 +543,8 @@ class ShardPlugin:
         """
         if not input_bytes:
             raise ValueError("cannot prepare shards for empty input")  # main.go:215-217
-        with span("prepare", nbytes=len(input_bytes)) as psp:
+        with span("prepare", nbytes=len(input_bytes),
+                  **_request_attrs()) as psp:
             if geometry is not None:
                 k, n = geometry
                 if not 1 <= k <= n <= self.max_total_shards:
@@ -1052,7 +1065,7 @@ class ShardPlugin:
         pool_key = f"{key}:{index}"
         try:
             with span("reassemble", key=trace_key(msg.file_signature),
-                      chunk=index):
+                      chunk=index, **_request_attrs(ctx)):
                 snapshot, distinct, was_new = self.pool.add(
                     pool_key, share, k, n
                 )
@@ -1130,7 +1143,7 @@ class ShardPlugin:
         decode_nbytes = sum(len(s.data) for s in snapshot)
         try:
             with span("decode", key=trace_key(msg.file_signature),
-                      chunk=index), \
+                      chunk=index, **_request_attrs(ctx)), \
                     Timer(self.counters, "decode_s", nbytes=decode_nbytes,
                           histogram=self._decode_hist):
                 chunk = fec.decode(snapshot)
@@ -1208,7 +1221,7 @@ class ShardPlugin:
             st0 = self._streams.get(key)
             started = st0["created"] if st0 is not None else None
         with span("verify", key=trace_key(msg.file_signature),
-                  nbytes=len(complete)):
+                  nbytes=len(complete), **_request_attrs(ctx)):
             ok = verify_parts(
                 self.signature_policy,
                 self.hash_policy,
@@ -1576,7 +1589,8 @@ class ShardPlugin:
                 f"shard number {msg.shard_number} out of range for n={n}"
             )
         try:
-            with span("reassemble", key=trace_key(msg.file_signature)):
+            with span("reassemble", key=trace_key(msg.file_signature),
+                      **_request_attrs(ctx)):
                 snapshot, distinct, was_new = self.pool.add(key, share, k, n)
         except PoolTooLargeError:
             self.counters.add("pool_overflows", 1)
@@ -1607,7 +1621,8 @@ class ShardPlugin:
         self._geometry_decode_begin(k, n)
         decode_nbytes = sum(len(s.data) for s in snapshot)
         try:
-            with span("decode", key=trace_key(msg.file_signature), k=k, n=n), \
+            with span("decode", key=trace_key(msg.file_signature), k=k, n=n,
+                      **_request_attrs(ctx)), \
                     Timer(self.counters, "decode_s", nbytes=decode_nbytes,
                           histogram=self._decode_hist):
                 complete = fec.decode(snapshot)
@@ -1635,7 +1650,8 @@ class ShardPlugin:
         self.counters.add("decodes", 1)
 
         sender = ctx.sender()
-        with span("verify", key=trace_key(msg.file_signature)):
+        with span("verify", key=trace_key(msg.file_signature),
+                  **_request_attrs(ctx)):
             ok = verify(
                 self.signature_policy,
                 self.hash_policy,

@@ -67,7 +67,7 @@ from noise_ec_tpu.host.crypto import (
 from noise_ec_tpu.host.wire import Shard, WireError
 from noise_ec_tpu.obs.metrics import Timer
 from noise_ec_tpu.obs.registry import default_registry
-from noise_ec_tpu.obs.trace import span, trace_key
+from noise_ec_tpu.obs.trace import current_trace_id, span, trace_key
 
 __all__ = [
     "Ctx",
@@ -432,11 +432,20 @@ class _WireConn(asyncio.BufferedProtocol):
 
 
 class Ctx:
-    """Plugin context handed to ``plugin.receive`` on every delivery."""
+    """Plugin context handed to ``plugin.receive`` on every delivery.
 
-    def __init__(self, msg: object, sender: PeerID):
+    ``trace`` is the originating request's trace id when the delivery
+    arrived inside a traced user request (the SHARD_BATCH trailing
+    trace block, or the loopback's same-thread request scope) — the
+    receive path stamps it as a ``request_trace`` span attr so a
+    collector can merge receive-side pipeline spans into the
+    originator's request trace. None for untraced traffic."""
+
+    def __init__(self, msg: object, sender: PeerID,
+                 trace: Optional[str] = None):
         self._msg = msg
         self._sender = sender
+        self.trace = trace
 
     def message(self) -> object:
         return self._msg
@@ -617,8 +626,13 @@ class LoopbackNetwork:
             self._record_error(exc)
             return
         metrics.record_in(sender.address, len(wire_bytes))
-        ctx = Ctx(msg, sender)
-        with span("deliver", key=trace_key(msg.file_signature)):
+        # Synchronous fan-out: delivery runs on the SENDER's thread, so
+        # the originating request scope is still active here — adopt its
+        # id, the loopback equivalent of the SHARD_BATCH trace block.
+        rt = current_trace_id()
+        ctx = Ctx(msg, sender, trace=rt)
+        with span("deliver", key=trace_key(msg.file_signature),
+                  **({"request_trace": rt} if rt else {})):
             for plugin in self.plugins:
                 try:
                     plugin.receive(ctx)
@@ -668,10 +682,21 @@ def _sign_preimage(opcode: int, addr: bytes, payload: bytes) -> bytes:
     )
 
 
-def _encode_shard_batch_parts(msgs) -> list:
+# Request-trace ids are ``req-<16 hex>`` (20 chars); the cap keeps a
+# hostile frame from smuggling bulk data through the trace block.
+_MAX_TRACE_LEN = 64
+
+
+def _encode_shard_batch_parts(msgs, trace: Optional[str] = None) -> list:
     """SHARD_BATCH payload as scatter-gather parts: each shard's
     ``marshal_parts`` buffers ride through unjoined, so the dominant
-    ``shard_data`` is never copied on the send path."""
+    ``shard_data`` is never copied on the send path.
+
+    ``trace`` (the originating request's trace id, when the cohort is
+    sent inside a traced user request) rides as an OPTIONAL trailing
+    ``u32 len | utf-8`` block after the shards — absent entirely for
+    untraced traffic, so the frame stays byte-identical to the pre-
+    trace wire format in that case and old decoders never see it."""
     parts = [struct.pack("<I", len(msgs))]
     for m in msgs:
         head, data, tail = m.marshal_parts()
@@ -684,11 +709,19 @@ def _encode_shard_batch_parts(msgs) -> list:
             parts.append(data)
         if tail:
             parts.append(tail)
+    if trace:
+        raw = trace.encode()[:_MAX_TRACE_LEN]
+        parts.append(struct.pack("<I", len(raw)))
+        parts.append(raw)
     return parts
 
 
-def _decode_shard_batch(payload) -> list[Shard]:
-    """Parse a SHARD_BATCH payload (bytes or an in-place ring view)."""
+def _decode_shard_batch(payload) -> tuple[list[Shard], Optional[str]]:
+    """Parse a SHARD_BATCH payload (bytes or an in-place ring view) to
+    ``(shards, trace_id)``. The trace block is optional (see
+    ``_encode_shard_batch_parts``); any OTHER trailing bytes — or a
+    trace block whose length does not close the payload exactly —
+    still reject the frame."""
     if len(payload) < 4:
         raise WireError("truncated shard batch")
     (count,) = struct.unpack_from("<I", payload, 0)
@@ -705,9 +738,19 @@ def _decode_shard_batch(payload) -> list[Shard]:
             raise WireError("truncated shard batch")
         out.append(Shard.unmarshal(payload[pos : pos + ln]))
         pos += ln
+    trace: Optional[str] = None
     if pos != len(payload):
-        raise WireError("trailing bytes in shard batch")
-    return out
+        if pos + 4 > len(payload):
+            raise WireError("trailing bytes in shard batch")
+        (tlen,) = struct.unpack_from("<I", payload, pos)
+        pos += 4
+        if tlen > _MAX_TRACE_LEN or pos + tlen != len(payload):
+            raise WireError("trailing bytes in shard batch")
+        try:
+            trace = bytes(payload[pos : pos + tlen]).decode()
+        except UnicodeDecodeError as exc:
+            raise WireError(f"bad trace block in shard batch: {exc}")
+    return out, trace
 
 
 def _encode_peer_list(addresses: list[str]) -> bytes:
@@ -1364,6 +1407,11 @@ class TCPNetwork:
         if len(msgs) == 1:
             self.broadcast(msgs[0])
             return
+        # Captured HERE, inside the caller's request scope (thread-local),
+        # so network implementations keep their signatures: the cohort
+        # frame carries the request trace id and every receiver's
+        # pipeline spans can merge into the originating request's trace.
+        rt = current_trace_id()
         # Split oversized cohorts so one frame never exceeds the batch
         # cap (the receive ring handles them either way, but a multi-
         # tens-of-MiB frame is a head-of-line blob for the peer).
@@ -1380,9 +1428,11 @@ class TCPNetwork:
             if len(group) == 1:
                 self.broadcast(group[0])
                 continue
-            with span("wire_encode", key=trace_key(group[0].file_signature)):
+            with span("wire_encode", key=trace_key(group[0].file_signature),
+                      **({"request_trace": rt} if rt else {})):
                 parts, nbytes = self._frame_parts(
-                    _OP_SHARD_BATCH, _encode_shard_batch_parts(group)
+                    _OP_SHARD_BATCH,
+                    _encode_shard_batch_parts(group, trace=rt),
                 )
             wire_metrics().batch_out(len(group))
             self._post_frame(parts, nbytes, shards=len(group))
@@ -1450,6 +1500,10 @@ class TCPNetwork:
             writer = peer.writer
             address = peer.pid.address
         metrics = transport_metrics()
+        # Thread-local request-scope read — same contract as
+        # broadcast_many: the cohort frame carries the trace id so the
+        # owner's receive-side spans merge into the PUT's trace.
+        rt = current_trace_id()
         start = 0
         while start < len(msgs):
             group = []
@@ -1462,7 +1516,8 @@ class TCPNetwork:
                 group.append(msgs[start])
                 start += 1
             with span(
-                "wire_encode", key=trace_key(group[0].file_signature)
+                "wire_encode", key=trace_key(group[0].file_signature),
+                **({"request_trace": rt} if rt else {}),
             ):
                 if len(group) == 1:
                     parts, nbytes = self._frame_parts(
@@ -1470,7 +1525,8 @@ class TCPNetwork:
                     )
                 else:
                     parts, nbytes = self._frame_parts(
-                        _OP_SHARD_BATCH, _encode_shard_batch_parts(group)
+                        _OP_SHARD_BATCH,
+                        _encode_shard_batch_parts(group, trace=rt),
                     )
             if len(group) > 1:
                 wire_metrics().batch_out(len(group))
@@ -1993,14 +2049,14 @@ class TCPNetwork:
             ))
             try:
                 if opcode == _OP_SHARD:
-                    msgs = [Shard.unmarshal(payload)]
+                    msgs, rt = [Shard.unmarshal(payload)], None
                 else:
-                    msgs = _decode_shard_batch(payload)
+                    msgs, rt = _decode_shard_batch(payload)
             except WireError as exc:
                 metrics.error("wire")
                 self._record_error(exc)
                 return
-            self._submit_verify(pid, digest, sig, msgs, len(body) + 4)
+            self._submit_verify(pid, digest, sig, msgs, len(body) + 4, rt)
             return
 
         # Control frames (handshake, gossip): rare and loop-affine —
@@ -2090,12 +2146,15 @@ class TCPNetwork:
     VERIFY_DRAIN_MAX = 16
 
     def _submit_verify(
-        self, pid: PeerID, digest: bytes, sig: bytes, msgs: list, nbytes: int
+        self, pid: PeerID, digest: bytes, sig: bytes, msgs: list,
+        nbytes: int, trace: Optional[str] = None,
     ) -> None:
         """Queue parsed-but-unverified frames for the per-sender batched
         verify drain. Bounded by ``recv_window`` per sender (the same
         budget the dispatch queue enforces) — overflow drops the frame
-        and counts it, never blocks the loop thread."""
+        and counts it, never blocks the loop thread. ``trace`` is the
+        cohort frame's request-trace id (rides to the plugin ``Ctx``
+        only after the signature verifies)."""
         key = pid.public_key
         schedule = False
         overflow = False
@@ -2106,7 +2165,7 @@ class TCPNetwork:
             if len(q) >= self.recv_window:
                 overflow = True
             else:
-                q.append((pid, digest, sig, msgs, nbytes))
+                q.append((pid, digest, sig, msgs, nbytes, trace))
                 if key not in self._verify_scheduled:
                     self._verify_scheduled.add(key)
                     schedule = True
@@ -2160,7 +2219,7 @@ class TCPNetwork:
                 len(batch), ok_count,
                 fell_back=len(batch) > 1 and ok_count < len(batch),
             )
-            for (pid, _digest, _sig, msgs, nbytes), ok in zip(
+            for (pid, _digest, _sig, msgs, nbytes, trace), ok in zip(
                 batch, verdicts
             ):
                 if not ok:
@@ -2171,7 +2230,7 @@ class TCPNetwork:
                     continue
                 metrics.record_in(pid.address, nbytes, count=len(msgs))
                 for msg in msgs:
-                    self._dispatch_plugins(Ctx(msg, pid))
+                    self._dispatch_plugins(Ctx(msg, pid, trace=trace))
         if more and not self._dispatch.submit(key, self._drain_verify, key):
             with self._verify_lock:
                 self._verify_scheduled.discard(key)
@@ -2180,7 +2239,9 @@ class TCPNetwork:
         metrics = transport_metrics()
         msg = ctx.message()
         key = trace_key(msg.file_signature) if isinstance(msg, Shard) else None
-        with span("deliver", key=key):
+        rt = ctx.trace
+        with span("deliver", key=key,
+                  **({"request_trace": rt} if rt else {})):
             for plugin in self.plugins:
                 try:
                     plugin.receive(ctx)

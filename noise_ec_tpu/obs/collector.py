@@ -258,10 +258,19 @@ class TraceCollector:
     def traces(self) -> dict[str, list[dict]]:
         """Merged spans grouped by trace id — each value is one
         *distributed* trace (spans from every contributing node, on one
-        corrected timeline, ordered by start)."""
+        corrected timeline, ordered by start).
+
+        A span carrying a ``request_trace`` attribute groups under THAT
+        id instead of its own ``trace_id``: pipeline spans keyed by
+        message signature (encode/broadcast/deliver/decode legs) stamp
+        the request id of the user GET/PUT that caused them, so the
+        merged view shows one request-rooted trace spanning every node
+        the request touched, not a signature trace disjoint from it."""
         out: dict[str, list[dict]] = {}
         for s in self.merged_spans():
-            out.setdefault(s["trace_id"], []).append(s)
+            attrs = s.get("attrs") or {}
+            tid = attrs.get("request_trace") or s["trace_id"]
+            out.setdefault(tid, []).append(s)
         return out
 
     # ----------------------------------------------------------- lifecycle

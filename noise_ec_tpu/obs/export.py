@@ -22,10 +22,12 @@ from noise_ec_tpu.obs.registry import Registry, default_registry
 
 __all__ = [
     "escape_label_value",
+    "parse_exemplar",
     "parse_prometheus",
     "render_counters",
     "render_parsed",
     "render_prometheus",
+    "split_exemplar",
     "unescape_label_value",
 ]
 
@@ -76,18 +78,38 @@ def _render_family(fam, out: list[str]) -> None:
             out.append(f"{fam.name}{lbl} {_fmt(child.read())}")
         else:  # histogram: cumulative le buckets + sum + count
             snap = child.snapshot()
+            exemplars = snap.get("exemplars") or {}
             cum = 0
-            for bound, count in zip(snap["bounds"], snap["counts"]):
+            for i, (bound, count) in enumerate(
+                zip(snap["bounds"], snap["counts"])
+            ):
                 cum += count
                 le = _labels_str(
                     fam.label_names, values, f'le="{_fmt_le(bound)}"'
                 )
-                out.append(f"{fam.name}_bucket{le} {cum}")
+                out.append(
+                    f"{fam.name}_bucket{le} {cum}"
+                    f"{_fmt_exemplar(exemplars.get(i))}"
+                )
             cum += snap["counts"][-1]
             le = _labels_str(fam.label_names, values, 'le="+Inf"')
-            out.append(f"{fam.name}_bucket{le} {cum}")
+            out.append(
+                f"{fam.name}_bucket{le} {cum}"
+                f"{_fmt_exemplar(exemplars.get(len(snap['bounds'])))}"
+            )
             out.append(f"{fam.name}_sum{lbl} {repr(snap['sum'])}")
             out.append(f"{fam.name}_count{lbl} {snap['count']}")
+
+
+def _fmt_exemplar(ex: Optional[dict]) -> str:
+    """OpenMetrics-style exemplar suffix for one bucket line
+    (`` # {trace_id="..."} <value>``), or "" — the parser keeps sample
+    values as raw text, so the suffix round-trips byte-exact and the
+    federator can forward it untouched."""
+    if not ex:
+        return ""
+    tid = escape_label_value(str(ex["trace_id"]))
+    return f' # {{trace_id="{tid}"}} {repr(float(ex["value"]))}'
 
 
 def _fmt_le(bound: float) -> str:
@@ -144,6 +166,40 @@ def render_prometheus(
 # one codec instead of two drifting halves.
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+_EXEMPLAR_RE = re.compile(
+    r'^\{trace_id="((?:[^"\\]|\\.)*)"\}\s+(\S+)$'
+)
+
+
+def split_exemplar(raw: str) -> tuple[str, Optional[str]]:
+    """Split one raw sample value into ``(numeric text, exemplar text or
+    None)``. ``parse_prometheus`` keeps values verbatim, so a bucket
+    line's ``# {trace_id=...} v`` exemplar rides inside the value
+    string; consumers that need the number alone (the federator's
+    bucket folding) split here."""
+    num, sep, ex = raw.partition(" # ")
+    if not sep:
+        return raw, None
+    return num, ex or None
+
+
+def parse_exemplar(text: Optional[str]) -> Optional[dict]:
+    """One exemplar suffix (the :func:`split_exemplar` tail) ->
+    ``{"trace_id", "value"}``, or None when absent/unparseable."""
+    if not text:
+        return None
+    m = _EXEMPLAR_RE.match(text.strip())
+    if m is None:
+        return None
+    try:
+        value = float(m.group(2))
+    except ValueError:
+        return None
+    return {
+        "trace_id": unescape_label_value(m.group(1)),
+        "value": value,
+    }
 
 
 def unescape_label_value(value: str) -> str:

@@ -43,7 +43,11 @@ import time
 import urllib.request
 from typing import Callable, Optional
 
-from noise_ec_tpu.obs.export import parse_prometheus, render_parsed
+from noise_ec_tpu.obs.export import (
+    parse_prometheus,
+    render_parsed,
+    split_exemplar,
+)
 from noise_ec_tpu.obs.registry import Registry, default_registry
 from noise_ec_tpu.resilience.breakers import CircuitBreaker
 
@@ -57,6 +61,10 @@ GAUGE_POLICIES: dict[str, str] = {
     "noise_ec_peer_circuit_state": "max",
     "noise_ec_codec_circuit_state": "max",
     "noise_ec_build_info": "max",
+    # Every node's rebalancer publishes its own view of the SAME
+    # per-domain shard census (PR 17); summing across nodes counts each
+    # shard once per reporter. "max" keeps the most complete view.
+    "noise_ec_placement_shards": "max",
 }
 
 _HIST_SUFFIXES = ("_bucket", "_sum", "_count")
@@ -119,7 +127,8 @@ def merge_documents(docs: dict[str, str]) -> list[dict]:
 
 def _fold_histogram(acc: dict, name: str, samples) -> None:
     for sname, labels, raw in samples:
-        value = float(raw.split()[0])
+        num, exemplar = split_exemplar(raw)
+        value = float(num.split()[0])
         if sname == f"{name}_bucket":
             le = None
             base = []
@@ -134,6 +143,11 @@ def _fold_histogram(acc: dict, name: str, samples) -> None:
                 tuple(base), {"buckets": {}, "sum": 0.0, "count": 0.0}
             )
             h["buckets"][le] = h["buckets"].get(le, 0.0) + value
+            if exemplar is not None:
+                # Forward exemplars through the merge: last writer per
+                # (labels, le) wins — any kept trace id answers "show me
+                # a request behind this bucket".
+                h.setdefault("exemplars", {})[le] = exemplar
         else:
             h = acc["hists"].setdefault(
                 tuple(labels), {"buckets": {}, "sum": 0.0, "count": 0.0}
@@ -160,11 +174,16 @@ def _emit_family(name: str, acc: dict) -> dict:
         for base in sorted(acc["hists"]):
             h = acc["hists"][base]
             labeled = tuple(base) + (("node", "fleet"),)
+            exemplars = h.get("exemplars") or {}
             for le in sorted(h["buckets"], key=_le_sort_key):
+                value = _fmt_value(h["buckets"][le])
+                ex = exemplars.get(le)
+                if ex is not None:
+                    value = f"{value} # {ex}"
                 samples.append((
                     f"{name}_bucket",
                     labeled + (("le", le),),
-                    _fmt_value(h["buckets"][le]),
+                    value,
                 ))
             samples.append((f"{name}_sum", labeled, repr(float(h["sum"]))))
             samples.append((f"{name}_count", labeled, _fmt_value(h["count"])))

@@ -26,6 +26,7 @@ __all__ = [
     "DEVICE_LATENCY_BUCKETS",
     "LATENCY_BUCKETS",
     "SIZE_BUCKETS",
+    "percentile_from",
 ]
 
 # Default latency buckets: 1 us .. ~16.8 s, geometric (x2). Wide enough to
@@ -45,6 +46,35 @@ DEVICE_LATENCY_BUCKETS: tuple[float, ...] = tuple(
 # Default size buckets: 64 B .. 1 GiB, geometric (x4) — shard payloads at
 # the low end, whole stream objects at the top.
 SIZE_BUCKETS: tuple[float, ...] = tuple(64.0 * 4**i for i in range(13))
+
+
+def percentile_from(
+    bounds: Sequence[float], counts: Sequence[float], q: float
+) -> float:
+    """The bucket-interpolated ``q``-quantile of raw (non-cumulative)
+    bucket counts — :meth:`Histogram.percentile` factored out so callers
+    holding MERGED counts (several children of one family summed, the
+    tail sampler's per-op p95 feed) share one interpolation."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile {q} outside [0, 1]")
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    target = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if cum + c >= target:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = (
+                bounds[i] if i < len(bounds)
+                else bounds[-1]  # +Inf bucket: clamp
+            )
+            frac = (target - cum) / c
+            return lo + frac * (hi - lo)
+        cum += c
+    return bounds[-1]
 
 
 class Counters:
@@ -77,7 +107,19 @@ class Histogram:
     catches the overflow. Observations are counted into the first bucket
     whose bound is >= the value — Prometheus ``le`` semantics, so the
     exporter can emit cumulative bucket lines without re-binning.
+
+    An observation may carry an *exemplar*: a trace-id string, or a
+    zero-arg callable resolving to one (or None). Callables defer the
+    tail-sampling decision — a latency observes BEFORE its trace's
+    keep/drop verdict exists, so resolution happens at snapshot time,
+    when it does. Per bucket the last few exemplar refs are retained
+    (newest resolvable one wins), bounding memory to O(buckets).
     """
+
+    # Unresolved exemplar refs retained per bucket: enough that a few
+    # dropped-trace observations do not erase a kept one, small enough
+    # that exemplar memory stays O(buckets).
+    EXEMPLAR_DEPTH = 4
 
     def __init__(self, buckets: Sequence[float] = LATENCY_BUCKETS):
         bounds = tuple(float(b) for b in buckets)
@@ -89,24 +131,55 @@ class Histogram:
         self._counts = [0] * (len(bounds) + 1)  # last = +Inf
         self.sum = 0.0
         self.count = 0
+        # bucket index -> [(value, str|callable), ...] newest last.
+        self._exemplars: dict[int, list] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar=None) -> None:
         i = bisect_left(self.bounds, value)
         with self._lock:
             self._counts[i] += 1
             self.sum += value
             self.count += 1
+            if exemplar is not None:
+                refs = self._exemplars.setdefault(i, [])
+                refs.append((value, exemplar))
+                if len(refs) > self.EXEMPLAR_DEPTH:
+                    del refs[0]
+
+    @staticmethod
+    def _resolve_exemplars(raw: dict) -> dict:
+        """Newest resolvable exemplar per bucket index ->
+        ``{"trace_id", "value"}`` (callables invoked here, at snapshot
+        time — after the tail-sampling decision exists)."""
+        out: dict[int, dict] = {}
+        for i, refs in raw.items():
+            for value, ref in reversed(refs):
+                trace_id = ref() if callable(ref) else ref
+                if trace_id:
+                    out[i] = {"trace_id": str(trace_id), "value": value}
+                    break
+        return out
 
     def snapshot(self) -> dict:
-        """(bounds, per-bucket counts, sum, count) — a consistent copy."""
+        """(bounds, per-bucket counts, sum, count[, exemplars]) — a
+        consistent copy; ``exemplars`` (bucket index -> trace ref) only
+        when any observation carried one."""
         with self._lock:
-            return {
-                "bounds": self.bounds,
-                "counts": tuple(self._counts),
-                "sum": self.sum,
-                "count": self.count,
-            }
+            counts = tuple(self._counts)
+            total, count = self.sum, self.count
+            raw = {i: list(refs) for i, refs in self._exemplars.items()}
+        snap = {
+            "bounds": self.bounds,
+            "counts": counts,
+            "sum": total,
+            "count": count,
+        }
+        if raw:
+            resolved = self._resolve_exemplars(raw)
+            if resolved:
+                snap["exemplars"] = resolved
+        return snap
 
     def percentile(self, q: float) -> float:
         """Estimate the ``q``-quantile (q in [0, 1]) by linear
@@ -116,28 +189,8 @@ class Histogram:
         honest answer a fixed-bucket sketch can give. Returns 0.0 for an
         empty histogram.
         """
-        if not 0.0 <= q <= 1.0:
-            raise ValueError(f"quantile {q} outside [0, 1]")
         snap = self.snapshot()
-        total = snap["count"]
-        if total == 0:
-            return 0.0
-        target = q * total
-        cum = 0.0
-        for i, c in enumerate(snap["counts"]):
-            if c == 0:
-                continue
-            if cum + c >= target:
-                lo = self.bounds[i - 1] if i > 0 else 0.0
-                hi = (
-                    self.bounds[i]
-                    if i < len(self.bounds)
-                    else self.bounds[-1]  # +Inf bucket: clamp
-                )
-                frac = (target - cum) / c
-                return lo + frac * (hi - lo)
-            cum += c
-        return self.bounds[-1]
+        return percentile_from(self.bounds, snap["counts"], q)
 
     @property
     def p50(self) -> float:

@@ -44,7 +44,10 @@ from noise_ec_tpu.obs.perfetto import write_chrome_trace
 from noise_ec_tpu.obs.registry import Registry, default_registry
 from noise_ec_tpu.obs.trace import Tracer, default_tracer
 
-__all__ = ["BUNDLE_VERSION", "FlightRecorder", "flatten_registry"]
+__all__ = [
+    "BUNDLE_VERSION", "FlightRecorder", "flatten_registry",
+    "group_request_traces",
+]
 
 log = logging.getLogger("noise_ec_tpu.obs")
 
@@ -71,6 +74,23 @@ def flatten_registry(registry: Registry) -> dict[str, float]:
                 snap = child.snapshot()
                 out[f"{key}#count"] = float(snap["count"])
                 out[f"{key}#sum"] = float(snap["sum"])
+    return out
+
+
+def group_request_traces(spans) -> dict[str, list[dict]]:
+    """Group spans into request-rooted traces (``req-...`` ids). A span
+    carrying a ``request_trace`` attribute groups under that id — same
+    merge rule as :meth:`TraceCollector.traces` — so an incident bundle
+    shows whole sampled requests from the degraded window, pipeline
+    legs included, not loose spans. Spans belonging to no request
+    (signature-keyed work with no request ancestor) are left out; they
+    are still in the bundle's flat ``spans`` list."""
+    out: dict[str, list[dict]] = {}
+    for s in spans:
+        attrs = s.get("attrs") or {}
+        tid = attrs.get("request_trace") or s.get("trace_id")
+        if isinstance(tid, str) and tid.startswith("req-"):
+            out.setdefault(tid, []).append(s)
     return out
 
 
@@ -238,6 +258,11 @@ class FlightRecorder:
             "verdict": verdict,
             "timeline": timeline,
             "spans": spans,
+            # The tail-sampled requests that completed inside the
+            # window: only traces the sampler KEPT are in the ring, so
+            # these are exactly the error/slow/sampled requests an
+            # operator wants next to the verdict flip.
+            "traces": group_request_traces(spans),
             "recorder": self.stats(),
             "trace_file": None,
         }

@@ -64,6 +64,20 @@ PIPELINE_STAGES: tuple[str, ...] = (
     # Placement churn rebalance (docs/placement.md): one span per
     # ownership-delta cycle over the local store.
     "rebalance",
+    # Request-scoped tracing tiers (docs/observability.md "Request
+    # tracing"): the root span of every object-service op, then one
+    # child per serving tier a GET touches and per PUT delivery leg.
+    "request",
+    "cache_probe",
+    "local_join",
+    "peer_fetch",
+    "gather_fetch",
+    "stripe_decode",
+    "stripe_put",
+    "placement_send",
+    # Single-flight followers: the span that points a coalesced reader
+    # at its leader's trace.
+    "joined",
 )
 
 # name -> (type, help, label names). The single source of truth for every
@@ -164,6 +178,12 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "counter",
         "Spans recorded by the in-process tracer, labeled by stage",
         ("stage",),
+    ),
+    "noise_ec_trace_requests_total": (
+        "counter",
+        "Request-scoped traces by tail-sampling decision (kept_error, "
+        "kept_slow, kept_sampled, dropped, evicted)",
+        ("decision",),
     ),
     # --- stripe store / scrub / repair (noise_ec_tpu/store, docs/store.md)
     "noise_ec_store_stripes": (

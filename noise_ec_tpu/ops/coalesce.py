@@ -164,7 +164,14 @@ class CoalescingDispatcher:
         caller rode another caller's in-flight call. An exception from
         ``fn`` propagates to every member. Flights record the coalesce
         metrics under ``flush_reason="shared"`` (one batch-size
-        observation per member, same contract as batched flushes)."""
+        observation per member, same contract as batched flushes).
+
+        Tracing: ``fn`` runs on the LEADER's thread, so any spans it
+        opens land in the leader's request trace — a follower's trace
+        would otherwise lose the decode work entirely. The object
+        service threads the leader's trace id through the shared result
+        so followers can record a ``joined`` span pointing at the
+        leader's trace (docs/observability.md "Request tracing")."""
         with self._lock:
             flight = self._flights.get(key)
             if flight is not None:

@@ -26,7 +26,7 @@ import logging
 from typing import Optional
 
 from noise_ec_tpu.obs.registry import default_registry
-from noise_ec_tpu.obs.trace import trace_key
+from noise_ec_tpu.obs.trace import current_trace_id, span, trace_key
 
 __all__ = ["TargetedDelivery"]
 
@@ -79,11 +79,22 @@ class TargetedDelivery:
                 continue
             cohorts.setdefault(owner, []).append(shard)
         sent = 0
+        rt = current_trace_id()
         for token, group in cohorts.items():
-            if send_many(directory[token], group):
-                sent += len(group)
-            else:
-                skipped += len(group)
+            # One span per destination cohort (PUT-side delivery leg).
+            # The span joins the signature trace through its ancestor
+            # chain; ``request_trace`` keys it to the user request so a
+            # collector can merge the delivery into the PUT's trace.
+            attrs = {"peer": token, "shards": len(group)}
+            if rt is not None:
+                attrs["request_trace"] = rt
+            with span("placement_send", **attrs) as sp:
+                if send_many(directory[token], group):
+                    sent += len(group)
+                    sp.set_attr(outcome="ok")
+                else:
+                    skipped += len(group)
+                    sp.set_attr(outcome="refused")
         # What a broadcast would have cost: every shard to every
         # directory peer. The saved delta is the wire win the fanout
         # acceptance test and the bench's placement_fanout_ratio gate.
@@ -154,17 +165,26 @@ class TargetedDelivery:
                 continue
             if token not in directory:
                 continue
-            try:
-                got = fetch(directory[token], key)
-            except Exception as exc:  # noqa: BLE001 — a dead owner
-                # degrades the gather, never breaks the read
-                log.debug("placement fetch from %s failed: %s", token, exc)
-                continue
-            if not got:
-                continue
-            for num, blob in got.items():
-                if 0 <= int(num) < n and blob is not None:
-                    collected.setdefault(int(num), bytes(blob))
+            # One span per owner fetch: peer id + outcome + bytes, so a
+            # straggling owner is visible in the GET's critical path.
+            with span("gather_fetch", peer=token) as sp:
+                try:
+                    got = fetch(directory[token], key)
+                except Exception as exc:  # noqa: BLE001 — a dead owner
+                    # degrades the gather, never breaks the read
+                    sp.set_attr(outcome="error", bytes=0)
+                    log.debug("placement fetch from %s failed: %s",
+                              token, exc)
+                    continue
+                if not got:
+                    sp.set_attr(outcome="empty", bytes=0)
+                    continue
+                nbytes = 0
+                for num, blob in got.items():
+                    if 0 <= int(num) < n and blob is not None:
+                        nbytes += len(blob)
+                        collected.setdefault(int(num), bytes(blob))
+                sp.set_attr(outcome="ok", bytes=nbytes, shards=len(got))
         if len(collected) < k:
             return None
         shard_lens = {len(b) for b in collected.values()}

@@ -45,6 +45,7 @@ from collections import OrderedDict
 from typing import Callable, Iterable, Optional
 
 from noise_ec_tpu.obs.registry import default_registry
+from noise_ec_tpu.obs.trace import current_trace_id, span
 
 __all__ = [
     "DecodedObjectCache",
@@ -187,7 +188,21 @@ class DecodedObjectCache:
 
     def get(self, address: str, idx: int) -> Optional[bytes]:
         """The cached stripe payload (bumping LRU recency) or None;
-        records the hit/miss counters — one call per logical lookup."""
+        records the hit/miss counters — one call per logical lookup.
+        Inside a request scope the probe records a ``cache_probe`` span
+        (outcome + bytes); outside one — bench warm sweeps, background
+        work — the lookup stays span-free."""
+        if current_trace_id() is None:
+            return self._probe(address, idx)
+        with span("cache_probe", stripe=idx) as sp:
+            blob = self._probe(address, idx)
+            sp.set_attr(
+                outcome="hit" if blob is not None else "miss",
+                bytes=len(blob) if blob is not None else 0,
+            )
+            return blob
+
+    def _probe(self, address: str, idx: int) -> Optional[bytes]:
         with self._lock:
             blob = self._entries.get((address, idx))
             if blob is not None:

@@ -33,9 +33,11 @@ from __future__ import annotations
 
 import json
 import re
+import sys
 from typing import Optional
 from urllib.parse import unquote
 
+from noise_ec_tpu.obs.trace import request as trace_request
 from noise_ec_tpu.service.objects import (
     ObjectStore,
     ObjectUnavailableError,
@@ -101,7 +103,17 @@ class ObjectAPI:
                 yield blk
 
         try:
-            doc = self.objects.put_stream(tenant, name, chunks(), length)
+            # Adopt a propagated trace id (X-NoiseEC-Trace) so a routed
+            # PUT joins the originator's request trace; shed/quota
+            # refusals raise through the scope and are kept as error
+            # traces by the tail sampler.
+            with trace_request(
+                "put", trace_id=req["headers"].get("X-NoiseEC-Trace"),
+                route="http",
+            ):
+                doc = self.objects.put_stream(
+                    tenant, name, chunks(), length
+                )
         except ShedError as exc:
             return _json(
                 503,
@@ -149,6 +161,27 @@ class ObjectAPI:
         # A warm-peer fetch from another node: serve local tiers only,
         # so peer routing is a single hop by construction.
         direct = req["headers"].get("X-NoiseEC-Route") == "direct"
+        # The request scope must outlive this handler frame (the body
+        # streams after we return), so it is entered manually here and
+        # closed by finish() — on any error return below, or when the
+        # streamed body is exhausted/abandoned. A propagated
+        # X-NoiseEC-Trace id (a warm-peer routed fetch) is adopted so
+        # the serving node's tier spans merge into the originator's
+        # trace; the object layer's own scope joins this one.
+        rscope = trace_request(
+            "get", trace_id=req["headers"].get("X-NoiseEC-Trace"),
+            route="http",
+        )
+        rscope.__enter__()
+        done = [False]
+
+        def finish(exc: Optional[BaseException] = None) -> None:
+            if not done[0]:
+                done[0] = True
+                rscope.__exit__(
+                    type(exc) if exc is not None else None, exc, None
+                )
+
         try:
             doc, total, chunks = self.objects.get_range(
                 tenant, name, start, length, peer_route=not direct
@@ -161,21 +194,30 @@ class ObjectAPI:
             except StopIteration:
                 first = b""
         except ShedError as exc:
+            finish(exc)
             return _json(
                 503,
                 {"error": str(exc), "shed": exc.reason},
                 {"Retry-After": f"{exc.retry_after:g}"},
             )
         except ObjectUnavailableError as exc:
+            finish(exc)
             return _json(503, {"error": str(exc)},
                          {"Retry-After": "2"})
         except ValueError as exc:
+            finish(exc)
             return _json(416, {"error": str(exc)},
                          {"Content-Range": f"bytes */{size}"})
+        except BaseException as exc:
+            finish(exc)
+            raise
 
         def body():
-            yield first
-            yield from chunks
+            try:
+                yield first
+                yield from chunks
+            finally:
+                finish(sys.exc_info()[1])
 
         headers = {
             "Content-Length": str(total),
@@ -212,7 +254,12 @@ class ObjectAPI:
             return _json(400, {"error": "expected /objects/<tenant>/<name>"})
         tenant, name = seg
         try:
-            self.objects.delete(tenant, name)
+            with trace_request(
+                "delete",
+                trace_id=req["headers"].get("X-NoiseEC-Trace"),
+                route="http",
+            ):
+                self.objects.delete(tenant, name)
         except UnknownObjectError:
             return _json(404, {"error": f"no object {tenant}/{name}"})
         return 204, "text/plain", b""

@@ -77,8 +77,15 @@ import weakref
 from typing import Iterable, Iterator, Optional
 
 from noise_ec_tpu.obs.device import hbm_snapshot
+from noise_ec_tpu.obs.metrics import percentile_from
 from noise_ec_tpu.obs.registry import default_registry
-from noise_ec_tpu.obs.trace import trace_key
+from noise_ec_tpu.obs.trace import (
+    current_trace_id,
+    default_tracer,
+    request,
+    span,
+    trace_key,
+)
 from noise_ec_tpu.ops.coalesce import coalescer
 from noise_ec_tpu.service.cache import (
     WARMSET_MAGIC,
@@ -183,6 +190,7 @@ class _ObjectMetrics:
             "noise_ec_object_tenant_shed_total"
         )
         self._op_children: dict[tuple[str, str, str], object] = {}
+        self._p95_cache: dict[str, tuple[float, Optional[float]]] = {}
         self._tenant_labels: set[str] = set()
         cls = _ObjectMetrics
         if not cls._registered:
@@ -230,17 +238,62 @@ class _ObjectMetrics:
         return tenant
 
     def op_seconds(self, tenant: str, op: str, route: str,
-                   seconds: float) -> None:
+                   seconds: float, exemplar=None) -> None:
         """Observe one op into the per-tenant attribution histogram
         (children cached — this lands once per request, not per
-        stripe)."""
+        stripe). ``exemplar`` is the request scope's deferred trace-id
+        resolver: /metrics renders the bucket's ``# {trace_id=...}``
+        exemplar from it once the tail sampler has kept the trace."""
         key = (self._tenant_label(tenant), op, route)
         child = self._op_children.get(key)
         if child is None:
             child = self._op_children[key] = self._op_seconds.labels(
                 tenant=key[0], op=op, route=route
             )
-        child.observe(seconds)
+        child.observe(seconds, exemplar=exemplar)
+
+    # Minimum op-histogram observations before the rolling p95 is
+    # trusted as a tail-sampling keep signal; below it every clean
+    # trace rides the seeded 1-in-N sample alone.
+    P95_MIN_COUNT = 32
+    # The tail sampler consults the p95 on EVERY request commit, but
+    # the merge below walks (and lock-snapshots) every child of the
+    # shared family — per-request cost that scales with tenant/node
+    # cardinality (a 50-node lab shares one registry). A threshold a
+    # quarter-second stale is indistinguishable for sampling, so the
+    # sweep runs at most once per TTL per op.
+    P95_CACHE_SECONDS = 0.25
+
+    def op_p95(self, op: str) -> Optional[float]:
+        """Rolling per-op p95 merged across every child of the
+        op-latency family (all tenants and routes, all ObjectStore
+        instances — the family is shared through the registry), or None
+        while the histogram is too thin. The tail sampler's
+        slower-than-p95 keep rule reads this; results are cached for
+        ``P95_CACHE_SECONDS`` (a benign data race: losers recompute)."""
+        now = time.monotonic()
+        hit = self._p95_cache.get(op)
+        if hit is not None and now - hit[0] < self.P95_CACHE_SECONDS:
+            return hit[1]
+        bounds = None
+        counts: Optional[list[float]] = None
+        total = 0
+        for values, child in self._op_seconds.children():
+            if values[1] != op:
+                continue
+            snap = child.snapshot()
+            if bounds is None:
+                bounds = snap["bounds"]
+                counts = [0.0] * len(snap["counts"])
+            for i, c in enumerate(snap["counts"]):
+                counts[i] += c
+            total += snap["count"]
+        if counts is None or total < self.P95_MIN_COUNT:
+            p95 = None
+        else:
+            p95 = percentile_from(bounds, counts, 0.95)
+        self._p95_cache[op] = (now, p95)
+        return p95
 
 
 class ObjectStore:
@@ -314,6 +367,11 @@ class ObjectStore:
         )
         self._metrics = _ObjectMetrics()
         _ObjectMetrics._instances.add(self)
+        # The tail sampler's slower-than-p95 keep rule feeds from the
+        # op-latency histograms this layer already records (op_p95
+        # reads the SHARED registry family, so any instance's provider
+        # sees every instance's observations).
+        default_tracer().set_p95_provider(self._metrics.op_p95)
         store.add_put_listener(self._on_store_put)
         store.add_delete_listener(self._on_store_evict)
         self._reindex()
@@ -409,7 +467,19 @@ class ObjectStore:
         ``size`` bytes arriving as a chunk iterator (memory stays
         O(stripe)); returns the manifest document. Admission (quota,
         then shed) runs BEFORE the first chunk is consumed, so a refused
-        PUT costs no encode and queues nothing toward the device."""
+        PUT costs no encode and queues nothing toward the device.
+
+        The whole PUT runs inside a request-scoped trace (joining the
+        HTTP layer's when one is active): quota/shed refusals raise
+        through the scope and are kept as error traces; each stripe's
+        encode+delivery is a ``stripe_put`` child span."""
+        with request("put", tenant=tenant_name) as rscope:
+            return self._put_stream(rscope, tenant_name, name, chunks, size)
+
+    def _put_stream(
+        self, rscope, tenant_name: str, name: str,
+        chunks: Iterable[bytes], size: int,
+    ) -> dict:
         t0 = time.monotonic()
         try:
             tenant = self.tenants.get(tenant_name)
@@ -468,11 +538,13 @@ class ObjectStore:
             # (docs/placement.md: one cohort per owner instead of a
             # full broadcast); the MANIFEST below stays broadcast so
             # every node can index the object.
-            shards = self.plugin.shard_and_broadcast(
-                self.network, payload + bytes(pad), geometry=(k, n),
-                targeted=True,
-            )
-            stripe_keys.append(trace_key(shards[0].file_signature))
+            with span("stripe_put", stripe=len(stripe_keys)) as sp:
+                shards = self.plugin.shard_and_broadcast(
+                    self.network, payload + bytes(pad), geometry=(k, n),
+                    targeted=True,
+                )
+                stripe_keys.append(trace_key(shards[0].file_signature))
+                sp.set_attr(key=stripe_keys[-1], bytes=len(payload))
             if warm is not None:
                 warm.append((stripe_keys[-1], payload))
 
@@ -515,7 +587,10 @@ class ObjectStore:
         # the put listener (_on_store_put) indexes it — the exact code
         # path every replica runs, so origin and peers converge through
         # one absorb implementation.
-        self.plugin.shard_and_broadcast(self.network, blob, geometry=(k, n))
+        with span("stripe_put", kind="manifest", bytes=len(blob)):
+            self.plugin.shard_and_broadcast(
+                self.network, blob, geometry=(k, n)
+            )
         if warm is not None:
             # After the manifest broadcast: an overwrite-PUT's manifest
             # absorb just evicted the REPLACED address, so the new
@@ -533,7 +608,10 @@ class ObjectStore:
         self._metrics.put(tenant.name, size)
         elapsed = time.monotonic() - t0
         self._metrics.put_seconds.observe(elapsed)
-        self._metrics.op_seconds(tenant.name, "put", "encode", elapsed)
+        self._metrics.op_seconds(
+            tenant.name, "put", "encode", elapsed,
+            exemplar=rscope.exemplar,
+        )
         return self.store.get_manifest(doc["address"]) or doc
 
     def _manifest_stripe_locked(self, address: str) -> Optional[str]:
@@ -657,7 +735,15 @@ class ObjectStore:
         pins the read to local tiers (a peer serving a direct fetch
         must not hop again). The metrics for the read land when the
         iterator is exhausted."""
-        doc = self.resolve(tenant, name)
+        try:
+            doc = self.resolve(tenant, name)
+        except UnknownObjectError:
+            # Resolve-time misses raise before the streaming scope below
+            # exists; replay through a short request scope so the tail
+            # sampler keeps the trace (errors are always kept) — without
+            # it the most common GET error class would be invisible.
+            with request("get", tenant=tenant, name=name):
+                raise
         address = doc["address"]
         size = int(doc["size"])
         capacity = int(doc["stripe_bytes"])
@@ -674,8 +760,12 @@ class ObjectStore:
         if shed and not self._fully_cached(address, i0, i1):
             reason = self.shed_reason()
             if reason is not None:
-                self._metrics.shed(reason, tenant)
-                raise ShedError(reason, self.retry_after_seconds)
+                # Shed traces are always kept by the tail sampler: the
+                # refusal raises through its own (short) request scope
+                # when no outer one is active.
+                with request("get", tenant=tenant, name=name):
+                    self._metrics.shed(reason, tenant)
+                    raise ShedError(reason, self.retry_after_seconds)
         # Per-request read state: served/cached stripe counts for the
         # result label, shared/degraded flags, the most expensive
         # serving tier touched (the per-tenant attribution route label),
@@ -687,50 +777,61 @@ class ObjectStore:
         }
 
         def chunks() -> Iterator[bytes]:
-            t0 = time.monotonic()
-            sent = 0
-            result = "ok"
-            with self._lock:
-                self._live_reads += 1
-            try:
-                for i in range(i0, i1):
-                    blob = self._read_stripe_tiered(
-                        doc, i, i1, state, peer_route
-                    )
-                    logical = min(capacity, size - i * capacity)
-                    lo = max(0, start - i * capacity)
-                    hi = min(logical, end - i * capacity)
-                    if lo == 0 and hi == logical == len(blob):
-                        piece = blob  # whole-stripe serve: no copy
-                    else:
-                        piece = bytes(memoryview(blob)[:logical][lo:hi])
-                    sent += len(piece)
-                    yield piece
-                if state["shared"]:
-                    # The request rode another request's in-flight
-                    # fetch; any degraded work was the leader's (which
-                    # records it on its own request).
-                    result = "coalesced"
-                elif state["degraded"]:
-                    result = "degraded"
-                elif state["served"] and state["cached"] == state["served"]:
-                    result = "hit"
-            except ObjectUnavailableError:
-                result = "unavailable"
-                raise
-            except Exception:
-                result = "error"
-                raise
-            finally:
+            # The request scope opens at first iteration (a built-but-
+            # never-consumed iterator must not leak a held trace) and
+            # closes when the stream ends — error, shed and abandonment
+            # all propagate through it, so the tail sampler sees them.
+            with request("get", tenant=tenant, name=name) as rscope:
+                t0 = time.monotonic()
+                sent = 0
+                result = "ok"
                 with self._lock:
-                    self._live_reads -= 1
-                self._metrics.get(result)
-                self._metrics.get_bytes.add(sent)
-                elapsed = time.monotonic() - t0
-                self._metrics.get_seconds.observe(elapsed)
-                self._metrics.op_seconds(
-                    tenant, "get", state["route"], elapsed
-                )
+                    self._live_reads += 1
+                try:
+                    for i in range(i0, i1):
+                        blob = self._read_stripe_tiered(
+                            doc, i, i1, state, peer_route
+                        )
+                        logical = min(capacity, size - i * capacity)
+                        lo = max(0, start - i * capacity)
+                        hi = min(logical, end - i * capacity)
+                        if lo == 0 and hi == logical == len(blob):
+                            piece = blob  # whole-stripe serve: no copy
+                        else:
+                            piece = bytes(
+                                memoryview(blob)[:logical][lo:hi]
+                            )
+                        sent += len(piece)
+                        yield piece
+                    if state["shared"]:
+                        # The request rode another request's in-flight
+                        # fetch; any degraded work was the leader's
+                        # (which records it on its own request).
+                        result = "coalesced"
+                    elif state["degraded"]:
+                        result = "degraded"
+                    elif (
+                        state["served"]
+                        and state["cached"] == state["served"]
+                    ):
+                        result = "hit"
+                except ObjectUnavailableError:
+                    result = "unavailable"
+                    raise
+                except Exception:
+                    result = "error"
+                    raise
+                finally:
+                    with self._lock:
+                        self._live_reads -= 1
+                    self._metrics.get(result)
+                    self._metrics.get_bytes.add(sent)
+                    elapsed = time.monotonic() - t0
+                    self._metrics.get_seconds.observe(elapsed)
+                    self._metrics.op_seconds(
+                        tenant, "get", state["route"], elapsed,
+                        exemplar=rscope.exemplar,
+                    )
 
         return doc, total, chunks()
 
@@ -772,19 +873,29 @@ class ObjectStore:
             self._metrics.routes["cache"].add(1)
             return blob
 
-        def fetch() -> tuple[bytes, str, bool]:
+        def fetch() -> tuple[bytes, str, bool, Optional[str]]:
+            # The leader's trace id rides the flight result so a
+            # coalesced follower can record a ``joined`` span pointing
+            # at the trace that did the actual work.
             if self.cache is not None:
                 hit = self.cache.peek(address, i)
                 if hit is not None:
                     # Landed by another flight between this request's
                     # miss and its flight turn.
                     self._metrics.routes["cache"].add(1)
-                    return hit, "cache", False
-            return self._fetch_stripe(doc, i, i1, state, peer_route)
+                    return hit, "cache", False, current_trace_id()
+            blob, route, degraded = self._fetch_stripe(
+                doc, i, i1, state, peer_route
+            )
+            return blob, route, degraded, current_trace_id()
 
-        (blob, route, degraded), shared = coalescer().submit_shared(
-            ("objget", address, i), fetch
+        (blob, route, degraded, leader), shared = (
+            coalescer().submit_shared(("objget", address, i), fetch)
         )
+        if shared:
+            with span("joined", stripe=i) as sp:
+                if leader is not None:
+                    sp.set_attr(leader=leader)
         if route == "cache":
             state["cached"] += 1
         if _ROUTE_RANK.get(route, 3) > _ROUTE_RANK[state["route"]]:
@@ -812,21 +923,26 @@ class ObjectStore:
         # ONE store-lock acquisition snapshots the request's remaining
         # stripe set (the per-stripe lock fix): the join fast path and
         # the degraded classification both work from it.
-        if state["snaps"] is None:
-            state["snaps"] = self.store.snapshot_many(doc["stripes"][i:i1])
-        snap = state["snaps"].get(key)
-        if snap is not None:
-            meta, shards, unverified = snap
-            if all(
-                shards[j] is not None and j not in unverified
-                for j in range(meta.k)
-            ):
-                blob = b"".join(
-                    shards[: meta.k]
-                )[: meta.object_len][:logical]
-                self._cache_store(address, i, blob, key)
-                self._metrics.routes["local"].add(1)
-                return blob, "local", False
+        with span("local_join", stripe=i) as lj:
+            if state["snaps"] is None:
+                state["snaps"] = self.store.snapshot_many(
+                    doc["stripes"][i:i1]
+                )
+            snap = state["snaps"].get(key)
+            if snap is not None:
+                meta, shards, unverified = snap
+                if all(
+                    shards[j] is not None and j not in unverified
+                    for j in range(meta.k)
+                ):
+                    blob = b"".join(
+                        shards[: meta.k]
+                    )[: meta.object_len][:logical]
+                    self._cache_store(address, i, blob, key)
+                    self._metrics.routes["local"].add(1)
+                    lj.set_attr(outcome="hit", bytes=len(blob))
+                    return blob, "local", False
+            lj.set_attr(outcome="miss")
         if peer_route:
             blob = self._peer_fetch(doc, i, logical)
             if blob is not None:
@@ -850,7 +966,9 @@ class ObjectStore:
                 self._cache_store(address, i, blob, key)
                 self._metrics.routes["gather"].add(1)
                 return blob, "gather", False
-        padded, degraded = self._read_stripe(key)
+        with span("stripe_decode", stripe=i, stripe_key=key) as sd:
+            padded, degraded = self._read_stripe(key)
+            sd.set_attr(degraded=degraded, bytes=logical)
         blob = (
             padded if len(padded) == logical
             else bytes(memoryview(padded)[:logical])
@@ -881,40 +999,55 @@ class ObjectStore:
             f"/objects/{quote(doc['tenant'], safe='')}"
             f"/{quote(doc['name'], safe='')}"
         )
+        trace_id = current_trace_id()
         for endpoint in peers:
             if endpoint == self.advertise_url:
                 continue
             breaker = self.directory.breaker(endpoint)
             if not breaker.allow():
                 continue
-            req = Request(endpoint + path, headers={
+            headers = {
                 "Range": f"bytes={lo}-{lo + logical - 1}",
                 # One hop only: the serving peer reads local tiers.
                 "X-NoiseEC-Route": "direct",
-            })
-            try:
-                with urlopen(
-                    req, timeout=self.peer_timeout_seconds
-                ) as resp:
-                    etag = (resp.headers.get("ETag") or "").strip('"')
-                    if etag != address:
+            }
+            if trace_id is not None:
+                # Trace context propagation: the serving peer's request
+                # scope adopts this id, so the collector merges its
+                # local-tier spans into THIS request's fleet-wide trace.
+                headers["X-NoiseEC-Trace"] = trace_id
+            req = Request(endpoint + path, headers=headers)
+            # One span per peer attempt — outcome + bytes per endpoint
+            # is what makes a straggling or dead warm peer visible in
+            # the trace's critical path.
+            with span("peer_fetch", peer=endpoint, stripe=i) as sp:
+                try:
+                    with urlopen(
+                        req, timeout=self.peer_timeout_seconds
+                    ) as resp:
+                        etag = (resp.headers.get("ETag") or "").strip('"')
+                        if etag != address:
+                            raise ValueError(
+                                f"peer serves address {etag!r}, "
+                                f"wanted {address!r}"
+                            )
+                        blob = resp.read(logical + 1)
+                    if len(blob) != logical:
                         raise ValueError(
-                            f"peer serves address {etag!r}, "
-                            f"wanted {address!r}"
+                            f"peer served {len(blob)} bytes, "
+                            f"wanted {logical}"
                         )
-                    blob = resp.read(logical + 1)
-                if len(blob) != logical:
-                    raise ValueError(
-                        f"peer served {len(blob)} bytes, wanted {logical}"
-                    )
-            except Exception as exc:  # noqa: BLE001 — a dead cache peer
-                # degrades to the decode tier, never breaks the read
-                breaker.record_failure()
-                log.debug("warm-peer fetch from %s failed: %s",
-                          endpoint, exc)
-                continue
-            breaker.record_success()
-            return blob
+                except Exception as exc:  # noqa: BLE001 — a dead cache
+                    # peer degrades to the decode tier, never breaks
+                    # the read
+                    breaker.record_failure()
+                    sp.set_attr(outcome="error", bytes=0)
+                    log.debug("warm-peer fetch from %s failed: %s",
+                              endpoint, exc)
+                    continue
+                breaker.record_success()
+                sp.set_attr(outcome="ok", bytes=len(blob))
+                return blob
         return None
 
     def _read_stripe(self, key: str) -> tuple[bytes, bool]:
@@ -992,12 +1125,13 @@ class ObjectStore:
         """Drop the manifest, release the quota, and evict stripes no
         other manifest references. Local-only: replicas keep their
         copies (v1 — see module docstring)."""
-        doc = self.resolve(tenant, name)
-        addr = doc["address"]
-        with self._lock:
-            self._index.pop((tenant, name), None)
-        self._drop_address(addr)
-        self._metrics.delete(tenant)
+        with request("delete", tenant=tenant, name=name):
+            doc = self.resolve(tenant, name)
+            addr = doc["address"]
+            with self._lock:
+                self._index.pop((tenant, name), None)
+            self._drop_address(addr)
+            self._metrics.delete(tenant)
 
     def _drop_address(self, addr: str) -> None:
         # Invalidation-by-address: DELETE and overwrite-PUT both land

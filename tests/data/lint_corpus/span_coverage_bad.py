@@ -1,0 +1,25 @@
+"""span-coverage corpus: /objects handlers with no request span.
+
+Both mounted handlers below serve traced object-service routes but
+never open a request scope — each mount line must produce one finding.
+"""
+
+
+class API:
+    def mount_routes(self, server):
+        server.mount("GET", "/objects", self._get, prefix=True)
+        server.mount("PUT", "/objects/", self._put, prefix=True)
+
+    def _get(self, req):
+        return 200, "text/plain", b"ok"
+
+    def _put(self, req):
+        return 201, "text/plain", b"ok"
+
+
+def mount_module_handler(server):
+    server.mount("DELETE", "/objects/", bare_delete, prefix=True)
+
+
+def bare_delete(req):
+    return 204, "text/plain", b""

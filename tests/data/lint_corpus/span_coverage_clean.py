@@ -1,0 +1,29 @@
+"""span-coverage clean twin: every /objects handler opens a request
+span, or its mount carries an explicit suppression, or the route is
+outside the traced /objects table entirely."""
+
+from noise_ec_tpu.obs.trace import default_tracer
+from noise_ec_tpu.obs.trace import request as trace_request
+
+
+class API:
+    def mount_routes(self, server):
+        server.mount("GET", "/objects", self._get, prefix=True)
+        server.mount("PUT", "/objects/", self._put, prefix=True)
+        # A deliberately untraced debug route: loud, justified.
+        server.mount("GET", "/objects-raw", self._raw)  # noise-ec: allow(span-coverage) — debug dump route, excluded from the tracing contract
+        server.mount("GET", "/metrics", self._metrics)
+
+    def _get(self, req):
+        with trace_request("get", route="http"):
+            return 200, "text/plain", b"ok"
+
+    def _put(self, req):
+        with default_tracer().request("put"):
+            return 201, "text/plain", b"ok"
+
+    def _raw(self, req):
+        return 200, "text/plain", b"raw"
+
+    def _metrics(self, req):
+        return 200, "text/plain", b""

@@ -192,6 +192,58 @@ def test_merge_histograms_bucket_wise():
     assert render_parsed(parse_prometheus(text)) == text
 
 
+def test_placement_census_merges_max_not_sum():
+    """Every node's rebalancer publishes its own view of the SAME
+    per-domain shard census (PR 17), so the fleet view must take the
+    most complete report per domain — summing would count each shard
+    once per reporter (ISSUE 18 satellite: pin the policy AND the
+    merge)."""
+    assert GAUGE_POLICIES["noise_ec_placement_shards"] == "max"
+
+    def doc(counts: dict[str, int]) -> str:
+        reg = Registry()
+        g = reg.gauge("noise_ec_placement_shards")
+        for domain, n in counts.items():
+            g.labels(domain=domain).set(n)
+        return render_prometheus(reg)
+
+    docs = {
+        "n0": doc({"rack0": 7, "rack1": 3}),
+        "n1": doc({"rack0": 5, "rack1": 9}),
+    }
+    fams = {f["name"]: f for f in merge_documents(docs)}
+    census = {
+        dict(labels)["domain"]: raw
+        for _, labels, raw in fams["noise_ec_placement_shards"]["samples"]
+    }
+    assert census == {"rack0": "7", "rack1": "9"}  # max per domain, not 12
+
+
+def test_merge_forwards_histogram_exemplars():
+    """A kept-trace exemplar on a node's bucket line survives the fleet
+    merge: /fleet/metrics still answers "show me one request behind this
+    bucket" (docs/observability.md "Request tracing")."""
+
+    def doc(trace: str | None) -> str:
+        reg = Registry()
+        hist = reg.histogram("noise_ec_object_get_seconds").labels()
+        hist.observe(0.002, exemplar=trace)
+        return render_prometheus(reg)
+
+    docs = {"n0": doc("req-00c0ffee00c0ffee"), "n1": doc(None)}
+    fams = {f["name"]: f for f in merge_documents(docs)}
+    text = render_parsed([fams["noise_ec_object_get_seconds"]])
+    assert 'trace_id="req-00c0ffee00c0ffee"' in text
+    # Counts still merged bucket-wise under the exemplar.
+    count = [
+        s for s in fams["noise_ec_object_get_seconds"]["samples"]
+        if s[0].endswith("_count")
+    ][0]
+    assert count[2] == "2"
+    # The merged exposition with exemplars still round-trips.
+    assert render_parsed(parse_prometheus(text)) == text
+
+
 # -- federator scraping -----------------------------------------------------
 
 

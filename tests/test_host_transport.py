@@ -999,8 +999,16 @@ def test_vectored_frame_parts_byte_identical_to_legacy():
             # frame parses + verifies like any legacy-built frame.
             op, pid, payload, sig = TCPNetwork._parse_frame(frame[4:])
             assert op == _OP_SHARD_BATCH
-            assert _decode_shard_batch(payload) == shards
-            assert _decode_shard_batch(memoryview(payload)) == shards
+            assert _decode_shard_batch(payload) == (shards, None)
+            assert _decode_shard_batch(memoryview(payload)) == (shards, None)
+            # Optional trailing trace block: round-trips, and a traced
+            # payload is the untraced one plus exactly the block.
+            traced = b"".join(
+                _encode_shard_batch_parts(shards, trace="req-00aabbccddeeff11")
+            )
+            assert traced.startswith(b"".join(batch_parts))
+            got, rt = _decode_shard_batch(traced)
+            assert got == shards and rt == "req-00aabbccddeeff11"
             assert net._sig.verify(
                 pid.public_key,
                 net._hash.hash_bytes(

@@ -156,6 +156,46 @@ def test_capture_bundle_contents_and_spans_window(tmp_path):
     assert doc["traceEvents"]
 
 
+def test_bundle_embeds_sampled_request_traces(tmp_path):
+    """ISSUE 18 satellite: an incident bundle groups the tail-sampled
+    request traces of its window under ``traces`` — whole requests
+    (wire legs joined via the ``request_trace`` attr), with background
+    spans and sampler-dropped requests excluded."""
+    import time
+
+    reg = Registry()
+    tr = Tracer(registry=Registry())
+    tr.sample_n = 1  # keep every surviving request
+    rec = FlightRecorder(
+        registry=reg, tracer=tr, incident_dir=str(tmp_path),
+        min_bundle_interval=0.0,
+    )
+    rec.tick()
+    with tr.request("get", tenant="t0") as scope:
+        with tr.span("peer_fetch", peer="p1"):
+            pass
+    # A wire leg recorded under its own signature-keyed trace id in
+    # another process, stamped with the originating request.
+    tr.ingest([{
+        "seq": 0, "trace_id": "deadbeefcafef00d", "name": "deliver",
+        "start": time.time(), "seconds": 0.001, "parent": None,
+        "attrs": {"request_trace": scope.trace_id},
+    }])
+    with tr.span("scrub"):  # background work: no request ancestor
+        pass
+    tr.sample_n = 10**9
+    with tr.request("get") as dropped:  # sampler discards this one
+        pass
+    assert dropped.decision == "dropped"
+
+    bundle = rec.capture("request")
+    assert set(bundle["traces"]) == {scope.trace_id}
+    names = {s["name"] for s in bundle["traces"][scope.trace_id]}
+    assert {"request", "peer_fetch", "deliver"} <= names
+    # The flat span list still carries the background span.
+    assert "scrub" in {s["name"] for s in bundle["spans"]}
+
+
 def test_incident_route_serves_bundle():
     reg = Registry()
     rec = FlightRecorder(registry=reg, tracer=Tracer(registry=Registry()))

@@ -1,0 +1,470 @@
+"""Request-scoped tracing: tail-sampling determinism, never-drop
+guarantees for slow/error traces, the holding-ring byte bound under a
+span stampede, and exemplar resolution (obs/trace.py,
+docs/observability.md "Request tracing")."""
+
+from __future__ import annotations
+
+import random
+import threading
+
+import pytest
+
+from noise_ec_tpu.obs.registry import Registry
+from noise_ec_tpu.obs.trace import Tracer
+
+
+def _tracer(**over) -> Tracer:
+    tr = Tracer(registry=Registry())
+    # Pin the incarnation so minted req- ids are reproducible run-to-run.
+    tr.epoch = 1_000_000
+    tr.sample_seed = 7
+    for k, v in over.items():
+        setattr(tr, k, v)
+    return tr
+
+
+def _decisions(tr: Tracer) -> dict[str, float]:
+    fam = tr._registry.counter("noise_ec_trace_requests_total")
+    return {values[0]: child.value for values, child in fam.children()}
+
+
+# -- determinism ------------------------------------------------------------
+
+
+def test_same_seed_and_sequence_keeps_identical_trace_set():
+    """Two tracers with the same (epoch, sample_seed) running the same
+    op sequence keep byte-identical trace sets — the sampling contract
+    an operator relies on when diffing two captures of one workload."""
+    kept_runs = []
+    for _ in range(2):
+        tr = _tracer()
+        kept = []
+        for i in range(200):
+            with tr.request("get", tenant=f"t{i % 3}") as scope:
+                with tr.span("cache_probe"):
+                    pass
+            if scope.kept:
+                kept.append(scope.trace_id)
+        kept_runs.append(kept)
+    assert kept_runs[0] == kept_runs[1]
+    assert kept_runs[0]  # the sample is not empty over 200 requests
+    # And the kept traces (only those) are what reached the span ring.
+    tr2 = _tracer()
+    for i in range(200):
+        with tr2.request("get", tenant=f"t{i % 3}"):
+            pass
+    ring_ids = {s["trace_id"] for s in tr2.dump()}
+    assert ring_ids == set(kept_runs[0])  # same minted sequence
+
+
+def test_sampling_decision_is_independent_of_completion_order():
+    """The seeded hash keys on the trace id alone, so shuffling the
+    completion order of the same request population keeps the same
+    set (adopted ids stand in for concurrent arrival order)."""
+    ids = [f"req-{i:016x}" for i in range(300)]
+    kept_sets = []
+    for order_seed in (1, 2):
+        tr = _tracer()
+        order = list(ids)
+        random.Random(order_seed).shuffle(order)
+        kept = set()
+        for tid in order:
+            with tr.request("get", trace_id=tid) as scope:
+                pass
+            if scope.kept:
+                kept.add(scope.trace_id)
+        kept_sets.append(kept)
+    assert kept_sets[0] == kept_sets[1]
+
+
+def test_clean_path_keep_rate_is_about_one_in_sample_n():
+    """sample_n=20 keeps ~5% of clean fast traces (the ISSUE bar:
+    <= 5% of the clean path, modulo hash noise)."""
+    tr = _tracer()
+    n = 2000
+    kept = 0
+    for _ in range(n):
+        with tr.request("get") as scope:
+            pass
+        kept += scope.kept
+    assert 0.02 <= kept / n <= 0.09
+    d = _decisions(tr)
+    assert d.get("kept_sampled") == kept
+    assert d.get("dropped") == n - kept
+
+
+# -- never-drop guarantees --------------------------------------------------
+
+
+def test_error_traces_are_always_kept():
+    tr = _tracer(sample_n=10**9)  # sampling alone would keep nothing
+    for i in range(20):
+        with pytest.raises(RuntimeError):
+            with tr.request("put") as scope:
+                with tr.span("stripe_put"):
+                    raise RuntimeError("shed")
+        assert scope.decision == "kept_error"
+    d = _decisions(tr)
+    assert d.get("kept_error") == 20
+    # Every error trace reached the ring, root span marked errored.
+    traces = tr.traces()
+    assert len(traces) == 20
+    for spans in traces.values():
+        root = [s for s in spans if s["name"] == "request"][0]
+        assert "error" in root
+
+
+def test_missing_object_get_mints_kept_error_trace():
+    """A resolve-time miss raises before get_range's streaming scope
+    exists; the short replay scope must still mint a kept trace —
+    without it the most common GET error class would be invisible to
+    the tail sampler."""
+    from noise_ec_tpu.host.plugin import ShardPlugin
+    from noise_ec_tpu.host.transport import (
+        LoopbackHub,
+        LoopbackNetwork,
+        format_address,
+    )
+    from noise_ec_tpu.obs.trace import default_tracer
+    from noise_ec_tpu.service import ObjectStore
+    from noise_ec_tpu.store import StripeStore
+
+    tr = default_tracer()
+    tr.clear()
+    hub = LoopbackHub()
+    net = LoopbackNetwork(hub, format_address("tcp", "localhost", 4700))
+    store = StripeStore()
+    plug = ShardPlugin(backend="numpy", store=store)
+    net.add_plugin(plug)
+    objects = ObjectStore(store, plug, net, stripe_bytes=8 << 10, k=4, n=6)
+    with pytest.raises(KeyError):
+        objects.read("acme", "no-such-object")
+    kept = [
+        spans for spans in tr.traces().values()
+        if any(s["name"] == "request" and "error" in s for s in spans)
+    ]
+    assert kept, sorted(tr.traces())
+
+
+def test_slow_traces_are_always_kept():
+    tr = _tracer(sample_n=10**9)
+    tr.set_p95_provider(lambda op: 0.0)  # everything is "slower than p95"
+    for _ in range(20):
+        with tr.request("get") as scope:
+            pass
+        assert scope.decision == "kept_slow"
+    assert _decisions(tr).get("kept_slow") == 20
+    assert len(tr.traces()) == 20
+
+
+def test_broken_p95_feed_degrades_to_sampling_not_failure():
+    tr = _tracer()
+
+    def bad(op):
+        raise ValueError("histogram too thin")
+
+    tr.set_p95_provider(bad)
+    with tr.request("get") as scope:
+        pass
+    assert scope.decision in ("kept_sampled", "dropped")
+
+
+def test_dropped_traces_never_reach_ring_or_collector_surface():
+    tr = _tracer(sample_n=10**9)
+    for _ in range(50):
+        with tr.request("get") as scope:
+            with tr.span("cache_probe"):
+                pass
+        assert scope.decision == "dropped"
+        assert scope.exemplar() is None
+    assert tr.dump() == []
+    assert tr.held_bytes() == 0  # nothing left pinned after commit
+
+
+# -- holding-ring byte bound ------------------------------------------------
+
+
+def test_holding_ring_byte_bound_holds_under_stampede():
+    """Concurrent requests each recording fat spans must never pin more
+    than hold_max_bytes; overflow evicts oldest whole traces (decision
+    ``evicted``) and an oversized single trace sheds its own oldest
+    spans — RAM is the cap, not the request rate."""
+    tr = _tracer(sample_n=1, hold_max_bytes=6_000)  # keep all survivors
+    high_water = []
+    results = []
+    lock = threading.Lock()
+
+    def one_request(i: int) -> None:
+        with tr.request("get") as scope:
+            for j in range(40):
+                with tr.span("peer_fetch", peer=f"peer-{i}",
+                             blob="x" * 200, n=j):
+                    pass
+                hb = tr.held_bytes()
+                with lock:
+                    high_water.append(hb)
+        with lock:
+            results.append(scope.decision)
+
+    threads = [
+        threading.Thread(target=one_request, args=(i,)) for i in range(16)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert max(high_water) <= tr.hold_max_bytes
+    assert tr.held_bytes() == 0
+    assert len(results) == 16
+    # Under this much pressure some traces were evicted whole…
+    d = _decisions(tr)
+    assert d.get("evicted", 0) == results.count("evicted")
+    # …and whatever survived was kept (sample_n=1 keeps every survivor).
+    assert results.count("kept_sampled") == d.get("kept_sampled", 0)
+    assert set(results) <= {"kept_sampled", "evicted"}
+
+
+def test_oversized_single_trace_sheds_oldest_spans_keeps_root():
+    tr = _tracer(sample_n=1, hold_max_bytes=1_500)
+    with tr.request("get") as scope:
+        for j in range(50):
+            with tr.span("peer_fetch", blob="y" * 100, n=j):
+                pass
+            assert tr.held_bytes() <= tr.hold_max_bytes
+    assert scope.decision == "kept_sampled"
+    spans = tr.dump(trace_id=scope.trace_id)
+    names = [s["name"] for s in spans]
+    # The root survived the shedding; the oldest children did not.
+    assert "request" in names
+    assert 0 < names.count("peer_fetch") < 50
+
+
+# -- scope surface ----------------------------------------------------------
+
+
+def test_nested_request_joins_one_root_one_decision():
+    tr = _tracer(sample_n=1)
+    with tr.request("get") as outer:
+        with tr.request("get") as inner:  # e.g. peer handler re-enters
+            assert inner.trace_id == outer.trace_id
+            assert tr.current_trace_id() == outer.trace_id
+    assert outer.kept
+    assert _decisions(tr) == {"kept_sampled": 1.0}
+    roots = [
+        s for s in tr.dump(trace_id=outer.trace_id)
+        if s["name"] == "request"
+    ]
+    assert len(roots) == 1
+
+
+def test_same_process_adopted_scope_defers_decision_to_originator():
+    """A serving leg adopting an in-flight trace id in the SAME tracer
+    (fleet-lab / loopback rigs route peer fetches back into one
+    process) merges its spans into the originator's holding buffer and
+    makes no sampling decision of its own — exactly one commit per
+    request, made by the scope that minted the id."""
+    tr = _tracer(sample_n=1)
+    with tr.request("get") as origin:
+        tid = origin.trace_id
+
+        def serving_leg():
+            with tr.request("get", trace_id=tid) as leg:
+                with tr.span("local_join"):
+                    pass
+            assert leg.decision is None  # non-owner: no commit
+
+        t = threading.Thread(target=serving_leg)
+        t.start()
+        t.join()
+        with tr.span("peer_fetch", peer="p"):
+            pass
+    assert origin.decision == "kept_sampled"
+    assert _decisions(tr) == {"kept_sampled": 1.0}
+    names = {s["name"] for s in tr.dump(trace_id=tid)}
+    assert {"request", "local_join", "peer_fetch"} <= names
+
+
+def test_adopted_trace_id_and_exemplar_resolution():
+    tr = _tracer(sample_n=1)
+    with tr.request("get", trace_id="req-feedfacefeedface") as scope:
+        assert tr.current_trace_id() == "req-feedfacefeedface"
+    assert scope.exemplar() == "req-feedfacefeedface"
+    assert tr.current_trace_id() is None
+
+
+def test_disabled_tracer_costs_nothing_and_keeps_nothing():
+    tr = _tracer(enabled=False)
+    with tr.request("get") as scope:
+        assert scope.trace_id is None
+    assert scope.kept is False
+    assert tr.dump() == []
+    assert _decisions(tr) == {}
+
+
+# -- fleet acceptance -------------------------------------------------------
+
+
+def _trace_report():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        import trace_report
+    finally:
+        sys.path.pop(0)
+    return trace_report
+
+
+def test_fleet_zipfian_get_straggler_trace_and_exemplar():
+    """ISSUE 18 acceptance: a 50-peer loopback fleet serving a zipfian
+    GET mix with ONE slow warm peer yields (a) a kept, merged request
+    trace whose per-peer fetch spans name the straggler, and (b) a
+    trace-id exemplar on the op-latency histogram's tail bucket that
+    resolves through ``tools/trace_report.py --op get``."""
+    import re
+    import time
+
+    import numpy as np
+
+    from noise_ec_tpu.host.plugin import ShardPlugin
+    from noise_ec_tpu.host.transport import (
+        LoopbackHub,
+        LoopbackNetwork,
+        format_address,
+    )
+    from noise_ec_tpu.obs.export import render_prometheus
+    from noise_ec_tpu.obs.registry import default_registry
+    from noise_ec_tpu.obs.server import StatsServer
+    from noise_ec_tpu.obs.trace import default_tracer
+    from noise_ec_tpu.service import DecodedObjectCache, ObjectAPI, ObjectStore
+    from noise_ec_tpu.store import RepairEngine, StripeStore
+
+    SLOW_S = 0.06
+
+    def full_node(hub, port, *, cache=None):
+        node = LoopbackNetwork(hub, format_address("tcp", "localhost", port))
+        store = StripeStore()
+        eng = RepairEngine(store, network=node, linger_seconds=0.0)
+        plugin = ShardPlugin(backend="numpy", store=store)
+        node.add_plugin(plugin)
+        return ObjectStore(
+            store, plugin, node, engine=eng, cache=cache,
+            stripe_bytes=8 << 10, k=4, n=6, fetch_timeout_seconds=0.5,
+            peer_timeout_seconds=1.0,
+        )
+
+    tr = default_tracer()
+    tr.clear()
+    hub = LoopbackHub()
+    a = full_node(hub, 4600, cache=DecodedObjectCache(max_bytes=32 << 20))
+    s = full_node(hub, 4601, cache=DecodedObjectCache(max_bytes=32 << 20))
+    b = full_node(hub, 4602, cache=DecodedObjectCache(max_bytes=32 << 20))
+    # Bystander peers: the other 47 fleet members the broadcasts reach.
+    bystanders = [
+        LoopbackNetwork(hub, format_address("tcp", "localhost", 4610 + i))
+        for i in range(47)
+    ]
+    assert len(hub.nodes) == 50
+
+    n_obj = 6
+    rng = np.random.default_rng(1807)
+    payloads = {
+        f"hot{i}": rng.integers(0, 256, size=16_000, dtype=np.uint8)
+        .tobytes()
+        for i in range(n_obj)
+    }
+    for name, blob in payloads.items():
+        a.put("acme", name, blob)
+
+    srv_a = StatsServer(registry=Registry())
+    srv_s = StatsServer(registry=Registry())
+    try:
+        ObjectAPI(a).mount(srv_a)
+        a.enable_peer_routing(srv_a.url)
+        a.engine.announce_once()
+
+        # S holds every stripe (broadcast absorb); warm its cache so
+        # the warm-set advert carries the addresses, then mount its
+        # /objects tree behind a fixed per-request delay — the one
+        # straggling peer in the fleet.
+        for name, blob in payloads.items():
+            assert s.read("acme", name) == blob
+        api_s = ObjectAPI(s)
+
+        def slow_get(req):
+            time.sleep(SLOW_S)
+            return api_s._get(req)
+
+        srv_s.mount("GET", "/objects", slow_get, prefix=True)
+        s.enable_peer_routing(srv_s.url)
+        time.sleep(0.01)  # S's advert is the freshest: tried first
+        s.engine.announce_once()
+        assert srv_s.url in b.directory.endpoints()
+
+        # Build the rolling GET p95 from warm traffic so the straggler
+        # legs register as tail (the slower-than-p95 keep rule).
+        for _ in range(40):
+            assert a.read("acme", "hot0") == payloads["hot0"]
+
+        # B can serve nothing locally: every stripe is below k.
+        for name in payloads:
+            doc = b.resolve("acme", name)
+            for key in set(doc["stripes"]):
+                for num in range(3):
+                    b.store.drop_shard(key, num)
+
+        # The zipfian mix: cold objects ride the slow warm peer once,
+        # then hit B's write-through cache.
+        for z in rng.zipf(1.3, size=120):
+            name = f"hot{(int(z) - 1) % n_obj}"
+            assert b.read("acme", name) == payloads[name]
+    finally:
+        srv_a.close()
+        srv_s.close()
+
+    trace_report = _trace_report()
+    traces = trace_report.group_traces(tr.dump())
+
+    # (a) The merged trace identifies the straggler: a kept GET trace
+    # whose longest per-peer fetch span names the slow endpoint.
+    slow_traces = {
+        tid: spans for tid, spans in traces.items()
+        if any(s["name"] == "peer_fetch" for s in spans)
+    }
+    assert slow_traces, sorted(traces)
+    for tid, spans in slow_traces.items():
+        fetches = [s for s in spans if s["name"] == "peer_fetch"]
+        straggler = max(fetches, key=lambda s: s["seconds"])
+        assert straggler["attrs"]["peer"] == srv_s.url
+        assert straggler["attrs"]["outcome"] == "ok"
+        assert straggler["attrs"]["bytes"] > 0
+        assert straggler["seconds"] >= SLOW_S * 0.8
+        # The serving node's adopted legs merged into the same trace.
+        assert any(
+            s["name"] == "local_join" for s in spans
+        ), [s["name"] for s in spans]
+
+    # (b) The tail bucket of the op-latency histogram carries an
+    # exemplar that resolves through trace_report --op get. The op
+    # family is shared through the default registry, so full-suite
+    # runs can leave exemplars from EARLIER traffic whose traces this
+    # tracer no longer holds (dangling exemplars are normal — scrape
+    # retention outlives trace retention); the acceptance is that this
+    # run's tail exemplar resolves, so pick the last one that does.
+    text = render_prometheus(default_registry())
+    tail_tid = None
+    for line in text.splitlines():
+        if (
+            line.startswith("noise_ec_object_op_seconds_bucket")
+            and 'op="get"' in line
+        ):
+            m = re.search(r'trace_id="(req-[0-9a-f]{16})"', line)
+            if m and m.group(1) in traces:
+                tail_tid = m.group(1)  # last match = largest le bucket
+    assert tail_tid is not None, "no resolvable exemplar on get buckets"
+    report = trace_report.render_op_report(traces, "get")
+    assert tail_tid in report
+    assert "peer_fetch" in report

@@ -556,6 +556,10 @@ def test_chaos_soak_eventual_delivery_and_health_flip(lockgraph, tmp_path):
     poller = threading.Thread(target=poll_health, daemon=True)
     poller.start()
 
+    from noise_ec_tpu.obs.trace import request as trace_request
+    probe_tids: list[str] = []
+    stop_probe = threading.Event()
+
     sent = []
     try:
         b.bootstrap([proxy.address])
@@ -568,6 +572,24 @@ def test_chaos_soak_eventual_delivery_and_health_flip(lockgraph, tmp_path):
         # aborts zero connections and the soak never exercises the
         # reconnect it asserts on (the transport-timing flake).
         proxy.rebase_clock()
+
+        # Failed GET probes throughout the soak: their kept_error
+        # request traces must ride the flip bundle (ISSUE 18 — incident
+        # bundles embed the degraded window's sampled traces, not just
+        # loose spans). Probing repeatedly keeps a fresh trace in the
+        # span ring however the flip lands against the soak's span
+        # stampede.
+        def probe_requests():
+            while not stop_probe.wait(0.2):
+                try:
+                    with trace_request("get", tenant="soak") as rscope:
+                        raise RuntimeError("degraded-window probe")
+                except RuntimeError:
+                    if rscope.decision == "kept_error":
+                        probe_tids.append(rscope.trace_id)
+
+        prober = threading.Thread(target=probe_requests, daemon=True)
+        prober.start()
 
         for i in range(200):
             payload = f"chaos soak msg {i:04d}!".encode()  # 20 B: k=5 stripes
@@ -622,6 +644,14 @@ def test_chaos_soak_eventual_delivery_and_health_flip(lockgraph, tmp_path):
         assert doc["trigger"] == "flip"
         assert doc["verdict"]["healthy"] is False
         assert doc["timeline"], "the pre-flip ring must ride the bundle"
+        # A sampled request trace from the degraded window rode the
+        # bundle whole (root span included), grouped under its req- id.
+        stop_probe.set()
+        carried = [t for t in probe_tids if t in doc["traces"]]
+        assert carried, (sorted(doc["traces"]), len(probe_tids))
+        assert "request" in {
+            s["name"] for s in doc["traces"][carried[0]]
+        }
         # The bundle loads in the offline reporter.
         import sys as _sys
         from pathlib import Path as _Path
@@ -642,6 +672,7 @@ def test_chaos_soak_eventual_delivery_and_health_flip(lockgraph, tmp_path):
         )
     finally:
         stop_poll.set()
+        stop_probe.set()
         recorder.close()
         server.close()
         proxy.close()

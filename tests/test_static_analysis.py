@@ -77,6 +77,7 @@ CORPUS_RULES = {
     "zero-copy": ("zero_copy_bad.py", "zero_copy_clean.py"),
     "metric-name": ("metric_name_bad.py", "metric_name_clean.py"),
     "span-stage": ("span_stage_bad.py", "span_stage_clean.py"),
+    "span-coverage": ("span_coverage_bad.py", "span_coverage_clean.py"),
 }
 
 # Project rules pinned by the synthetic-drift tests in this module.

@@ -163,6 +163,17 @@ PANEL_RIG_DECODE_RATIO_MAX = 1.25
 # (docs/placement.md).
 PLACEMENT_FANOUT_RATIO_MAX = 1.5
 
+# The ISSUE-18 tracing acceptance bar (trace_overhead_check, fresh
+# runs): hot cached GETs with the tail sampler ARMED must run within 3%
+# of the same mix with tracing disabled — above it request tracing is
+# taxing the clean path it exists to observe
+# (docs/observability.md "Request tracing"). The keep-rate bar holds
+# tail sampling honest: clean-path traces sample 1-in-sample_n (5% at
+# the default 20), so a keep rate past 25% on the all-hot bench mix
+# means the sampler is keeping traces it should drop.
+TRACE_OVERHEAD_PCT_MAX = 3.0
+TRACE_KEEP_RATE_MAX = 0.25
+
 
 def metric_direction(name: str) -> str | None:
     """'up' (higher better), 'down' (lower better), or None (skip)."""
@@ -371,6 +382,38 @@ def placement_rig_check(stats: dict) -> list[str]:
             f"rebalance_amplification {amp} is not a positive ratio — "
             "the churn rebalance drill did not move (or did not "
             "measure) the ownership delta"
+        )
+    return problems
+
+
+def trace_overhead_check(stats: dict) -> list[str]:
+    """ISSUE-18 acceptance bars for request tracing, fresh runs only
+    (recorded rounds before the tail sampler genuinely lack the keys).
+    ``trace_overhead_pct`` (armed vs disabled hot-GET wall time) must
+    stay <= 3%, and ``trace_keep_rate`` (kept share of the armed legs'
+    requests) must stay <= 0.25 — the clean path samples 1-in-sample_n,
+    so a higher keep rate means the sampler stopped dropping."""
+    problems = []
+    try:
+        pct = float(stats["trace_overhead_pct"])
+    except (KeyError, TypeError, ValueError):
+        pct = None
+    if pct is not None and pct > TRACE_OVERHEAD_PCT_MAX:
+        problems.append(
+            f"trace_overhead_pct {pct} above the "
+            f"{TRACE_OVERHEAD_PCT_MAX:g}% bar — armed tail sampling is "
+            "taxing the hot GET path (docs/observability.md "
+            '"Request tracing")'
+        )
+    try:
+        rate = float(stats["trace_keep_rate"])
+    except (KeyError, TypeError, ValueError):
+        return problems
+    if rate > TRACE_KEEP_RATE_MAX:
+        problems.append(
+            f"trace_keep_rate {rate} above the {TRACE_KEEP_RATE_MAX} "
+            "bar — the tail sampler is keeping clean-path traces it "
+            "should drop"
         )
     return problems
 
@@ -671,6 +714,7 @@ def main(argv: list[str] | None = None) -> int:
         problems.extend(lrc_repair_check(current))
         problems.extend(panel_rig_check(current))
         problems.extend(placement_rig_check(current))
+        problems.extend(trace_overhead_check(current))
     if args.json:
         print(json.dumps(
             {"against": against_name, "findings": findings,

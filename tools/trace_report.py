@@ -22,7 +22,14 @@ Usage:
     python tools/trace_report.py dump_a.json dump_b.json
     python tools/trace_report.py --peers http://127.0.0.1:9464,http://127.0.0.1:9465
     python tools/trace_report.py --quantiles 0.5,0.9,0.99 dump.json
+    python tools/trace_report.py --op get dump.json
     python tools/trace_report.py --incident incident-...-flip.json
+
+``--op`` reports only request-scoped traces (``req-...`` ids) whose
+root ``request`` span carries that op: every matching trace id is
+listed slowest-first (so a ``# {trace_id="req-..."}`` exemplar on a
+``/metrics`` histogram bucket resolves directly to its trace), followed
+by the per-tier critical path of the slowest few.
 
 File arguments may be ``/spans`` dump documents (``{"node", "spans",
 ...}`` — spans are stamped with the document's node id) or plain JSON
@@ -59,10 +66,27 @@ def load_spans(paths: list[str]) -> list[dict]:
 
 
 def group_traces(spans: list[dict]) -> dict[str, list[dict]]:
+    """Spans grouped into distributed traces. A span carrying a
+    ``request_trace`` attribute groups under that request id (same
+    merge rule as ``TraceCollector.traces``), so signature-keyed
+    pipeline legs land inside the user request that caused them."""
     out: dict[str, list[dict]] = {}
     for s in sorted(spans, key=lambda d: float(d.get("start", 0.0))):
-        out.setdefault(str(s.get("trace_id")), []).append(s)
+        attrs = s.get("attrs") or {}
+        tid = attrs.get("request_trace") or s.get("trace_id")
+        out.setdefault(str(tid), []).append(s)
     return out
+
+
+def request_op(trace: list[dict]) -> str | None:
+    """The ``op`` attribute of a trace's ``request`` root span (None
+    for traces with no request root — pure pipeline traces)."""
+    for s in trace:
+        if s.get("name") == "request":
+            op = (s.get("attrs") or {}).get("op")
+            if op is not None:
+                return str(op)
+    return None
 
 
 def _interval(s: dict) -> tuple[float, float]:
@@ -192,6 +216,54 @@ def render_report(
     return "\n".join(lines) + "\n"
 
 
+def render_op_report(
+    traces: dict[str, list[dict]], op: str, top: int = 5
+) -> str:
+    """Per-tier critical paths for the request traces of one op.
+
+    Lists every matching request trace id (slowest first) so an
+    exemplar's ``trace_id`` from ``/metrics`` resolves straight to its
+    trace here, then prints the per-(node, tier) self-time breakdown
+    for the ``top`` slowest — the tail the exemplars point at.
+    """
+    matching = {
+        tid: tr for tid, tr in traces.items() if request_op(tr) == op
+    }
+    if not matching:
+        return f"no request traces for op {op!r}\n"
+    ranked = sorted(
+        ((tid, e2e_seconds(tr)) for tid, tr in matching.items()),
+        key=lambda p: -p[1],
+    )
+    lines = [
+        f"{len(ranked)} {op!r} request trace(s); e2e max "
+        f"{ranked[0][1] * 1e3:.2f} ms, min {ranked[-1][1] * 1e3:.2f} ms"
+    ]
+    for tid, e2e in ranked:
+        lines.append(f"   {tid}  {e2e * 1e3:9.3f} ms")
+    for tid, e2e in ranked[:top]:
+        trace = matching[tid]
+        cp = critical_path(trace)
+        nodes = {str(s.get("node", "") or "unknown") for s in trace}
+        lines.append("")
+        lines.append(
+            f"== trace {tid}: e2e {e2e * 1e3:.2f} ms, "
+            f"{len(trace)} spans across {len(nodes)} node(s)"
+        )
+        for st in cp["stages"]:
+            lines.append(
+                f"   {st['stage']:<12} {st['node']:<32} "
+                f"{st['seconds'] * 1e3:9.3f} ms  {st['share'] * 100:5.1f}%"
+            )
+        dom = cp["dominant"]
+        if dom is not None:
+            lines.append(
+                f"   dominant: {dom['stage']} on {dom['node']} "
+                f"({dom['share'] * 100:.1f}% of e2e)"
+            )
+    return "\n".join(lines) + "\n"
+
+
 def render_incident(bundle: dict, top: int = 10) -> str:
     """The text report for one flight-recorder incident bundle:
     verdict-flip timeline, top metric deltas in the window, dominant
@@ -288,6 +360,13 @@ def main(argv=None) -> int:
         help="comma-separated quantiles to report (default 0.5,0.99)",
     )
     p.add_argument(
+        "-op", "--op", default="",
+        help="report only request traces for this op (get/put/delete): "
+        "list every matching trace id slowest-first, then the per-tier "
+        "critical path of the slowest few — resolves /metrics exemplar "
+        "trace ids",
+    )
+    p.add_argument(
         "-incident", "--incident", default="",
         help="flight-recorder incident bundle JSON: report the "
         "verdict-flip timeline, top metric deltas and dominant span "
@@ -315,6 +394,9 @@ def main(argv=None) -> int:
     if not spans:
         print("no spans found (pass dump files or --peers)", file=sys.stderr)
         return 1
+    if args.op:
+        print(render_op_report(group_traces(spans), args.op), end="")
+        return 0
     quantiles = tuple(float(x) for x in args.quantiles.split(",") if x)
     print(render_report(group_traces(spans), quantiles), end="")
     return 0
