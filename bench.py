@@ -1208,6 +1208,69 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — secondary stat only
         stats["placement_error"] = str(exc)[:80]
 
+    # --- hedged k-of-n GETs under one straggler (docs/object-service.md
+    # "Read path"). A targeted-placement fleet with a slow@ peer (every
+    # link touching peer 2 pays 120 ms) drives a GET-heavy mix; reads
+    # whose k-set lands on the straggler stall unhedged, while the
+    # hedged engine races a spare source at the clamped per-peer p95
+    # and cancels the loser. The stat is the hedged run's fleet-tenant
+    # GET p99 (ms, lower-better) — the straggler-bounded tail the
+    # ISSUE-19 acceptance names — smoke-gated on the hedge counters
+    # actually moving (requests fanned, at least one spare won).
+    try:
+        from noise_ec_tpu.fleet import FleetLab, FleetProfile
+        from noise_ec_tpu.obs.registry import default_registry as _hreg
+
+        h_base = (
+            "peers=24,fanout=4,msgs=64,object=1,get=2,object_bytes=8192,"
+            "stripe_bytes=4096,k=4,n=8,chaos=clean,domains@8,slow@2:120"
+        )
+
+        def _hedge_counts() -> dict:
+            reg = _hreg()
+            return {
+                key: float(
+                    reg.counter(f"noise_ec_hedge_{key}_total")
+                    .labels().value
+                )
+                for key in ("requests", "wins", "cancelled")
+            }
+
+        def _hedge_run(profile_s: str) -> dict:
+            lab = FleetLab(FleetProfile.parse(profile_s), seed=7)
+            lab.start()
+            try:
+                return lab.run()
+            finally:
+                lab.close()
+
+        # The registry is process-global and earlier sections may have
+        # hedged; delta the counters around the hedge=1 run alone.
+        h_before = _hedge_counts()
+        h_on = _hedge_run(h_base + ",hedge=1")
+        h_delta = {
+            key: val - h_before[key]
+            for key, val in _hedge_counts().items()
+        }
+        check_smoke(
+            h_on["delivery"]["rate"] >= 0.999,
+            f"hedge bench delivery {h_on['delivery']}",
+        )
+        check_smoke(
+            h_delta["requests"] > 0 and h_delta["wins"] > 0,
+            f"hedge bench: straggler run moved no hedge counters "
+            f"({h_delta})",
+        )
+        p99_hedged = h_on["tenant_get_p99_ms"].get("fleet", 0.0)
+        check_smoke(
+            p99_hedged > 0.0, "hedge bench: no fleet-tenant GET samples"
+        )
+        stats["object_get_p99_hedged_ms"] = round(p99_hedged, 3)
+    except SmokeMismatch:
+        raise  # deterministic correctness failure: fail the run
+    except Exception as exc:  # noqa: BLE001 — secondary stat only
+        stats["hedge_error"] = str(exc)[:80]
+
     # --- live-path coalescing: N concurrent senders whose same-geometry
     # encodes ride one node's CoalescingDispatcher (ops/coalesce.py) vs
     # the same N dispatches issued sequentially, one device call each.

@@ -278,7 +278,8 @@ SUBSYSTEM_DOCS: dict[str, dict] = {
         "prefixes": ("noise_ec_fleet_", "noise_ec_backpressure_"),
         "extras": (),
         "tokens": ("-fleet-profile", "-fleet-size", "-fleet-report",
-                   "/fleet", "churn@", "Retry-After"),
+                   "/fleet", "churn@", "Retry-After", "slow@",
+                   "noisy=", "hedge="),
     },
     "datapath": {
         "doc": "docs/design.md",
@@ -337,6 +338,15 @@ SUBSYSTEM_DOCS: dict[str, dict] = {
                    "noise_ec_object_tenant_shed_total"),
         "tokens": ("Tenant attribution", "object_get_p99_ms",
                    "tenant_isolation_p99_ratio"),
+    },
+    "hedge-qos": {
+        "doc": "docs/object-service.md",
+        "prefixes": ("noise_ec_hedge_", "noise_ec_lane_"),
+        "extras": ("noise_ec_peer_fetch_seconds",),
+        "tokens": ("Hedged", "X-NoiseEC-Hedge", "hedge_extra",
+                   "hedge_floor_seconds", "hedge_ceiling_seconds",
+                   "lane=", "weight=", "background_floor",
+                   "object_get_p99_hedged_ms"),
     },
     "request-tracing": {
         "doc": "docs/observability.md",

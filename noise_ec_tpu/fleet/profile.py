@@ -54,6 +54,22 @@ Grammar (docs/fleet.md):
 ``killdomain@T:NAME``  chaos: at T seconds, kill EVERY peer in failure
                        domain NAME at once (the rack-failure drill;
                        requires ``domains@``)
+``slow@PEER:MS[:JITTER]``  delay-only chaos on every link touching peer
+                       index PEER: MS milliseconds (+ uniform 0..JITTER
+                       ms, seeded) on each delivery to or from it, and
+                       on every placement gather fetch it serves — the
+                       one-straggler scenario the hedged read path is
+                       for (docs/fleet.md). Repeatable for several slow
+                       peers.
+``hedge=0|1``          disable/enable hedged k+Δ gather fan-out on the
+                       fleet's object read path (default 1; hedge=0 is
+                       the A/B control run)
+``noisy=M``            tenant-isolation mix: object/GET traffic splits
+                       into a "noisy" tenant submitting M× the "quiet"
+                       tenant's share (default 0 = single "fleet"
+                       tenant); the report then carries per-tenant GET
+                       latency so the QoS-lane isolation bar is
+                       checkable
 """
 
 from __future__ import annotations
@@ -77,8 +93,10 @@ NAMED_CHAOS: dict[str, str] = {
 _INT_KEYS = (
     "peers", "fanout", "msgs", "senders", "drivers",
     "chat_bytes", "object_bytes", "stripe_bytes", "k", "n", "churn_peers",
+    "hedge",
 )
-_FLOAT_KEYS = ("chat", "object", "get", "repair", "rate", "zipf_s")
+_FLOAT_KEYS = ("chat", "object", "get", "repair", "rate", "zipf_s",
+               "noisy")
 _CHAOS_PASSTHROUGH = ("churn@", "partition@", "reset@", "kill@")
 
 
@@ -120,6 +138,16 @@ class FleetProfile:
     domains: int = 0
     # (at_seconds, domain_name) whole-domain kills (``killdomain@``).
     domain_kills: tuple = ()
+    # (peer_idx, delay_s, jitter_s) per-peer straggler links (``slow@``,
+    # milliseconds in the grammar, seconds here).
+    slow_peers: tuple = ()
+    # Hedged k+Δ gather fan-out on the object read path (``hedge=0``
+    # is the A/B control run with the fan-out disabled).
+    hedge: int = 1
+    # Noisy-tenant multiplier (``noisy=M``): 0 = single "fleet" tenant;
+    # M > 0 splits object/GET traffic into "noisy" (share M/(M+1)) and
+    # "quiet" tenants for the QoS-isolation scenario.
+    noisy: float = 0.0
     chaos_name: str = "clean"
     churn_peers: int = 0   # 0 = ~5% of the fleet when churn is scheduled
     chaos: ChaosProfile = field(default_factory=ChaosProfile)
@@ -167,6 +195,26 @@ class FleetProfile:
                 kills = kwargs.setdefault("domain_kills", [])
                 kills.append((float(at_text), name.strip()))
                 continue
+            if tok.startswith("slow@"):
+                parts = tok[len("slow@"):].split(":")
+                if len(parts) not in (2, 3):
+                    raise ValueError(
+                        f"slow@ wants PEER:MS[:JITTER], got {tok!r}"
+                    )
+                idx = int(parts[0])
+                delay_ms = float(parts[1])
+                jitter_ms = float(parts[2]) if len(parts) == 3 else 0.0
+                if idx < 0:
+                    raise ValueError(
+                        f"slow@ peer index must be >= 0, got {idx}"
+                    )
+                if delay_ms < 0 or jitter_ms < 0:
+                    raise ValueError(
+                        f"slow@ delay/jitter must be >= 0 ms, got {tok!r}"
+                    )
+                slows = kwargs.setdefault("slow_peers", [])
+                slows.append((idx, delay_ms / 1000.0, jitter_ms / 1000.0))
+                continue
             if "=" not in tok:
                 raise ValueError(f"unparseable fleet token {tok!r}")
             key, _, val = tok.partition("=")
@@ -194,6 +242,8 @@ class FleetProfile:
         )
         if "domain_kills" in kwargs:
             kwargs["domain_kills"] = tuple(kwargs["domain_kills"])
+        if "slow_peers" in kwargs:
+            kwargs["slow_peers"] = tuple(kwargs["slow_peers"])
         prof = cls(chaos_name=chaos_name, chaos=chaos, **kwargs)
         prof.validate()
         return prof
@@ -258,6 +308,18 @@ class FleetProfile:
                     f"killdomain@ names unknown domain {name!r} "
                     f"(domains@{self.domains} declares d0..d{self.domains - 1})"
                 )
+        for idx, delay_s, jitter_s in self.slow_peers:
+            if not 0 <= idx < self.peers:
+                raise ValueError(
+                    f"slow@ peer index {idx} outside [0, peers-1="
+                    f"{self.peers - 1}]"
+                )
+            if delay_s < 0 or jitter_s < 0:
+                raise ValueError("slow@ delay/jitter must be >= 0")
+        if self.hedge not in (0, 1):
+            raise ValueError(f"hedge must be 0 or 1, got {self.hedge}")
+        if self.noisy < 0:
+            raise ValueError(f"noisy must be >= 0, got {self.noisy}")
         if self.msgs < 1:
             raise ValueError(f"msgs must be >= 1, got {self.msgs}")
         if self.stripe_bytes < self.k:

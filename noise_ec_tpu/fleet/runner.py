@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import itertools
 import json
 import logging
 import struct
@@ -104,6 +105,8 @@ class FleetPeer:
         )
         self.store = None
         self.objects = None
+        # Seeded per-call jitter stream for slow@ placement fetches.
+        self._fetch_seq = itertools.count()
         if profile.needs_stores():
             from noise_ec_tpu.store import StripeStore
 
@@ -139,6 +142,7 @@ class FleetPeer:
                 # A below-k stripe with no repair engine cannot heal;
                 # fail reads fast instead of stalling the scorer.
                 fetch_timeout_seconds=0.2,
+                hedge_enabled=bool(profile.hedge),
             )
 
     # ---- the network surface the plugin drives
@@ -174,13 +178,32 @@ class FleetPeer:
         """Owner-slot fetch for the gather read path: a direct snapshot
         of the target peer's store (the lab's stand-in for a directed
         fetch RPC). Raises for a down/storeless peer — the gather
-        degrades per-owner."""
+        degrades per-owner. A ``slow@`` endpoint pays its declared link
+        delay here too, so the hedged gather actually races the slow
+        source (docs/fleet.md)."""
         lab = self._lab()
         if lab is None:
             raise RuntimeError("lab is gone")
         peer = lab.peers[int(handle)]
         if not peer.up or peer.store is None:
             raise RuntimeError(f"peer {handle} is down")
+        delay, jitter = lab.slow_edge(self.idx, peer.idx)
+        if delay or jitter:
+            u = 0.0
+            if jitter:
+                # Seeded, call-indexed jitter keeps slow fetches
+                # reproducible across runs with the same lab seed.
+                draw = hashlib.blake2b(
+                    struct.pack(
+                        "<QIIQ", lab.seed & (2**64 - 1),
+                        self.idx, peer.idx, next(self._fetch_seq),
+                    ),
+                    digest_size=8,
+                ).digest()
+                u = int.from_bytes(draw, "little") / 2.0**64
+            # Same cap as the hub's _deliver: a mis-profiled delay must
+            # not wedge a gather worker for seconds.
+            time.sleep(min(delay + jitter * u, 0.25))
         _, shards, _ = peer.store.snapshot(key)
         return {i: b for i, b in enumerate(shards) if b is not None}
 
@@ -255,7 +278,10 @@ class FleetHub:
             conn_id = sender.idx * len(lab.peers) + ridx
             link = self.links.setdefault(
                 (sender.idx, ridx),
-                ChaosLink(lab.profile.chaos, lab.seed, conn_id, "a2b"),
+                ChaosLink(
+                    lab.link_chaos(sender.idx, ridx),
+                    lab.seed, conn_id, "a2b",
+                ),
             )
         now = self.now()
         ok = True
@@ -329,6 +355,12 @@ class FleetLab:
             profile.validate()
         self.profile = profile
         self.seed = seed
+        # slow@PEER:MS[:JITTER] → {peer index: (delay_s, jitter_s)};
+        # every link touching a slow peer pays the extra delay.
+        self._slow = {
+            int(idx): (float(d), float(j))
+            for idx, d, j in profile.slow_peers
+        }
         self.p99_target_seconds = p99_target_seconds
         self.slo_success_target = slo_success_target
         self.dispatch_workers = dispatch_workers
@@ -377,6 +409,32 @@ class FleetLab:
         self.errors.append(exc)
         self.error_count += 1
 
+    # ---- slow-peer link shaping (profile ``slow@PEER:MS[:JITTER]``)
+
+    def slow_edge(self, a_idx: int, b_idx: int) -> tuple:
+        """``(delay_s, jitter_s)`` the profile's ``slow@`` entries add
+        to the a↔b link — (0, 0) unless an endpoint is slow; the larger
+        delay wins when both are."""
+        best = (0.0, 0.0)
+        for idx in (a_idx, b_idx):
+            entry = self._slow.get(idx)
+            if entry is not None and entry[0] >= best[0]:
+                best = entry
+        return best
+
+    def link_chaos(self, a_idx: int, b_idx: int):
+        """The chaos profile for the directed link a→b: the base
+        profile, plus any ``slow@`` delay/jitter when either endpoint
+        is a declared slow peer. Links between two fast peers share the
+        unmodified base profile object."""
+        delay, jitter = self.slow_edge(a_idx, b_idx)
+        base = self.profile.chaos
+        if not delay and not jitter:
+            return base
+        return dataclasses.replace(
+            base, delay=base.delay + delay, jitter=base.jitter + jitter
+        )
+
     # -------------------------------------------------------------- build
 
     def start(self) -> "FleetLab":
@@ -413,7 +471,8 @@ class FleetLab:
             for ridx in peer.neighbors:
                 conn_id = peer.idx * prof.peers + ridx
                 self.hub.links[(peer.idx, ridx)] = ChaosLink(
-                    prof.chaos, self.seed, conn_id, "a2b"
+                    self.link_chaos(peer.idx, ridx),
+                    self.seed, conn_id, "a2b",
                 )
         if prof.domains:
             self._build_placement()
@@ -465,7 +524,8 @@ class FleetLab:
         for peer in self.peers:
             token = f"fleet://{peer.idx}"
             peer.plugin.placement = TargetedDelivery(
-                self.ring, self_token=token
+                self.ring, self_token=token,
+                hedge=bool(prof.hedge),
             )
             if peer.store is not None:
                 self.rebalancers[peer.idx] = Rebalancer(
@@ -582,6 +642,12 @@ class FleetLab:
         report["backpressure_waits"] = _backpressure_waits()
         report["gets"] = dict(self.get_results)
         report["wire_sends"] = self.hub.sends
+        reg = default_registry()
+        report["hedge"] = {
+            key: int(reg.counter(f"noise_ec_hedge_{key}_total")
+                     .labels().value)
+            for key in ("requests", "wins", "cancelled", "late")
+        }
         if self.ring is not None:
             self.scorer.note_placement({
                 "domains": self.profile.domains,
@@ -687,17 +753,25 @@ class FleetLab:
         payload = rng.bytes(prof.object_bytes)
         expected = self._expected(sender, stores_only=True)
         name = f"o{sender.idx}-{int(rng.integers(0, 2**31))}"
+        tenant = "fleet"
+        if prof.noisy > 0:
+            # noisy=M tenant mix: M noisy submissions per quiet one, so
+            # the noisy share is M/(M+1). The per-tenant op histograms
+            # plus the scorer's independent tenant_get samples are what
+            # the QoS-isolation scenario reads back (docs/fleet.md).
+            share = prof.noisy / (prof.noisy + 1.0)
+            tenant = "noisy" if float(rng.random()) < share else "quiet"
         try:
-            sender.objects.put("fleet", name, payload)
+            sender.objects.put(tenant, name, payload)
         except ShedError as exc:
             self.scorer.shed("object", sender.idx, exc.reason,
                              exc.retry_after)
             return None
         msg_id = self.scorer.begin("object", sender.idx, expected)
         digest = hashlib.blake2b(payload, digest_size=16).digest()
-        self.scorer.add_object(msg_id, "fleet", name, digest)
+        self.scorer.add_object(msg_id, tenant, name, digest)
         with self._obj_lock:
-            self._put_objects.append(("fleet", name, digest))
+            self._put_objects.append((tenant, name, digest))
         return msg_id
 
     def submit_get(self, peer: FleetPeer, rng) -> None:
@@ -714,7 +788,9 @@ class FleetLab:
         if not objs:
             self.submit_chat(peer, rng)
             return
-        from noise_ec_tpu.service.objects import ShedError
+        from noise_ec_tpu.service.objects import (
+            ShedError, UnknownObjectError,
+        )
 
         # Zipf rank (s > 1) over the put ledger: rank 1 = the hottest.
         rank = int(rng.zipf(self.profile.zipf_s))
@@ -728,9 +804,19 @@ class FleetLab:
         except ShedError as exc:
             self.get_results["shed"] += 1
             self.scorer.shed("get", peer.idx, exc.reason, exc.retry_after)
-        except Exception:  # noqa: BLE001 — the object may simply not
-            # have replicated to this peer (bounded-degree overlay);
-            # delivery scoring owns loss accounting, not the GET mix
+        except UnknownObjectError:
+            # Manifest never replicated to this peer (bounded-degree
+            # overlay): the read failed at resolve, BEFORE the op
+            # histogram's timing scope — no scorer sample either, so
+            # the two per-tenant views stay aligned.
+            self.get_results["missing"] += 1
+        except Exception:  # noqa: BLE001 — a below-k/unavailable read;
+            # delivery scoring owns loss accounting, not the GET mix.
+            # The op histogram DID time this read (its finally runs on
+            # the unavailable path), so record the same wall time here —
+            # the scorer's per-tenant p99 and the fleet-merged histogram
+            # p99 must estimate the same sample set.
+            self.scorer.tenant_get(tenant, time.monotonic() - t0)
             self.get_results["missing"] += 1
         else:
             # Scorer-side wall time for the same read the tenant-labeled
@@ -901,6 +987,8 @@ class FleetLab:
     def _verify_objects(self) -> None:
         """Post-run GET verification: every expected receiver must serve
         each put object byte-identical through its own service layer."""
+        from noise_ec_tpu.service.objects import UnknownObjectError
+
         with self.scorer._lock:
             objects = dict(self.scorer.objects)
             sent = {m: dict(r) for m, r in self.scorer.sent.items()}
@@ -920,7 +1008,14 @@ class FleetLab:
                     data = receiver.objects.read(
                         obj["tenant"], obj["name"], shed=False
                     )
-                except Exception:  # noqa: BLE001 — not delivered
+                except UnknownObjectError:
+                    continue  # not delivered; no op-histogram sample
+                except Exception:  # noqa: BLE001 — not delivered, but
+                    # the op histogram timed the failed read: mirror it
+                    # so scorer and histogram p99 stay comparable.
+                    self.scorer.tenant_get(
+                        obj["tenant"], time.monotonic() - t0
+                    )
                     continue
                 # Verification reads land in the tenant-labeled op
                 # histogram too; keep the scorer's sample set aligned.

@@ -114,17 +114,21 @@ class ShardPool:
         self._total_bytes = 0
         cls = type(self)
         cls._instances.add(self)
+        # Re-registered on every construction (idempotent — the closures
+        # read the CLASS WeakSet): the test-isolation registry reset
+        # drops callback children, and a once-guard would leave the
+        # gauges dead for the rest of the process.
+        reg = default_registry()
+        reg.gauge("noise_ec_mempool_pools").set_callback(
+            lambda: sum(len(p) for p in list(ShardPool._instances))
+        )
+        reg.gauge("noise_ec_mempool_pinned_bytes").set_callback(
+            lambda: sum(
+                p.pinned_bytes for p in list(ShardPool._instances)
+            )
+        )
         if not ShardPool._gauges_registered:
             ShardPool._gauges_registered = True
-            reg = default_registry()
-            reg.gauge("noise_ec_mempool_pools").set_callback(
-                lambda: sum(len(p) for p in list(ShardPool._instances))
-            )
-            reg.gauge("noise_ec_mempool_pinned_bytes").set_callback(
-                lambda: sum(
-                    p.pinned_bytes for p in list(ShardPool._instances)
-                )
-            )
             fam = reg.counter("noise_ec_mempool_evictions_total")
             ShardPool._eviction_counters = {
                 reason: fam.labels(reason=reason)

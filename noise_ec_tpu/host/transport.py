@@ -422,6 +422,42 @@ class _WireConn(asyncio.BufferedProtocol):
             # through the owning loop.
             loop.call_soon_threadsafe(t.close)
 
+    def half_close(self) -> None:
+        """FIN our send side but keep draining inbound frames until the
+        peer's own FIN answers (mutual-dial loser demotion): a hard
+        ``close()`` discards inbound data still unread in the kernel
+        buffer, losing frames the peer wrote before it learned the
+        tie-break verdict. A backstop timer full-closes if the peer
+        never FINs back (``eof_received`` returning False makes a
+        well-behaved peer close promptly)."""
+        loop = self._wire_loop
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if loop is None or running is loop:
+            self._half_close_here()
+        else:
+            loop.call_soon_threadsafe(self._half_close_here)
+
+    # noise-ec: loop-affine
+    def _half_close_here(self) -> None:
+        t = self.transport
+        if t is None or t.is_closing():
+            return
+        try:
+            if not t.can_write_eof():
+                t.close()
+                return
+            t.write_eof()
+        except Exception:  # noqa: BLE001 — fall back to the hard close
+            t.close()
+            return
+        if self._wire_loop is not None:
+            self._wire_loop.call_later(
+                self.net.connection_timeout, self.close
+            )
+
     def is_closing(self) -> bool:
         return self.transport is None or self.transport.is_closing()
 
@@ -1129,6 +1165,15 @@ class TCPNetwork:
         self._posted_bytes: dict[asyncio.StreamWriter, int] = {}
         self._flush_handles: dict[asyncio.StreamWriter, asyncio.TimerHandle] = {}
         self._draining: set[asyncio.StreamWriter] = set()
+        # Frames addressed to a connection that died mid-swap (mutual-dial
+        # tie-break demotion, or the remote's demotion FIN) are re-routed
+        # to the peer's surviving connection — or parked here, keyed by
+        # peer public key, until its registration lands. Guarded by
+        # self._lock; entries are (parked-at monotonic, bytes, batches)
+        # and expire after connection_timeout (checked lazily on insert
+        # and flush — no timer), so a peer that never comes back costs at
+        # most MAX_PEER_WRITE_BUFFER bytes until close().
+        self._limbo: dict[bytes, tuple[float, int, list]] = {}
         # Discovery state: addresses we are responsible for dialing (dedup +
         # budget). Entries are removed on dial failure and on disconnect of
         # the dialed peer, so churned peers can be re-learned from gossip.
@@ -1258,6 +1303,8 @@ class TCPNetwork:
         self._closing = True
         if self.supervisor is not None:
             self.supervisor.close()
+        with self._lock:
+            self._limbo.clear()
 
         async def _shutdown():
             if self._server is not None:
@@ -1615,6 +1662,79 @@ class TCPNetwork:
     # -- write path (event-loop thread only) --
 
     # noise-ec: loop-affine
+    @staticmethod
+    def _writer_pubkey(writer) -> Optional[bytes]:
+        """The registered peer public key a connection's frames are
+        addressed to, or None for handshake-stage connections and
+        writer fakes without a ``conn``."""
+        conn = getattr(writer, "conn", None)
+        peer = getattr(conn, "peer", None)
+        return getattr(peer, "public_key", None)
+
+    def _reroute_frames(
+        self, pubkey: bytes, parts: list, nframes: int, nbytes: int,
+        exclude=None,
+    ) -> None:
+        """Re-address coalesced frames whose connection is dying to the
+        peer's CURRENT connection. Simultaneous mutual dials resolve by
+        closing one of the two connections (the ``_register`` tie-break),
+        and a broadcast can race that swap: its frames are posted to the
+        connection that loses — on either side — and a hard teardown
+        would drop them on the floor (observed: the three-process
+        discovery e2e losing a one-shot broadcast sent the instant the
+        gossip-built edge re-registered). If the survivor is not
+        registered YET (the eviction→re-registration gap), the frames
+        park in ``_limbo`` and flush when its registration lands."""
+        target = None
+        with self._lock:
+            if self._closing:
+                return
+            peer = self.peers.get(pubkey)
+            if (
+                peer is not None
+                and peer.writer is not exclude
+                and not getattr(peer.writer, "is_closing", lambda: False)()
+            ):
+                target = peer.writer
+                self._posted_bytes[target] = (
+                    self._posted_bytes.get(target, 0) + nbytes
+                )
+            else:
+                now = time.monotonic()
+                parked_at, parked_bytes, batches = self._limbo.get(
+                    pubkey, (now, 0, [])
+                )
+                if now - parked_at > self.connection_timeout:
+                    parked_at, parked_bytes, batches = now, 0, []
+                if parked_bytes + nbytes <= self.MAX_PEER_WRITE_BUFFER:
+                    batches.append((parts, nframes, nbytes))
+                    self._limbo[pubkey] = (
+                        parked_at, parked_bytes + nbytes, batches
+                    )
+        if target is not None:
+            self._writer_loop(target).call_soon_threadsafe(
+                self._enqueue_frames, target, parts, nframes, nbytes
+            )
+
+    def _flush_limbo(self, pubkey: bytes, writer) -> None:
+        """Hand any parked frames for ``pubkey`` to its freshly
+        registered connection (expired parks are dropped)."""
+        with self._lock:
+            parked = self._limbo.pop(pubkey, None)
+            if parked is None:
+                return
+            parked_at, parked_bytes, batches = parked
+            if time.monotonic() - parked_at > self.connection_timeout:
+                return
+            self._posted_bytes[writer] = (
+                self._posted_bytes.get(writer, 0) + parked_bytes
+            )
+        loop = self._writer_loop(writer)
+        for parts, nframes, nbytes in batches:
+            loop.call_soon_threadsafe(
+                self._enqueue_frames, writer, parts, nframes, nbytes
+            )
+
     def _enqueue_frames(
         self, writer: asyncio.StreamWriter, parts: list, nframes: int,
         nbytes: int,
@@ -1624,6 +1744,22 @@ class TCPNetwork:
         ``write_buffer_size`` bytes or ``send_window`` frames, otherwise
         after ``write_flush_latency``. Runs on the writer's owning
         loop."""
+        if getattr(writer, "is_closing", lambda: False)():
+            # The connection died between the broadcast's peer-table
+            # snapshot and this loop callback (mutual-dial swap, remote
+            # FIN): writing would vanish into a closed transport.
+            with self._lock:
+                left = self._posted_bytes.get(writer, 0) - nbytes
+                if left > 0:
+                    self._posted_bytes[writer] = left
+                else:
+                    self._posted_bytes.pop(writer, None)
+            pubkey = self._writer_pubkey(writer)
+            if pubkey is not None:
+                self._reroute_frames(
+                    pubkey, parts, nframes, nbytes, exclude=writer
+                )
+            return
         if writer.transport.get_write_buffer_size() > self.MAX_PEER_WRITE_BUFFER:
             self._drop_writer(writer)  # also clears _posted_bytes
             self._record_error(
@@ -1666,6 +1802,16 @@ class TCPNetwork:
         nframes = self._pending_frames.pop(writer, 0)
         if not pend:
             self._pending_bytes.pop(writer, None)
+            return
+        if getattr(writer, "is_closing", lambda: False)():
+            # The connection died while this batch coalesced; re-address
+            # it instead of writing into a closed transport.
+            nbytes = self._pending_bytes.pop(writer, 0)
+            pubkey = self._writer_pubkey(writer)
+            if pubkey is not None:
+                self._reroute_frames(
+                    pubkey, pend, nframes, nbytes, exclude=writer
+                )
             return
         try:
             # _pending_bytes is cleared only after the batch lands in the
@@ -1774,23 +1920,42 @@ class TCPNetwork:
     def _drop_writer(self, writer: asyncio.StreamWriter,
                      reason: str = "") -> None:
         lost_dialed: list[str] = []
+        lost_addrs: list[str] = []
         with self._lock:
             for key, p in list(self.peers.items()):
                 if p.writer is writer:
                     del self.peers[key]
+                    lost_addrs.append(p.pid.address)
                     # Allow gossip to re-establish a churned peer.
                     self._dialing.discard(p.pid.address)
                     if p.dial_address is not None:
                         self._dialing.discard(p.dial_address)
                         lost_dialed.append(p.dial_address)
+        for address in lost_addrs:
+            # INFO mirror of "registered peer": operators (and the e2e
+            # tests) can pair every registration with its teardown
+            # instead of inferring loss from silence.
+            log.info("dropped peer %s%s", address,
+                     f" ({reason})" if reason else "")
         handle = self._flush_handles.pop(writer, None)
         if handle is not None:
             handle.cancel()
-        self._pending.pop(writer, None)
-        self._pending_frames.pop(writer, None)
-        self._pending_bytes.pop(writer, None)
+        pend = self._pending.pop(writer, None)
+        pend_frames = self._pending_frames.pop(writer, 0)
+        pend_bytes = self._pending_bytes.pop(writer, 0)
         with self._lock:
             self._posted_bytes.pop(writer, None)
+        if pend:
+            # Shard frames already addressed to this peer must survive a
+            # connection swap (mutual-dial demotion): hand them to the
+            # surviving connection, or park them until it registers.
+            # Handshake-stage writers have no peer identity; their
+            # control frames drop with the connection, as before.
+            pubkey = self._writer_pubkey(writer)
+            if pubkey is not None:
+                self._reroute_frames(
+                    pubkey, pend, pend_frames, pend_bytes, exclude=writer
+                )
         try:
             writer.close()
         except Exception:  # noqa: BLE001
@@ -1966,15 +2131,49 @@ class TCPNetwork:
                     dial_address=conn.dial_address,
                 )
         if prev is not None and prev.writer is not writer:
-            # Close the loser; its read-loop teardown calls _drop_writer,
-            # which only removes entries whose writer matches — the
-            # surviving entry is never evicted by the teardown.
+            # Demote the loser GRACEFULLY: the remote may have written
+            # frames on it before learning the tie-break verdict (the
+            # other side registers the loser first and can broadcast on
+            # it immediately), and a hard close() discards whatever is
+            # still unread in the kernel buffer — a one-shot message
+            # vanishes with no teardown signal the sender can act on.
+            # half_close() FINs our send side while the read loop keeps
+            # draining; the loser's conn identity stays verified, so
+            # those late frames still deliver, and the teardown
+            # completes when the remote's own FIN answers. Its read-loop
+            # teardown calls _drop_writer, which only removes entries
+            # whose writer matches — the surviving entry is never
+            # evicted by the teardown.
+            loser = prev.writer if keep_new else writer
+            log.info("demoting duplicate connection to %s (%s survives)",
+                     pid.address, "new" if keep_new else "previous")
+            half = getattr(loser, "half_close", None)
             try:
-                (prev.writer if keep_new else writer).close()
+                if half is not None:
+                    half()
+                else:
+                    loser.close()
             except Exception:  # noqa: BLE001
                 pass
+            # Frames coalescing on the loser can no longer flush (its
+            # send side just FINned); re-address them to the survivor.
+            handle = self._flush_handles.pop(loser, None)
+            if handle is not None:
+                handle.cancel()
+            pend = self._pending.pop(loser, None)
+            lost_frames = self._pending_frames.pop(loser, 0)
+            lost_bytes = self._pending_bytes.pop(loser, 0)
+            if pend:
+                self._reroute_frames(
+                    pid.public_key, pend, lost_frames, lost_bytes,
+                    exclude=loser,
+                )
         conn.registered.set()
         if keep_new:
+            # A registration that swapped the peer's connection releases
+            # any frames that were parked while no live connection held
+            # the entry (a broadcast racing the swap).
+            self._flush_limbo(pid.public_key, writer)
             # INFO so operators (and the e2e tests) can observe exactly
             # when a peer becomes reachable instead of probing with
             # retried sends.

@@ -16,7 +16,11 @@ clock (``clock.now``) at render time; the collector brackets the request
 with its own wall-clock reads and assumes the render happened at the RTT
 midpoint, so ``offset = peer_now - (t0 + t1) / 2`` with uncertainty
 ±RTT/2. The estimate with the smallest RTT across polls wins (least
-queue-delayed sample). Where the transport measured a HELLO handshake
+queue-delayed sample), and the *applied* correction is the raw estimate
+soft-thresholded by its own uncertainty: an offset the sample cannot
+distinguish from zero is measurement noise, and applying it would skew
+peers whose clocks actually agree (same host, NTP-disciplined fleet) by
+up to RTT/2 — enough to break span nesting across nodes. Where the transport measured a HELLO handshake
 RTT to the same peer (``TCPNetwork.handshake_rtts()``), that tighter
 bound refines the *uncertainty* — the TCP-level handshake skips the
 HTTP/json overhead, so it is the truer floor on one-way delay.
@@ -55,6 +59,17 @@ class PeerClock:
         self.offset = offset
         self.rtt = rtt
         self.uncertainty = uncertainty
+
+    def applied_offset(self) -> float:
+        """The correction actually applied to this peer's spans: the raw
+        estimate shrunk toward zero by its own uncertainty (soft
+        threshold). A sample cannot testify to any offset smaller than
+        its error bound, so the sub-uncertainty part is noise — and on
+        clock-agreeing peers applying it is what *introduces* skew."""
+        mag = abs(self.offset) - self.uncertainty
+        if mag <= 0.0:
+            return 0.0
+        return mag if self.offset > 0.0 else -mag
 
     def as_dict(self) -> dict:
         return {
@@ -195,7 +210,7 @@ class TraceCollector:
                 # at read time, so a later, lower-RTT (better) estimate
                 # retroactively re-aligns everything already collected.
                 self._clocks[peer] = sample
-                self._offsets[node_id] = sample.offset
+                self._offsets[node_id] = sample.applied_offset()
             self._nodes[peer] = node_meta
             self._epochs[peer] = epoch
             self._cursors[peer] = int(doc.get("next_since", 0))

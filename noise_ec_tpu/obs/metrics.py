@@ -161,6 +161,18 @@ class Histogram:
                     break
         return out
 
+    def reset(self) -> None:
+        """Zero counts/sum and drop retained exemplar refs in place —
+        child identity (and any caller-cached references) survive, so
+        instrumented layers keep recording into the same object. The
+        test-isolation boundary (tests/conftest.py) resets the default
+        registry through this."""
+        with self._lock:
+            self._counts = [0] * (len(self.bounds) + 1)
+            self.sum = 0.0
+            self.count = 0
+            self._exemplars.clear()
+
     def snapshot(self) -> dict:
         """(bounds, per-bucket counts, sum, count[, exemplars]) — a
         consistent copy; ``exemplars`` (bucket index -> trace ref) only

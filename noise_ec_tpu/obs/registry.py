@@ -606,6 +606,39 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "tenant, labeled by tenant and reason (slo, hbm)",
         ("tenant", "reason"),
     ),
+    # --- hedged reads (service/objects.py, docs/object-service.md
+    # "Read path": the hedge tier's trigger/cancel/accounting contract)
+    "noise_ec_hedge_requests_total": (
+        "counter",
+        "Stripe fetches that entered the hedged fetch engine (>= 2 "
+        "ranked sources available, hedging enabled)",
+        (),
+    ),
+    "noise_ec_hedge_wins_total": (
+        "counter",
+        "Hedged fetches won by a hedge (a source launched AFTER the "
+        "primary because the per-peer p95 trigger fired)",
+        (),
+    ),
+    "noise_ec_hedge_cancelled_total": (
+        "counter",
+        "Losing in-flight fetches aborted after another source won "
+        "(connection closed, worker reaped — never leaked)",
+        (),
+    ),
+    "noise_ec_hedge_late_total": (
+        "counter",
+        "Losing fetches that completed between the winner's arrival "
+        "and their cancellation (work done, result discarded)",
+        (),
+    ),
+    "noise_ec_peer_fetch_seconds": (
+        "histogram",
+        "Warm-peer stripe fetch latency per peer endpoint (capped at "
+        "an 'other' bucket past the cardinality limit) — the per-peer "
+        "distribution whose p95 arms the hedge trigger",
+        ("peer",),
+    ),
     # --- host<->device data path (ops/coalesce.py, ops/dispatch.py
     # buffer pool; docs/design.md "host<->device data path" owns the
     # buffer lifecycle and flush policy those series instrument)
@@ -691,6 +724,20 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "Occupied slots plus blocked producers per bounded queue, "
         "labeled by layer (device, dispatch), read at collect time",
         ("layer",),
+    ),
+    # --- QoS lanes (ops/dispatch.py device gate; docs/object-service.md
+    # "QoS lanes" owns the lane/weight grammar and starvation floor)
+    "noise_ec_lane_queue_depth": (
+        "gauge",
+        "Waiters queued at the device gate per QoS lane (live, "
+        "background), read at collect time",
+        ("lane",),
+    ),
+    "noise_ec_lane_grants_total": (
+        "counter",
+        "Contended device-gate grants by QoS lane (live, background) — "
+        "the background share proves the starvation floor drains",
+        ("lane",),
     ),
     # --- fleet lab (noise_ec_tpu/fleet, docs/fleet.md)
     "noise_ec_fleet_peers": (
@@ -972,12 +1019,22 @@ class Family:
 
     def set_callback(self, fn: Callable[[], float], **labels: str) -> None:
         """Install a collect-time callback gauge child (queue depths and
-        other live values that would be racy to mirror on every event)."""
+        other live values that would be racy to mirror on every event).
+
+        An existing child is mutated IN PLACE rather than replaced:
+        callers cache ``labels()`` handles, and a handle grabbed before
+        the owning object registered its callback (or re-grabbed after a
+        test-isolation reset dropped the callback) must start reading
+        the live value, not a dead zero."""
         if self.type != "gauge":
             raise ValueError(f"{self.name} is a {self.type}, not a gauge")
         key = tuple(str(labels.get(k, "")) for k in self.label_names)
         with self._lock:
-            self._children[key] = _Gauge(fn)
+            child = self._children.get(key)
+            if child is not None:
+                child.fn = fn
+            else:
+                self._children[key] = _Gauge(fn)
 
     def children(self) -> Iterable[tuple[tuple[str, ...], object]]:
         with self._lock:
@@ -1027,6 +1084,31 @@ class Registry:
         with self._lock:
             fams = dict(self._families)
         return [fams[n] for n in self._declarations if n in fams]
+
+    def reset_values(self) -> None:
+        """Zero every child's recorded state IN PLACE: counter and gauge
+        values, histogram counts + exemplars. Child identity is kept, so
+        references cached by instrumented layers stay live and keep
+        recording. Callback-gauge children are DROPPED: their closures
+        pin whatever object registered them (a gate, a lab) and would
+        keep exporting a dead object's state across a test boundary —
+        the next object's ``set_callback`` re-creates the child. This is
+        the tests' isolation boundary (tests/conftest.py), not a
+        production surface: a running node never resets its registry."""
+        with self._lock:
+            fams = list(self._families.values())
+        for fam in fams:
+            with fam._lock:
+                for key, child in list(fam._children.items()):
+                    if isinstance(child, _Counter):
+                        child.value = 0.0
+                    elif isinstance(child, _Gauge):
+                        if child.fn is not None:
+                            del fam._children[key]
+                        else:
+                            child.value = 0.0
+                    else:
+                        child.reset()
 
 
 _default = Registry()

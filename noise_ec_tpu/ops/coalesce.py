@@ -49,14 +49,71 @@ from __future__ import annotations
 
 import threading
 import time
+from contextlib import contextmanager
 from typing import Callable, Optional, Sequence
 
-__all__ = ["CoalescingDispatcher", "coalescer", "configure_coalescer"]
+__all__ = [
+    "CoalescingDispatcher",
+    "QOS_LANES",
+    "coalescer",
+    "configure_coalescer",
+    "current_qos",
+    "qos_lane",
+]
 
 # A follower must never wait forever on a leader that died violently
 # (thread killed between append and flush); after this many seconds it
 # raises instead of hanging the receive path.
 _FOLLOWER_TIMEOUT_S = 120.0
+
+# ------------------------------------------------------------ QoS lanes
+#
+# The device gate and this dispatcher are SHARED by every producer in
+# the process: live GET decodes, repair drains, scrub verifies, archival
+# conversions. Without classification, one tenant's decode storm (or a
+# background repair burst) queues ahead of everyone at the gate — the
+# noisy-neighbor tail the ISSUE's DRF-style fairness addresses. The QoS
+# context is a thread-local (lane, tenant, weight) tag set by the layer
+# that KNOWS the traffic class (the object service tags per-tenant live
+# work from the Tenant policy grammar; repair/scrub/convert/rebalance
+# loops tag themselves background) and read by the admission points
+# (DeviceGate.acquire's weighted lane queues, this dispatcher's linger
+# budget). Thread-local — not a call argument — because the tag must
+# survive the codec call stack without threading a parameter through
+# every matmul signature. A coalesced batch runs on its leader's thread
+# and therefore rides the leader's lane; members of one bucket share a
+# (backend, field, matrix, shape) key, so cross-lane mixing inside one
+# batch is bounded by the linger window and costs at most one batch.
+
+QOS_LANES = ("live", "background")
+
+_qos_local = threading.local()
+
+
+def current_qos() -> tuple[str, str, int]:
+    """The calling thread's ``(lane, tenant, weight)`` QoS tag —
+    ``("live", "", 1)`` outside any :func:`qos_lane` scope."""
+    return getattr(_qos_local, "ctx", ("live", "", 1))
+
+
+@contextmanager
+def qos_lane(lane: str, tenant: str = "", weight: int = 1):
+    """Tag the calling thread's device-gate/coalescer admissions with a
+    QoS class for the duration of the scope (module comment). Nests:
+    the previous tag is restored on exit."""
+    if lane not in QOS_LANES:
+        raise ValueError(
+            f"unknown QoS lane {lane!r} (lanes: {', '.join(QOS_LANES)})"
+        )
+    prev = getattr(_qos_local, "ctx", None)
+    _qos_local.ctx = (lane, tenant, max(1, int(weight)))
+    try:
+        yield
+    finally:
+        if prev is None:
+            del _qos_local.ctx
+        else:
+            _qos_local.ctx = prev
 
 
 class _Bucket:
@@ -89,12 +146,18 @@ class CoalescingDispatcher:
     ``_mul``; tests build their own with shrunk knobs."""
 
     def __init__(self, *, linger_seconds: float = 0.0005,
-                 max_batch: int = 32, hot_window_seconds: float = 0.005):
+                 max_batch: int = 32, hot_window_seconds: float = 0.005,
+                 background_linger_x: float = 4.0):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if background_linger_x < 1.0:
+            raise ValueError(
+                f"background_linger_x must be >= 1, got {background_linger_x}"
+            )
         self.linger_seconds = linger_seconds
         self.max_batch = max_batch
         self.hot_window_seconds = hot_window_seconds
+        self.background_linger_x = background_linger_x
         self._lock = threading.Lock()
         self._buckets: dict = {}
         self._flights: dict = {}  # single-flight tier (submit_shared)
@@ -238,7 +301,12 @@ class CoalescingDispatcher:
     def _linger_budget(self) -> float:
         """The bounded latency budget: the base linger, scaled by the
         device-gate queue depth (a deep gate queue means the batch would
-        block at admission anyway, so a longer linger costs nothing)."""
+        block at admission anyway, so a longer linger costs nothing).
+        Background-lane leaders under pressure linger
+        ``background_linger_x`` longer still — repair/scrub batches
+        YIELD the contended gate to live GETs (collecting bigger
+        batches while they wait), the coalescer half of the QoS-lane
+        story (the gate's weighted queues are the other half)."""
         if self.linger_seconds <= 0:
             return 0.0
         depth = 0
@@ -249,7 +317,10 @@ class CoalescingDispatcher:
             depth = gate.in_flight + gate.waiters
         except Exception:  # noqa: BLE001 — linger must not require jax
             pass
-        return max(self.linger_seconds, self.linger_seconds * depth)
+        budget = max(self.linger_seconds, self.linger_seconds * depth)
+        if depth > 0 and current_qos()[0] == "background":
+            budget *= self.background_linger_x
+        return budget
 
     def _lead(self, bucket: _Bucket, linger: float,
               reason: Optional[str] = None) -> None:

@@ -23,6 +23,7 @@ import logging
 import threading
 import time
 import weakref
+from collections import deque
 from typing import Optional
 
 import jax
@@ -51,6 +52,7 @@ from noise_ec_tpu.obs.device import (
     maybe_analyze_program,
 )
 from noise_ec_tpu.obs.profiling import record_kernel
+from noise_ec_tpu.ops.coalesce import QOS_LANES, current_qos
 
 _FIELDS = {"gf256": GF256, "gf65536": GF65536}
 
@@ -203,6 +205,46 @@ def _resolve_kernel(kernel: str) -> str:
 # the noise_ec_backpressure_* family (layer="device"); a wait past
 # ``wait_timeout`` proceeds anyway — the gate is a governor, not a
 # deadlock (same escape contract as TCPNetwork.wait_writable).
+#
+# QoS lanes (docs/object-service.md "QoS lanes"): a contended gate no
+# longer drains FIFO. Waiters queue per (lane, tenant) — the lane and
+# tenant come from the ambient ``qos_lane`` context the admitting layer
+# set (ops/coalesce.py) — and freed slots are HANDED to a queued ticket
+# in release() rather than raced for, so the pick order below is the
+# actual service order:
+#
+# - live beats background: a repair/scrub/convert burst queued in the
+#   background lane cannot delay an interactive GET behind it;
+# - starvation floor: background still gets >= 1 of every
+#   ``background_floor`` contended grants, so a saturating live tenant
+#   cannot park repair forever (durability work must progress);
+# - inside a lane, tenants share by smooth weighted round-robin on
+#   their ``weight=`` policy token — a 10x-noisy tenant's queue drains
+#   at its weight's share, not at its arrival rate.
+#
+# The governor escape is unchanged: a ticket that waits past
+# ``wait_timeout`` abandons its queue slot and proceeds anyway.
+
+
+class _Ticket:
+    """One queued waiter; ``granted`` flips under the gate lock when
+    release() hands it the freed slot (in_flight already charged)."""
+
+    __slots__ = ("granted",)
+
+    def __init__(self):
+        self.granted = False
+
+
+class _TenantQueue:
+    """One tenant's FIFO inside a lane + its smooth-WRR credit."""
+
+    __slots__ = ("weight", "current", "tickets")
+
+    def __init__(self, weight: int):
+        self.weight = max(1, int(weight))
+        self.current = 0
+        self.tickets: "deque[_Ticket]" = deque()
 
 
 class DeviceGate:
@@ -210,18 +252,39 @@ class DeviceGate:
 
     ``with gate:`` around a dispatch; reentrant nesting is NOT supported
     (DeviceCodec acquires only at its public entry points, which never
-    nest). Tests shrink ``capacity`` to pin the blocking behavior.
+    nest). Tests shrink ``capacity`` to pin the blocking behavior and
+    ``background_floor`` to pin the lane arbitration.
     """
 
-    def __init__(self, capacity: int = 8, wait_timeout: float = 120.0):
+    def __init__(
+        self,
+        capacity: int = 8,
+        wait_timeout: float = 120.0,
+        background_floor: int = 8,
+    ):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if background_floor < 2:
+            raise ValueError(
+                f"background_floor must be >= 2, got {background_floor}"
+            )
         self.capacity = capacity
         self.wait_timeout = wait_timeout
+        self.background_floor = background_floor
         self._cv = threading.Condition()
         self.in_flight = 0
         self.waiters = 0
         self.waits = 0  # local mirror of the counter (tests, reports)
+        # lane -> tenant -> _TenantQueue; lanes fixed, tenant queues are
+        # created on first wait and deleted when drained (bounds memory
+        # and resets a departed tenant's WRR credit).
+        self._queues: dict[str, dict[str, _TenantQueue]] = {
+            lane: {} for lane in QOS_LANES
+        }
+        self._lane_waiters = {lane: 0 for lane in QOS_LANES}
+        # Contended live grants since the last background grant (or
+        # since background stopped waiting) — the starvation-floor odometer.
+        self._live_streak = 0
         from noise_ec_tpu.obs.registry import default_registry
 
         reg = default_registry()
@@ -234,32 +297,120 @@ class DeviceGate:
         reg.gauge("noise_ec_backpressure_queue_depth").set_callback(
             lambda: self.in_flight + self.waiters, layer="device"
         )
+        depth_gauge = reg.gauge("noise_ec_lane_queue_depth")
+        for lane in QOS_LANES:
+            depth_gauge.set_callback(
+                lambda lane=lane: self._lane_waiters[lane], lane=lane
+            )
+        self._grants = {
+            lane: reg.counter("noise_ec_lane_grants_total").labels(lane=lane)
+            for lane in QOS_LANES
+        }
 
     def acquire(self) -> None:
+        lane, tenant, weight = current_qos()
         with self._cv:
-            if self.in_flight < self.capacity:
+            if self.in_flight < self.capacity and not self._queued():
                 self.in_flight += 1
+                self._grants[lane].add(1)
                 return
+            ticket = _Ticket()
+            q = self._queues[lane].get(tenant)
+            if q is None:
+                q = self._queues[lane][tenant] = _TenantQueue(weight)
+            else:
+                q.weight = max(1, int(weight))  # latest policy wins
+            q.tickets.append(ticket)
+            self._lane_waiters[lane] += 1
             self.waits += 1
             self._waits_total.add(1)
             t0 = time.monotonic()
             deadline = t0 + self.wait_timeout
             self.waiters += 1
             try:
-                while self.in_flight >= self.capacity:
+                while not ticket.granted:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         break  # governor, not a deadlock: proceed
                     self._cv.wait(min(remaining, 0.5))
             finally:
                 self.waiters -= 1
+                if not ticket.granted:
+                    # Governor escape: leave the queue and barge. The
+                    # ungranted ticket is still queued (grants happen
+                    # under this same lock), so discard is exact.
+                    self._discard(lane, tenant, ticket)
+                    self.in_flight += 1
+                    self._grants[lane].add(1)
             self._wait_hist.observe(time.monotonic() - t0)
-            self.in_flight += 1
 
     def release(self) -> None:
         with self._cv:
             self.in_flight -= 1
-            self._cv.notify()
+            self._grant_free_slots()
+            self._cv.notify_all()
+
+    # ------------------------------------------------ queue internals
+
+    def _queued(self) -> bool:
+        return any(self._lane_waiters[lane] for lane in QOS_LANES)
+
+    def _discard(self, lane: str, tenant: str, ticket: _Ticket) -> None:
+        q = self._queues[lane].get(tenant)
+        if q is None:
+            return
+        try:
+            q.tickets.remove(ticket)
+        except ValueError:
+            return
+        self._lane_waiters[lane] -= 1
+        if not q.tickets:
+            del self._queues[lane][tenant]
+
+    def _grant_free_slots(self) -> None:
+        """Hand every free slot to the next queued ticket (lock held)."""
+        while self.in_flight < self.capacity:
+            picked = self._pick()
+            if picked is None:
+                return
+            lane, ticket = picked
+            ticket.granted = True
+            self.in_flight += 1
+            self._grants[lane].add(1)
+            if lane == "background":
+                self._live_streak = 0
+            elif self._lane_waiters["background"]:
+                self._live_streak += 1
+            else:
+                self._live_streak = 0
+
+    def _pick(self) -> Optional[tuple[str, _Ticket]]:
+        live = self._queues["live"]
+        background = self._queues["background"]
+        if background and (
+            not live or self._live_streak >= self.background_floor - 1
+        ):
+            lane = "background"
+        elif live:
+            lane = "live"
+        else:
+            return None
+        queues = self._queues[lane]
+        # Smooth weighted round-robin (each queue's credit grows by its
+        # weight; the max-credit queue serves and repays the total), so
+        # grants interleave proportionally instead of bursting.
+        total = sum(q.weight for q in queues.values())
+        best_name = best = None
+        for name, q in queues.items():
+            q.current += q.weight
+            if best is None or q.current > best.current:
+                best_name, best = name, q
+        best.current -= total
+        ticket = best.tickets.popleft()
+        self._lane_waiters[lane] -= 1
+        if not best.tickets:
+            del queues[best_name]
+        return lane, ticket
 
     def __enter__(self) -> "DeviceGate":
         self.acquire()

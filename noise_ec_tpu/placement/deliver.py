@@ -23,6 +23,7 @@ never gather (``getattr`` probing, same as ``broadcast_many``).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Optional
 
 from noise_ec_tpu.obs.registry import default_registry
@@ -39,12 +40,45 @@ class TargetedDelivery:
     ``self_token`` is this node's own topology token (its shards are
     never self-sent — the origin already stores its full stripe)."""
 
-    def __init__(self, ring, *, self_token: Optional[str] = None):
+    def __init__(
+        self,
+        ring,
+        *,
+        self_token: Optional[str] = None,
+        hedge: bool = True,
+        hedge_extra: int = 1,
+        gather_timeout_seconds: float = 5.0,
+    ):
+        if hedge_extra < 0:
+            raise ValueError(f"hedge_extra must be >= 0, got {hedge_extra}")
         self.ring = ring
         self.self_token = self_token
+        # Hedged gather (docs/object-service.md "Read path"): with >= 2
+        # remote owners the gather fans to the owners needed for k PLUS
+        # ``hedge_extra`` next-ranked sources in parallel, decodes the
+        # moment any k distinct slots arrive, and abandons the losers —
+        # one straggling owner stops bounding the read's tail.
+        self.hedge = hedge
+        self.hedge_extra = hedge_extra
+        self.gather_timeout_seconds = gather_timeout_seconds
         reg = default_registry()
         self._m_saved = reg.counter(
             "noise_ec_placement_fanout_saved_total"
+        ).labels()
+        # Shared hedge accounting family (service/objects.py's peer tier
+        # feeds the same counters): every fan-out, hedge win, abandoned
+        # loser and post-decision completion is accounted, never leaked.
+        self._m_hedge_requests = reg.counter(
+            "noise_ec_hedge_requests_total"
+        ).labels()
+        self._m_hedge_wins = reg.counter(
+            "noise_ec_hedge_wins_total"
+        ).labels()
+        self._m_hedge_cancelled = reg.counter(
+            "noise_ec_hedge_cancelled_total"
+        ).labels()
+        self._m_hedge_late = reg.counter(
+            "noise_ec_hedge_late_total"
         ).labels()
 
     # -------------------------------------------------------------- send
@@ -160,11 +194,40 @@ class TargetedDelivery:
         alive = set(directory)
         if self.self_token is not None:
             alive.add(self.self_token)
-        for token in self.ring.owners(key, n, k=k, alive=alive):
-            if token is None or token == self.self_token:
-                continue
-            if token not in directory:
-                continue
+        # Ranked remote sources: ring-owner order (the ring already
+        # prefers live, domain-diverse owners), deduped — one owner may
+        # hold several of the stripe's slots and is asked once.
+        candidates = [
+            token
+            for token in dict.fromkeys(
+                self.ring.owners(key, n, k=k, alive=alive)
+            )
+            if token is not None
+            and token != self.self_token
+            and token in directory
+        ]
+        if candidates and len(collected) < k:
+            if self.hedge and len(candidates) >= 2:
+                self._gather_parallel(
+                    fetch, directory, key, n, k, candidates, collected
+                )
+            else:
+                self._gather_serial(
+                    fetch, directory, key, n, candidates, collected
+                )
+        if len(collected) < k:
+            return None
+        shard_lens = {len(b) for b in collected.values()}
+        return self._decode_gathered(store, key, k, n, field, code,
+                                     collected, shard_lens)
+
+    def _gather_serial(
+        self, fetch, directory, key: str, n: int,
+        candidates: list, collected: dict,
+    ) -> None:
+        """The pre-hedge sequential gather (hedging disabled, or a
+        single remote owner): ask each owner in rank order."""
+        for token in candidates:
             # One span per owner fetch: peer id + outcome + bytes, so a
             # straggling owner is visible in the GET's critical path.
             with span("gather_fetch", peer=token) as sp:
@@ -185,9 +248,135 @@ class TargetedDelivery:
                         nbytes += len(blob)
                         collected.setdefault(int(num), bytes(blob))
                 sp.set_attr(outcome="ok", bytes=nbytes, shards=len(got))
-        if len(collected) < k:
-            return None
-        shard_lens = {len(b) for b in collected.values()}
+
+    def _gather_parallel(
+        self, fetch, directory, key: str, n: int, k: int,
+        candidates: list, collected: dict,
+    ) -> None:
+        """The hedged k+Δ gather fan-out (constructor comment): launch
+        the owners needed to reach k plus ``hedge_extra`` hedges in
+        parallel, merge slots under one condition variable, and stop the
+        moment ``collected`` holds k distinct slots. A concluded failure
+        promotes the next ranked owner (keeping the fan width), and the
+        decision point abandons the in-flight losers — their eventual
+        results are dropped and accounted (cancelled/late), never
+        merged, so a decode never mixes in post-decision bytes."""
+        import threading
+
+        self._m_hedge_requests.add(1)
+        cond = threading.Condition()
+        state = {"live": 0, "decided": False}
+        attempts: list[dict] = []
+        needed = max(1, k - len(collected))
+        fan = min(len(candidates), needed + self.hedge_extra)
+
+        def run(att: dict) -> None:
+            token = att["token"]
+            with span(
+                "gather_fetch", peer=token, hedge=int(att["rank"] >= needed)
+            ) as sp:
+                got = None
+                outcome = "error"
+                nbytes = 0
+                win = False
+                try:
+                    got = fetch(directory[token], key)
+                    outcome = "ok" if got else "empty"
+                except Exception as exc:  # noqa: BLE001 — a dead owner
+                    # degrades the gather, never breaks the read
+                    log.debug("placement fetch from %s failed: %s",
+                              token, exc)
+                # Only plain state mutates under the condition —
+                # metrics land after release (lock-order hygiene: the
+                # registry families have their own locks).
+                with cond:
+                    att["live"] = False
+                    state["live"] -= 1
+                    if att["cancel"]:
+                        # The decision point already counted this
+                        # attempt as cancelled; drop its result.
+                        outcome = "cancelled"
+                    elif state["decided"]:
+                        if outcome == "ok":
+                            outcome = "late"
+                    elif outcome == "ok":
+                        for num, blob in got.items():
+                            if 0 <= int(num) < n and blob is not None:
+                                nbytes += len(blob)
+                                collected.setdefault(int(num), bytes(blob))
+                        if att["rank"] >= needed and len(collected) >= k:
+                            # A hedge source completed the k-set: the
+                            # fan-out beat a straggling primary owner.
+                            win = True
+                    cond.notify_all()
+                if outcome == "late":
+                    self._m_hedge_late.add(1)
+                if win:
+                    self._m_hedge_wins.add(1)
+                sp.set_attr(
+                    outcome=outcome, bytes=nbytes,
+                    shards=len(got) if got else 0,
+                )
+
+        next_rank = 0
+
+        def fill() -> None:
+            """Launch until the fan is full (or sources/need run out).
+            Threads start OUTSIDE the condition: Thread.start() blocks
+            on its own started-event, and holding the gather lock
+            across that handshake is a lock-order edge the lockgraph
+            harness (rightly) rejects."""
+            nonlocal next_rank
+            while True:
+                with cond:
+                    if (
+                        next_rank >= len(candidates)
+                        or state["live"] >= fan
+                        or len(collected) >= k
+                        or state["decided"]
+                    ):
+                        return
+                    att = {
+                        "token": candidates[next_rank], "rank": next_rank,
+                        "cancel": False, "live": True,
+                    }
+                    attempts.append(att)
+                    state["live"] += 1
+                    next_rank += 1
+                threading.Thread(
+                    target=run, args=(att,),
+                    name="noise-ec-gather", daemon=True,
+                ).start()
+
+        deadline = time.monotonic() + self.gather_timeout_seconds
+        while True:
+            # Top up the fan: a concluded failure hands its slot to the
+            # next ranked owner (the serial ladder's promotion, without
+            # giving up the parallelism).
+            fill()
+            with cond:
+                if len(collected) >= k:
+                    break
+                if state["live"] == 0 and next_rank >= len(candidates):
+                    break  # sources exhausted
+                now = time.monotonic()
+                if now >= deadline:
+                    break
+                cond.wait(min(0.25, deadline - now))
+        cancelled = 0
+        with cond:
+            state["decided"] = True
+            for att in attempts:
+                if att["live"] and not att["cancel"]:
+                    att["cancel"] = True
+                    cancelled += 1
+        if cancelled:
+            self._m_hedge_cancelled.add(cancelled)
+
+    def _decode_gathered(
+        self, store, key: str, k: int, n: int, field: str, code: str,
+        collected: dict, shard_lens: set,
+    ) -> Optional[bytes]:
         if len(shard_lens) != 1:
             return None  # inconsistent cohort: refuse
         rs = store.codec(k, n, field, code)

@@ -229,19 +229,24 @@ class Rebalancer:
             t.join(timeout=5.0)
 
     def _run_loop(self, interval: float) -> None:
-        while not self._closed:
-            self._wake.wait(interval)
-            if self._closed:
-                return
-            self._wake.clear()
-            with self._lock:
-                dirty = self._dirty
-            if not dirty:
-                continue
-            try:
-                self.run_cycle()
-            except Exception as exc:  # noqa: BLE001 — keep the loop alive
-                log.warning("rebalance cycle failed: %s", exc)
+        from noise_ec_tpu.ops.coalesce import qos_lane
+
+        # Rebalance re-sends ride the device gate's background lane:
+        # churn convergence yields to live traffic at a contended gate.
+        with qos_lane("background", tenant="rebalance"):
+            while not self._closed:
+                self._wake.wait(interval)
+                if self._closed:
+                    return
+                self._wake.clear()
+                with self._lock:
+                    dirty = self._dirty
+                if not dirty:
+                    continue
+                try:
+                    self.run_cycle()
+                except Exception as exc:  # noqa: BLE001 — keep loop alive
+                    log.warning("rebalance cycle failed: %s", exc)
 
     # ------------------------------------------------------------- cycles
 

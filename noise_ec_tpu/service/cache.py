@@ -108,7 +108,6 @@ def parse_warmset(data: bytes) -> Optional[dict]:
 
 
 class _CacheMetrics:
-    _registered = False
     _instances: "weakref.WeakSet[DecodedObjectCache]" = weakref.WeakSet()
 
     def __init__(self):
@@ -121,11 +120,13 @@ class _CacheMetrics:
             "noise_ec_object_cache_evictions_total"
         )
         cls = _CacheMetrics
-        if not cls._registered:
-            cls._registered = True
-            reg.gauge("noise_ec_object_cache_bytes").set_callback(
-                lambda: sum(c.bytes_used for c in list(cls._instances))
-            )
+        # Re-registered on every construction (idempotent — the closure
+        # reads the CLASS WeakSet): the test-isolation registry reset
+        # drops callback children, and a once-guard would leave the
+        # gauge dead for the rest of the process.
+        reg.gauge("noise_ec_object_cache_bytes").set_callback(
+            lambda: sum(c.bytes_used for c in list(cls._instances))
+        )
 
     def evicted(self, reason: str, count: int) -> None:
         if count:

@@ -168,9 +168,15 @@ class ObjectAPI:
         # X-NoiseEC-Trace id (a warm-peer routed fetch) is adopted so
         # the serving node's tier spans merge into the originator's
         # trace; the object layer's own scope joins this one.
+        attrs = {"route": "http"}
+        if req["headers"].get("X-NoiseEC-Hedge"):
+            # This serving leg is one arm of a hedged race on the
+            # requesting node — stamped so a fleet-wide trace shows
+            # which legs were hedges (and which lost).
+            attrs["hedge"] = 1
         rscope = trace_request(
             "get", trace_id=req["headers"].get("X-NoiseEC-Trace"),
-            route="http",
+            **attrs,
         )
         rscope.__enter__()
         done = [False]

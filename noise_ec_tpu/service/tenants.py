@@ -70,13 +70,31 @@ class Tenant:
     # Per-tenant erasure geometry; 0 = the service default.
     k: int = 0
     n: int = 0
-    # Hot->archival conversion policy (docs/lrc.md; empty = never
-    # convert): e.g. "archive=lrc:20/4+6,age=600". Validated by
-    # ConversionPolicy.parse at configure time, so an unknown tier or an
+    # Tenant policy string (docs/lrc.md archival grammar + the QoS
+    # lane/weight grammar, docs/object-service.md "QoS lanes"; empty =
+    # never convert, live lane, weight 1): e.g.
+    # "archive=lrc:20/4+6,age=600,lane=background,weight=2". Both halves
+    # are validated at configure time — an unknown archival tier, an
     # invalid LRC geometry (group count must divide k, >= 1 global
-    # parity) fails HERE with a clear ValueError, not in the background
-    # loop.
+    # parity), an unknown lane or an out-of-range weight all fail HERE
+    # with a clear ValueError, not in a background loop.
     policy: str = ""
+
+    @property
+    def lane(self) -> str:
+        """QoS lane of this tenant's device-gate traffic ("live" |
+        "background"; the ``lane=`` policy token, default live)."""
+        from noise_ec_tpu.store.convert import split_qos
+
+        return split_qos(self.policy)[0]
+
+    @property
+    def weight(self) -> int:
+        """Deficit-round-robin weight of this tenant's queue inside its
+        lane (the ``weight=`` policy token, default 1)."""
+        from noise_ec_tpu.store.convert import split_qos
+
+        return split_qos(self.policy)[1]
 
 
 class TenantRegistry:
@@ -131,13 +149,19 @@ class TenantRegistry:
         if tenant.replicas < 1:
             raise ValueError(f"tenant {name!r} replicas must be >= 1")
         if tenant.policy:
-            # Parse-time policy validation (docs/lrc.md grammar): an
-            # unknown archival tier or an invalid LRC geometry must
-            # fail the configure call, not the background loop.
-            from noise_ec_tpu.store.convert import ConversionPolicy
+            # Parse-time policy validation (docs/lrc.md archival grammar
+            # + the QoS lane/weight grammar): an unknown archival tier,
+            # an invalid LRC geometry, an unknown lane or a bad weight
+            # must fail the configure call, not a background loop.
+            from noise_ec_tpu.store.convert import (
+                ConversionPolicy,
+                split_qos,
+            )
 
             try:
-                ConversionPolicy.parse(tenant.policy)
+                archival = split_qos(tenant.policy)[2]
+                if archival:
+                    ConversionPolicy.parse(archival)
             except ValueError as exc:
                 raise ValueError(
                     f"tenant {name!r} policy {tenant.policy!r}: {exc}"

@@ -77,6 +77,7 @@ __all__ = [
     "ConversionPolicy",
     "derive_stripe_sig",
     "finish_prev_stripes_gc",
+    "split_qos",
 ]
 
 log = logging.getLogger("noise_ec_tpu.store")
@@ -139,6 +140,52 @@ _FIELD_ORDER = {"gf256": 256, "gf65536": 65536}
 
 # archive=lrc:K/G+R  |  archive=rs:K+R
 _GEOMETRY_RE = re.compile(r"^([a-z0-9_]+):(\d+)(?:/(\d+))?\+(\d+)$")
+
+# Tenant-policy QoS tokens (service/tenants.py grammar): ``lane=`` and
+# ``weight=`` ride the SAME comma-separated policy string as
+# ``archive=``/``age=`` but belong to the device-gate fairness layer
+# (ops/dispatch.py), not conversion. The splitter lives here so both
+# consumers — tenant configure-time validation and :meth:`policy_for`'s
+# archival parse — share one tokenizer without a service<->store import
+# cycle (tenants.py already imports this module lazily).
+QOS_LANES = ("live", "background")
+QOS_WEIGHT_MAX = 1000
+
+
+def split_qos(text: str) -> tuple[str, int, str]:
+    """Split the ``lane=``/``weight=`` QoS tokens out of a tenant policy
+    string: ``(lane, weight, archival_rest)``. Raises ``ValueError`` for
+    an unknown lane or a weight outside ``[1, QOS_WEIGHT_MAX]`` — the
+    same configure-time contract as the archival grammar."""
+    lane, weight = "live", 1
+    rest: list[str] = []
+    for raw in (text or "").split(","):
+        tok = raw.strip()
+        if not tok:
+            continue
+        key, _, val = tok.partition("=")
+        key, val = key.strip(), val.strip()
+        if key == "lane":
+            if val not in QOS_LANES:
+                raise ValueError(
+                    f"unknown QoS lane {val!r} (lanes: "
+                    f"{', '.join(QOS_LANES)})"
+                )
+            lane = val
+        elif key == "weight":
+            try:
+                weight = int(val)
+            except ValueError as exc:
+                raise ValueError(
+                    f"QoS weight {val!r} is not an integer"
+                ) from exc
+            if not 1 <= weight <= QOS_WEIGHT_MAX:
+                raise ValueError(
+                    f"QoS weight {weight} outside [1, {QOS_WEIGHT_MAX}]"
+                )
+        else:
+            rest.append(tok)
+    return lane, weight, ",".join(rest)
 
 
 @dataclass(frozen=True)
@@ -342,13 +389,18 @@ class ConversionEngine:
             self._thread = None
 
     def _run(self) -> None:
-        while not self._closed:
-            try:
-                self.run_cycle()
-            except Exception as exc:  # noqa: BLE001 — keep converting
-                log.error("conversion cycle failed: %s", exc)
-            self._wake.wait(self.interval_seconds)
-            self._wake.clear()
+        from noise_ec_tpu.ops.coalesce import qos_lane
+
+        # Conversion decode/re-encode dispatches ride the device gate's
+        # background lane (docs/object-service.md "QoS lanes").
+        with qos_lane("background", tenant="convert"):
+            while not self._closed:
+                try:
+                    self.run_cycle()
+                except Exception as exc:  # noqa: BLE001 — keep converting
+                    log.error("conversion cycle failed: %s", exc)
+                self._wake.wait(self.interval_seconds)
+                self._wake.clear()
 
     # ------------------------------------------------------------- policy
 
@@ -365,7 +417,14 @@ class ConversionEngine:
             return None
         if text not in self._policies:
             try:
-                self._policies[text] = ConversionPolicy.parse(text)
+                # QoS tokens (lane=, weight=) share the policy string but
+                # configure the device-gate lanes, not conversion: strip
+                # them before the archival parse. A policy that is ONLY
+                # QoS tokens has no archival tier.
+                archival = split_qos(text)[2]
+                self._policies[text] = (
+                    ConversionPolicy.parse(archival) if archival else None
+                )
             except ValueError as exc:
                 log.warning("ignoring bad policy %r: %s", text, exc)
                 self._policies[text] = None

@@ -266,6 +266,15 @@ class RepairEngine:
             self._thread = None
 
     def _run(self) -> None:
+        from noise_ec_tpu.ops.coalesce import qos_lane
+
+        # Repair reconstruct dispatches ride the device gate's
+        # background lane: durability work yields to live traffic at a
+        # contended gate (the starvation floor guarantees progress).
+        with qos_lane("background", tenant="repair"):
+            self._run_loop()
+
+    def _run_loop(self) -> None:
         next_announce = (
             time.monotonic() + self.announce_interval_seconds
             if self.announce_interval_seconds > 0 else None

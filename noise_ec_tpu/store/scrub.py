@@ -94,13 +94,19 @@ class Scrubber:
             self._thread = None
 
     def _run(self) -> None:
-        while not self._closed:
-            try:
-                self.run_cycle()
-            except Exception as exc:  # noqa: BLE001 — keep scrubbing
-                log.error("scrub cycle failed: %s", exc)
-            self._wake.wait(self.interval_seconds)
-            self._wake.clear()
+        from noise_ec_tpu.ops.coalesce import qos_lane
+
+        # The scrub thread's verify dispatches ride the device gate's
+        # background lane: they yield to live traffic (up to the gate's
+        # starvation floor) instead of racing it for slots.
+        with qos_lane("background", tenant="scrub"):
+            while not self._closed:
+                try:
+                    self.run_cycle()
+                except Exception as exc:  # noqa: BLE001 — keep scrubbing
+                    log.error("scrub cycle failed: %s", exc)
+                self._wake.wait(self.interval_seconds)
+                self._wake.clear()
 
     # -------------------------------------------------------------- cycle
 

@@ -127,7 +127,6 @@ class _StoreMetrics:
     """Cached registry children for the store metric family (resolved
     once; the scrub/repair loops record per stripe)."""
 
-    _registered = False
     _instances: "weakref.WeakSet[StripeStore]" = weakref.WeakSet()
 
     def __init__(self):
@@ -142,14 +141,16 @@ class _StoreMetrics:
             "noise_ec_store_absorb_rejected_total"
         ).labels()
         cls = _StoreMetrics
-        if not cls._registered:
-            cls._registered = True
-            reg.gauge("noise_ec_store_stripes").set_callback(
-                lambda: sum(len(s) for s in list(cls._instances))
-            )
-            reg.gauge("noise_ec_store_shard_bytes").set_callback(
-                lambda: sum(s.shard_bytes for s in list(cls._instances))
-            )
+        # Re-registered on every construction (idempotent — the closures
+        # read the CLASS WeakSet): the test-isolation registry reset
+        # drops callback children, and a once-guard would leave the
+        # gauges dead for the rest of the process.
+        reg.gauge("noise_ec_store_stripes").set_callback(
+            lambda: sum(len(s) for s in list(cls._instances))
+        )
+        reg.gauge("noise_ec_store_shard_bytes").set_callback(
+            lambda: sum(s.shard_bytes for s in list(cls._instances))
+        )
 
 
 class StripeStore:

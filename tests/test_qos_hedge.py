@@ -1,0 +1,264 @@
+"""ISSUE-19 acceptance pins: per-tenant QoS lanes at the DeviceGate
+(live preempts background, starvation floor, smooth weighted round-robin
+inside a lane, the ``lane=``/``weight=`` policy grammar) and the hedged
+peer-fetch engine (straggler raced at its clamped p95, loser cancelled,
+worker threads unwound — docs/object-service.md "Read path"/"QoS lanes")."""
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from noise_ec_tpu.host.plugin import ShardPlugin
+from noise_ec_tpu.host.transport import (
+    LoopbackHub,
+    LoopbackNetwork,
+    format_address,
+)
+from noise_ec_tpu.obs.registry import default_registry
+from noise_ec_tpu.ops.coalesce import QOS_LANES, current_qos, qos_lane
+from noise_ec_tpu.ops.dispatch import DeviceGate
+from noise_ec_tpu.service import ObjectStore
+from noise_ec_tpu.store import StripeStore
+from noise_ec_tpu.store.convert import split_qos
+
+
+# ----------------------------------------------------------- QoS grammar
+
+
+def test_qos_context_defaults_nests_and_rejects():
+    assert current_qos() == ("live", "", 1)
+    with qos_lane("background", tenant="t", weight=3):
+        assert current_qos() == ("background", "t", 3)
+        with qos_lane("live", tenant="u"):
+            assert current_qos() == ("live", "u", 1)
+        assert current_qos() == ("background", "t", 3)
+    assert current_qos() == ("live", "", 1)
+    with pytest.raises(ValueError):
+        with qos_lane("bulk"):
+            pass
+
+
+def test_split_qos_grammar():
+    lane, weight, rest = split_qos(
+        "archive=lrc:4/2+2,age=600,lane=background,weight=2"
+    )
+    assert (lane, weight) == ("background", 2)
+    # The archival half passes through untouched, QoS tokens stripped.
+    assert rest == "archive=lrc:4/2+2,age=600"
+    assert split_qos("")[:2] == ("live", 1)
+    for bad in ("lane=bulk", "weight=0", "weight=100000", "weight=x"):
+        with pytest.raises(ValueError):
+            split_qos(bad)
+
+
+# ------------------------------------------------------ DeviceGate lanes
+
+
+def _grant_order(gate: DeviceGate, specs):
+    """Queue one waiter per (lane, tenant, weight) spec behind a held
+    gate — in ARRIVAL order, so tenant-queue creation order is pinned —
+    then release the slot and record the order grants land in. Each
+    granted waiter releases immediately, so the chain serializes and the
+    recorded order IS the arbitration order."""
+    order = []
+    lock = threading.Lock()
+
+    def worker(spec):
+        lane, tenant, weight = spec
+        with qos_lane(lane, tenant=tenant, weight=weight):
+            gate.acquire()
+        with lock:
+            order.append(spec)
+        gate.release()
+
+    with qos_lane("live", tenant="holder"):
+        gate.acquire()  # occupy the only slot: everything below queues
+    threads = []
+    try:
+        for spec in specs:
+            t = threading.Thread(target=worker, args=(spec,), daemon=True)
+            t.start()
+            threads.append(t)
+            deadline = time.monotonic() + 5.0
+            while gate.waiters < len(threads):
+                assert time.monotonic() < deadline, "waiter never queued"
+                time.sleep(0.001)
+    finally:
+        gate.release()
+    for t in threads:
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+    assert gate.in_flight == 0 and gate.waiters == 0
+    return order
+
+
+def test_gate_live_lane_preempts_background():
+    """Queued-first background work still drains AFTER every queued live
+    GET when the floor is out of reach — the noisy-repair scenario."""
+    gate = DeviceGate(capacity=1, background_floor=50)
+    specs = [("background", "repair", 1)] * 3 + [("live", "tenant", 1)] * 3
+    order = _grant_order(gate, specs)
+    assert [lane for lane, _, _ in order] == ["live"] * 3 + ["background"] * 3
+
+
+def test_gate_background_starvation_floor():
+    """With floor=2 a saturating live lane cannot starve background:
+    grants alternate until the background queue drains."""
+    gate = DeviceGate(capacity=1, background_floor=2)
+    specs = [("live", "tenant", 1)] * 4 + [("background", "scrub", 1)] * 2
+    order = _grant_order(gate, specs)
+    lanes = [lane for lane, _, _ in order]
+    assert lanes == [
+        "live", "background", "live", "background", "live", "live"
+    ]
+
+
+def test_gate_weighted_round_robin_within_lane():
+    """Two live tenants at weight 3:1 drain by smooth WRR — grants
+    interleave proportionally instead of bursting, and the heavy tenant
+    finishing hands the lane to the light one."""
+    gate = DeviceGate(capacity=1, background_floor=50)
+    specs = [("live", "heavy", 3)] * 4 + [("live", "light", 1)] * 4
+    order = _grant_order(gate, specs)
+    tenants = [tenant for _, tenant, _ in order]
+    assert tenants == [
+        "heavy", "heavy", "light", "heavy", "heavy",
+        "light", "light", "light",
+    ]
+
+
+# --------------------------------------------------- hedged peer fetches
+
+
+class _StripeServer:
+    """A warm peer serving one stripe's bytes with the ETag contract,
+    optionally straggling ``delay`` seconds before answering."""
+
+    def __init__(self, payload: bytes, etag: str, delay: float = 0.0):
+        class _H(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if delay:
+                    time.sleep(delay)
+                self.send_response(206)
+                self.send_header("ETag", f'"{etag}"')
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                try:
+                    self.wfile.write(payload)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # a cancelled loser closed the socket mid-write
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        self.url = f"http://127.0.0.1:{self._httpd.server_address[1]}"
+        threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        ).start()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _make_objects(**kw):
+    hub = LoopbackHub()
+    node = LoopbackNetwork(hub, format_address("tcp", "localhost", 3901))
+    store = StripeStore()
+    plugin = ShardPlugin(backend="numpy", store=store)
+    node.add_plugin(plugin)
+    return ObjectStore(
+        store, plugin, node, stripe_bytes=256, k=2, n=3,
+        peer_timeout_seconds=2.0,
+        hedge_floor_seconds=0.005, hedge_ceiling_seconds=0.05, **kw,
+    )
+
+
+def _hedge_counts() -> dict:
+    reg = default_registry()
+    return {
+        key: float(
+            reg.counter(f"noise_ec_hedge_{key}_total").labels().value
+        )
+        for key in ("requests", "wins", "cancelled")
+    }
+
+
+def _no_hedge_threads(timeout: float = 3.0) -> bool:
+    """Every hedge worker unwound (the zero-leak acceptance bar)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if not any(
+            t.name == "noise-ec-hedge" and t.is_alive()
+            for t in threading.enumerate()
+        ):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_hedged_fetch_races_straggler_cancels_loser():
+    """The tentpole end to end at unit scale: the ranked primary
+    straggles, the hedge fires at its clamped p95 and launches the
+    spare, the spare's verified response wins while the read is still
+    far below the straggler's delay, the loser is cancelled, and every
+    worker thread unwinds (cancelled fetches leak nothing)."""
+    payload = bytes(range(64))
+    address = "addr-hedge-test"
+    slow = _StripeServer(payload, address, delay=0.5)
+    fast = _StripeServer(payload, address, delay=0.0)
+    objects = _make_objects()
+    try:
+        # Rank the straggler PRIMARY: peers_for sorts least-loaded first.
+        objects.directory.observe(slow.url, [address], load=0.0)
+        objects.directory.observe(fast.url, [address], load=1.0)
+        # Arm the straggler's hedge trigger: p95 ~10 ms << its 500 ms
+        # response, so the spare launches almost immediately.
+        for _ in range(objects._metrics.HEDGE_MIN_COUNT):
+            objects._metrics.peer_fetch_seconds(slow.url, 0.01)
+        doc = {
+            "address": address, "stripe_bytes": 256,
+            "tenant": "t", "name": "o",
+        }
+        before = _hedge_counts()
+        t0 = time.monotonic()
+        blob = objects._peer_fetch(doc, 0, len(payload))
+        elapsed = time.monotonic() - t0
+        assert blob == payload
+        assert elapsed < 0.4  # the spare won; the read never paid 500 ms
+        delta = {k: v - before[k] for k, v in _hedge_counts().items()}
+        assert delta == {"requests": 1.0, "wins": 1.0, "cancelled": 1.0}
+        assert _no_hedge_threads()
+    finally:
+        slow.close()
+        fast.close()
+
+
+def test_hedge_disabled_runs_serial_ladder():
+    """hedge_enabled=False is the pre-hedge baseline: the sequential
+    ladder waits out the straggling primary and no hedge counter moves."""
+    payload = b"\x07" * 32
+    address = "addr-serial-test"
+    slow = _StripeServer(payload, address, delay=0.15)
+    fast = _StripeServer(payload, address, delay=0.0)
+    objects = _make_objects(hedge_enabled=False)
+    try:
+        objects.directory.observe(slow.url, [address], load=0.0)
+        objects.directory.observe(fast.url, [address], load=1.0)
+        doc = {
+            "address": address, "stripe_bytes": 256,
+            "tenant": "t", "name": "o",
+        }
+        before = _hedge_counts()
+        t0 = time.monotonic()
+        blob = objects._peer_fetch(doc, 0, len(payload))
+        elapsed = time.monotonic() - t0
+        assert blob == payload
+        assert elapsed >= 0.15  # paid the straggler: no race happened
+        assert _hedge_counts() == before
+    finally:
+        slow.close()
+        fast.close()

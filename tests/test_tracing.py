@@ -45,6 +45,19 @@ def test_estimate_offset_midpoint_and_uncertainty():
     assert c.uncertainty == pytest.approx(0.2)
 
 
+def test_applied_offset_soft_thresholds_noise():
+    """A sample that cannot distinguish its offset from zero applies NO
+    correction — peers whose clocks agree (same host, NTP fleet) must
+    not be skewed by the collector's own RTT noise. A genuine offset is
+    applied, shrunk by at most the uncertainty, either sign."""
+    noise = estimate_offset(10.0, 10.4, 10.35)  # |offset| 0.15 < ±0.2
+    assert noise.applied_offset() == 0.0
+    ahead = estimate_offset(10.0, 10.4, 1000.3)  # offset 990.1 >> 0.2
+    assert ahead.applied_offset() == pytest.approx(990.1 - 0.2)
+    behind = estimate_offset(10.0, 10.4, -979.9)  # offset -990.1
+    assert behind.applied_offset() == pytest.approx(-(990.1 - 0.2))
+
+
 def test_estimate_offset_handshake_hint_tightens_uncertainty():
     loose = estimate_offset(10.0, 10.4, 1000.3)
     tight = estimate_offset(10.0, 10.4, 1000.3, handshake_rtt=0.05)

@@ -174,6 +174,18 @@ PLACEMENT_FANOUT_RATIO_MAX = 1.5
 TRACE_OVERHEAD_PCT_MAX = 3.0
 TRACE_KEEP_RATE_MAX = 0.25
 
+# ISSUE-19 acceptance bars for the hedged read tier and tenant QoS
+# (docs/object-service.md "Read path"). The hedged-fleet bench runs a
+# 120 ms straggler peer; with the hedge engine racing a spare source the
+# fleet-tenant GET p99 lands ~250 ms (vs ~2 s unhedged, which stacks
+# the straggler across both stripes of each read) — 600 ms is real
+# headroom on a loaded CI box while still far below the unhedged tail.
+# The isolation ratio (quiet-tenant p99 contended / solo, lower-better)
+# rides power-of-2 buckets, so one-bucket jitter is a 2x swing; 4.0
+# only trips when the noisy neighbor genuinely moves the quiet tail.
+HEDGE_P99_MS_MAX = 600.0
+TENANT_ISOLATION_RATIO_MAX = 4.0
+
 
 def metric_direction(name: str) -> str | None:
     """'up' (higher better), 'down' (lower better), or None (skip)."""
@@ -414,6 +426,42 @@ def trace_overhead_check(stats: dict) -> list[str]:
             f"trace_keep_rate {rate} above the {TRACE_KEEP_RATE_MAX} "
             "bar — the tail sampler is keeping clean-path traces it "
             "should drop"
+        )
+    return problems
+
+
+def hedge_rig_check(stats: dict) -> list[str]:
+    """ISSUE-19 acceptance bars for hedged reads and tenant QoS, fresh
+    runs only (recorded rounds before the hedge tier genuinely lack the
+    keys). ``object_get_p99_hedged_ms`` — the straggler-fleet GET p99
+    with the hedge engine on — must stay under HEDGE_P99_MS_MAX (the
+    unhedged tail is ~3x the bar; crossing it means hedges stopped
+    firing or stopped winning). ``tenant_isolation_p99_ratio`` — the
+    quiet tenant's contended-over-solo p99 — must stay under
+    TENANT_ISOLATION_RATIO_MAX (above it the noisy neighbor is moving
+    the quiet tail and the QoS lanes are not isolating)."""
+    problems = []
+    try:
+        p99 = float(stats["object_get_p99_hedged_ms"])
+    except (KeyError, TypeError, ValueError):
+        p99 = None
+    if p99 is not None and p99 > HEDGE_P99_MS_MAX:
+        problems.append(
+            f"object_get_p99_hedged_ms {p99} above the "
+            f"{HEDGE_P99_MS_MAX:g} ms bar — the straggler is back in "
+            "the GET tail; hedged fan-out is not racing the slow "
+            'source (docs/object-service.md "Read path")'
+        )
+    try:
+        ratio = float(stats["tenant_isolation_p99_ratio"])
+    except (KeyError, TypeError, ValueError):
+        return problems
+    if ratio > TENANT_ISOLATION_RATIO_MAX:
+        problems.append(
+            f"tenant_isolation_p99_ratio {ratio} above the "
+            f"{TENANT_ISOLATION_RATIO_MAX} bar — a noisy tenant is "
+            "moving the quiet tenant's GET p99 through the shared "
+            'lanes (docs/object-service.md "QoS lanes")'
         )
     return problems
 
@@ -715,6 +763,7 @@ def main(argv: list[str] | None = None) -> int:
         problems.extend(panel_rig_check(current))
         problems.extend(placement_rig_check(current))
         problems.extend(trace_overhead_check(current))
+        problems.extend(hedge_rig_check(current))
     if args.json:
         print(json.dumps(
             {"against": against_name, "findings": findings,
