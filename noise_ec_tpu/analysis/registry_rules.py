@@ -367,6 +367,22 @@ SUBSYSTEM_DOCS: dict[str, dict] = {
                    "rebalance_amplification", "prev_stripes",
                    "SHARD_BATCH"),
     },
+    "wide-events": {
+        "doc": "docs/observability.md",
+        "prefixes": ("noise_ec_events_", "noise_ec_event_"),
+        "extras": (),
+        "tokens": ("/events", "EventLog", "EVENT_NAMES",
+                   "event-on-swallow", "event_log_overhead_pct",
+                   "suppressed"),
+    },
+    "diagnosis": {
+        "doc": "docs/observability.md",
+        "prefixes": ("noise_ec_diagnose_",),
+        "extras": (),
+        "tokens": ("/diagnose", "DiagnosisEngine", "slow-peer",
+                   "noisy-tenant", "tools/diagnose.py",
+                   "diagnose_verdict_ms", "add_flip_listener"),
+    },
     "lrc": {
         "doc": "docs/lrc.md",
         "prefixes": ("noise_ec_lrc_", "noise_ec_convert_"),

@@ -37,6 +37,20 @@ Each rule encodes an invariant this codebase already paid to learn
   exemplars and never joins the collector-merged fleet view. A
   deliberately untraced route takes a
   ``# noise-ec: allow(span-coverage)`` suppression on its mount line.
+
+- **event-on-swallow** — in a module that imports the wide-event API
+  (``noise_ec_tpu.obs.events``, i.e. an instrumented subsystem), a
+  broad exception handler (bare ``except:``, ``except Exception`` /
+  ``BaseException``) must leave a footprint: re-``raise``, emit a wide
+  ``event(...)``, log at some level, or feed the subsystem's error
+  accounting (``*._record_error`` / ``metrics.error``). A silent broad
+  swallow is exactly the failure class the event log exists to
+  surface; the diagnosis engine cannot rank what never lands in the
+  window. A deliberate swallow (environment probe, error re-delivered
+  through another channel) takes a justified
+  ``# noise-ec: allow(event-on-swallow)`` on the ``except`` line.
+  Narrow typed handlers (``ValueError``, ``UnknownStripeError``, ...)
+  are expected control flow and are exempt.
 """
 
 from __future__ import annotations
@@ -691,3 +705,96 @@ def check_zero_copy(sf: SourceFile):
                             f"(.{node.func.attr}) — it dangles at the "
                             "next ring fill; store bytes(view) instead",
                         )
+
+
+# ----------------------------------------------------------- event-on-swallow
+
+
+_EVENTS_MODULE = "noise_ec_tpu.obs.events"
+_EVENT_EMITTERS = {"event", "emit"}
+_LOG_LEVELS = {"debug", "info", "warning", "error", "exception",
+               "critical"}
+_ERROR_SINKS = {"_record_error", "record_error"}
+
+
+def _imports_event_api(sf: SourceFile) -> bool:
+    """True when the module imports ``noise_ec_tpu.obs.events`` anywhere
+    (top level or deferred inside a function — both idioms are live in
+    the instrumented subsystems)."""
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ImportFrom) \
+                and node.module == _EVENTS_MODULE:
+            return True
+        if isinstance(node, ast.Import) \
+                and any(a.name == _EVENTS_MODULE for a in node.names):
+            return True
+    return False
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except:
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elts:
+        name = e.id if isinstance(e, ast.Name) else (
+            e.attr if isinstance(e, ast.Attribute) else None
+        )
+        if name in ("Exception", "BaseException"):
+            return True
+    return False
+
+
+def _handler_leaves_footprint(handler: ast.ExceptHandler) -> bool:
+    """Re-raise, wide event, log call, or error-accounting sink
+    anywhere in the handler body."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in _EVENT_EMITTERS:
+            return True
+        if isinstance(f, ast.Attribute):
+            if f.attr in _EVENT_EMITTERS or f.attr in _ERROR_SINKS:
+                return True
+            if f.attr == "error":
+                return True  # metrics.error(...) / log.error(...)
+            if f.attr in _LOG_LEVELS:
+                base = f.value
+                base_name = base.id if isinstance(base, ast.Name) \
+                    else getattr(base, "attr", None)
+                if base_name and "log" in base_name.lower():
+                    return True
+    return False
+
+
+@rule(
+    "event-on-swallow",
+    scope="file",
+    invariant="in modules importing noise_ec_tpu.obs.events, a broad "
+              "except (bare/Exception/BaseException) must raise, emit "
+              "an event, log, or record the error",
+    motivation="PR 20 (wide-event log: a silently swallowed failure in "
+               "an instrumented subsystem never reaches the event "
+               "window, so the diagnosis engine ranks verdicts against "
+               "a hole where the incident evidence should be)",
+)
+def check_event_on_swallow(sf: SourceFile):
+    if not _imports_event_api(sf):
+        return
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad_handler(node):
+            continue
+        if _handler_leaves_footprint(node):
+            continue
+        yield Finding(
+            "event-on-swallow", sf.rel, node.lineno,
+            "broad except swallows the failure with no footprint — in "
+            "an instrumented subsystem emit event(...)/log or feed "
+            "_record_error so the diagnosis window sees it, or justify "
+            "with # noise-ec: allow(event-on-swallow)",
+        )

@@ -37,6 +37,7 @@ from noise_ec_tpu.host.crypto import (
 )
 from noise_ec_tpu.host.mempool import PoolLimitError, PoolTooLargeError, ShardPool
 from noise_ec_tpu.host.wire import Shard
+from noise_ec_tpu.obs.events import event
 from noise_ec_tpu.obs.health import SLOEvaluator, record_e2e
 from noise_ec_tpu.obs.metrics import Counters, Timer
 from noise_ec_tpu.obs.registry import default_registry
@@ -326,6 +327,7 @@ class ShardPlugin:
     def _sender_key(ctx: PluginContext) -> bytes:
         try:
             return bytes(ctx.client_public_key())
+        # noise-ec: allow(event-on-swallow) — identity-less test transports — empty identity is the contract
         except Exception:  # noqa: BLE001 — identity-less test transports
             return b""
 
@@ -928,7 +930,9 @@ class ShardPlugin:
                 from noise_ec_tpu.shim import CppReedSolomon
 
                 self._shim_cache[key] = CppReedSolomon(k, n - k)
-            except Exception:  # noqa: BLE001 — any load/build failure -> FEC
+            except Exception as exc:  # noqa: BLE001 — any load/build failure -> FEC
+                log.warning("shim load failed for %s (%s); using FEC",
+                            key, exc)
                 self._shim_cache[key] = None
         return self._shim_cache[key]
 
@@ -1295,7 +1299,8 @@ class ShardPlugin:
                 self._geometry_decode_begin(k, n)
                 try:
                     chunk = fec.decode(shares)
-                except Exception:  # noqa: BLE001 — keep repairing others
+                except Exception as exc:  # noqa: BLE001 — keep repairing others
+                    log.debug("stream chunk decode failed: %s", exc)
                     self.counters.add("decode_errors", 1)
                     continue
                 finally:
@@ -1464,6 +1469,9 @@ class ShardPlugin:
                 with self._nack_lock:
                     self._nack.pop(key, None)
                 self._nack_giveups.add(1)
+                event("repair.giveup", "error", key=key[:16],
+                      have=entry.distinct(), need=st["k"],
+                      retries=st["retries"])
                 self._record_outcome("incomplete", entry.created_at)
                 log.warning(
                     "object %s… stuck at %d/%d shards after %d NACK "

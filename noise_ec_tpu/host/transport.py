@@ -65,6 +65,7 @@ from noise_ec_tpu.host.crypto import (
     PeerID,
 )
 from noise_ec_tpu.host.wire import Shard, WireError
+from noise_ec_tpu.obs.events import event
 from noise_ec_tpu.obs.metrics import Timer
 from noise_ec_tpu.obs.registry import default_registry
 from noise_ec_tpu.obs.trace import current_trace_id, span, trace_key
@@ -450,6 +451,7 @@ class _WireConn(asyncio.BufferedProtocol):
                 t.close()
                 return
             t.write_eof()
+        # noise-ec: allow(event-on-swallow) — teardown fallback — the hard close below is the only remaining action
         except Exception:  # noqa: BLE001 — fall back to the hard close
             t.close()
             return
@@ -965,6 +967,7 @@ class _SerialDispatcher:
                 if self._on_error is not None:
                     try:
                         self._on_error(exc)
+                    # noise-ec: allow(event-on-swallow) — recorder tap must not kill the drain loop; nothing actionable
                     except Exception:  # noqa: BLE001 — recorder must not kill drain
                         pass
                 else:
@@ -1653,6 +1656,7 @@ class TCPNetwork:
                     + self._pending_bytes.get(w, 0) + posted_w > soft_cap
                     for w, posted_w in zip(writers, posted)
                 )
+            # noise-ec: allow(event-on-swallow) — peer set mutating mid-scan — retried on the next sweep tick
             except Exception:  # noqa: BLE001 — peer set mutating mid-scan
                 busy = True
             if not busy:
@@ -1686,6 +1690,7 @@ class TCPNetwork:
         registered YET (the eviction→re-registration gap), the frames
         park in ``_limbo`` and flush when its registration lands."""
         target = None
+        parked = expired = False
         with self._lock:
             if self._closing:
                 return
@@ -1705,13 +1710,23 @@ class TCPNetwork:
                     pubkey, (now, 0, [])
                 )
                 if now - parked_at > self.connection_timeout:
+                    expired = bool(batches)
                     parked_at, parked_bytes, batches = now, 0, []
                 if parked_bytes + nbytes <= self.MAX_PEER_WRITE_BUFFER:
                     batches.append((parts, nframes, nbytes))
                     self._limbo[pubkey] = (
                         parked_at, parked_bytes + nbytes, batches
                     )
+                    parked = True
+        if expired:
+            event("conn.limbo_drop", "warn", peer=pubkey[:8].hex(),
+                  reason="park expired before a connection registered")
+        if parked:
+            event("conn.limbo_park", peer=pubkey[:8].hex(),
+                  frames=nframes, bytes=nbytes)
         if target is not None:
+            event("conn.limbo_reroute", peer=pubkey[:8].hex(),
+                  frames=nframes, bytes=nbytes)
             self._writer_loop(target).call_soon_threadsafe(
                 self._enqueue_frames, target, parts, nframes, nbytes
             )
@@ -1725,10 +1740,19 @@ class TCPNetwork:
                 return
             parked_at, parked_bytes, batches = parked
             if time.monotonic() - parked_at > self.connection_timeout:
-                return
-            self._posted_bytes[writer] = (
-                self._posted_bytes.get(writer, 0) + parked_bytes
-            )
+                expired = True
+            else:
+                expired = False
+                self._posted_bytes[writer] = (
+                    self._posted_bytes.get(writer, 0) + parked_bytes
+                )
+        if expired:
+            event("conn.limbo_drop", "warn", peer=pubkey[:8].hex(),
+                  bytes=parked_bytes,
+                  reason="park expired before registration")
+            return
+        event("conn.limbo_reroute", peer=pubkey[:8].hex(),
+              bytes=parked_bytes, batches=len(batches))
         loop = self._writer_loop(writer)
         for parts, nframes, nbytes in batches:
             loop.call_soon_threadsafe(
@@ -1937,6 +1961,8 @@ class TCPNetwork:
             # instead of inferring loss from silence.
             log.info("dropped peer %s%s", address,
                      f" ({reason})" if reason else "")
+            event("peer.drop", "warn", peer=address,
+                  reason=reason or "connection closed")
         handle = self._flush_handles.pop(writer, None)
         if handle is not None:
             handle.cancel()
@@ -1958,6 +1984,7 @@ class TCPNetwork:
                 )
         try:
             writer.close()
+        # noise-ec: allow(event-on-swallow) — close() race on a dying writer; the loss is already accounted above
         except Exception:  # noqa: BLE001
             pass
         # Established-connection loss of a peer WE dialed: hand the dialed
@@ -2147,12 +2174,15 @@ class TCPNetwork:
             loser = prev.writer if keep_new else writer
             log.info("demoting duplicate connection to %s (%s survives)",
                      pid.address, "new" if keep_new else "previous")
+            event("conn.demote", peer=pid.address,
+                  survivor="new" if keep_new else "previous")
             half = getattr(loser, "half_close", None)
             try:
                 if half is not None:
                     half()
                 else:
                     loser.close()
+            # noise-ec: allow(event-on-swallow) — loser half-close race during connection-demote teardown
             except Exception:  # noqa: BLE001
                 pass
             # Frames coalescing on the loser can no longer flush (its

@@ -28,13 +28,21 @@ has to come from structured telemetry, not log archaeology:
   compile/execute split, recompile counters, roofline (cost_analysis
   FLOPs/bytes, achieved-vs-peak utilization) and HBM gauges;
 - :mod:`obs.sampler` — the always-on ~50 Hz folded-stack sampling
-  profiler behind ``GET /profile``.
+  profiler behind ``GET /profile``;
+- :mod:`obs.events` — the wide structured-event log: every
+  load-bearing decision (demotion, shed, hedge, breaker flip) as one
+  trace-correlated record behind ``GET /events``;
+- :mod:`obs.diagnose` — the rule-table diagnosis engine that joins
+  events, registry deltas and kept traces into ranked cause verdicts
+  behind ``GET /diagnose``.
 
 ``utils.metrics`` / ``utils.profiling`` remain as compatible re-export
 shims, so existing imports keep working.
 """
 
 from noise_ec_tpu.obs.collector import TraceCollector
+from noise_ec_tpu.obs.diagnose import DiagnosisEngine
+from noise_ec_tpu.obs.events import EventLog, default_event_log, event
 from noise_ec_tpu.obs.device import (
     analyze_program,
     device_op,
@@ -57,6 +65,8 @@ from noise_ec_tpu.obs.trace import Tracer, default_tracer, node_attrs, span
 
 __all__ = [
     "Counters",
+    "DiagnosisEngine",
+    "EventLog",
     "Histogram",
     "METRICS",
     "PIPELINE_STAGES",
@@ -67,11 +77,13 @@ __all__ = [
     "TraceCollector",
     "Tracer",
     "analyze_program",
+    "default_event_log",
     "default_registry",
     "default_sampler",
     "default_slo",
     "default_tracer",
     "device_op",
+    "event",
     "hbm_snapshot",
     "node_attrs",
     "peak_hbm_gbps",

@@ -140,6 +140,12 @@ class FlightRecorder:
         self._bundle_n = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Duck-typed diagnosis hooks, set by DiagnosisEngine (this
+        # module never imports obs.events/obs.diagnose): ``events`` is
+        # an EventLog whose window lands in every bundle; ``diagnoser``
+        # is a zero-arg callable returning a ranked-verdict document.
+        self.events = None
+        self.diagnoser = None
         self._bundles = self.registry.counter(
             "noise_ec_incident_bundles_total"
         )
@@ -266,6 +272,20 @@ class FlightRecorder:
             "recorder": self.stats(),
             "trace_file": None,
         }
+        if self.events is not None:
+            # The wide-event tail of the same window the timeline
+            # covers: the decisions (demotions, sheds, hedges) made in
+            # the seconds the deltas describe.
+            bundle["events"] = [
+                e for e in self.events.dump()
+                if e["ts"] >= window_start
+            ]
+        if self.diagnoser is not None:
+            try:
+                bundle["diagnosis"] = self.diagnoser()
+            except Exception as exc:  # noqa: BLE001 — a diagnosis
+                # failure must not lose the bundle it annotates
+                log.warning("bundle diagnosis failed: %s", exc)
         if self.incident_dir is None:
             return bundle
         with self._lock:

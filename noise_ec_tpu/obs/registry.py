@@ -823,6 +823,41 @@ METRICS: dict[str, tuple[str, str, tuple[str, ...]]] = {
         "(bounded by its byte cap), read at collect time",
         (),
     ),
+    # --- wide events + diagnosis (obs/events.py, obs/diagnose.py,
+    # docs/observability.md "Wide events" / "Diagnosis")
+    "noise_ec_events_total": (
+        "counter",
+        "Wide structured events recorded by the event log, labeled by "
+        "event name (the bounded EVENT_NAMES vocabulary) and severity; "
+        "rate-limit-suppressed emissions are counted separately",
+        ("name", "severity"),
+    ),
+    "noise_ec_events_suppressed_total": (
+        "counter",
+        "Event emissions dropped by the per-name token bucket, labeled "
+        "by event name; the next surviving record of that name carries "
+        "the dropped count as its `suppressed` attr",
+        ("name",),
+    ),
+    "noise_ec_event_ring_bytes": (
+        "gauge",
+        "Approximate bytes currently pinned by the wide-event ring "
+        "(bounded by the log's byte cap), set on every emit",
+        (),
+    ),
+    "noise_ec_diagnose_runs_total": (
+        "counter",
+        "Diagnosis-engine runs, labeled by trigger (flip = SLO "
+        "healthy->degraded listener, request = GET /diagnose, "
+        "bundle = flight-recorder capture embedding)",
+        ("trigger",),
+    ),
+    "noise_ec_diagnose_seconds": (
+        "histogram",
+        "Wall time of one diagnosis run (every verdict rule evaluated "
+        "over the registry deltas, event window and kept traces)",
+        (),
+    ),
     # --- wire hot loop (host/transport.py, docs/design.md §15)
     "noise_ec_wire_verify_batch_size": (
         "histogram",

@@ -253,6 +253,7 @@ class CoalescingDispatcher:
             return flight.result, True
         try:
             flight.result = fn()
+        # noise-ec: allow(event-on-swallow) — error is re-delivered to every waiter via flight.error
         except BaseException as exc:  # noqa: BLE001 — fan the error out
             flight.error = exc
         finally:
@@ -315,11 +316,16 @@ class CoalescingDispatcher:
 
             gate = device_gate()
             depth = gate.in_flight + gate.waiters
+        # noise-ec: allow(event-on-swallow) — linger sizing probe — host regime without jax
         except Exception:  # noqa: BLE001 — linger must not require jax
             pass
         budget = max(self.linger_seconds, self.linger_seconds * depth)
         if depth > 0 and current_qos()[0] == "background":
             budget *= self.background_linger_x
+            from noise_ec_tpu.obs.events import event
+
+            event("qos.linger", lane="background", depth=depth,
+                  budget_ms=round(budget * 1e3, 3))
         return budget
 
     def _lead(self, bucket: _Bucket, linger: float,
@@ -350,6 +356,7 @@ class CoalescingDispatcher:
                     f"for {size} payloads"
                 )
             bucket.results = list(results)
+        # noise-ec: allow(event-on-swallow) — error is re-delivered to every waiter via bucket.error
         except BaseException as exc:  # noqa: BLE001 — fan the error out
             bucket.error = exc
         finally:
@@ -413,6 +420,7 @@ def coalesce_cutoff_bytes() -> int:
             if router.enabled:
                 base *= router.n_pow2
             return base
+    # noise-ec: allow(event-on-swallow) — device-count probe — host regime without jax
     except Exception:  # noqa: BLE001 — no jax, host regime
         pass
     return 128 << 10

@@ -129,6 +129,9 @@ def record_codec_fallback(reason: str) -> None:
             "noise_ec_codec_fallback_total"
         ).labels(reason=reason)
     child.add(1)
+    from noise_ec_tpu.obs.events import event
+
+    event("codec.fallback", "warn", reason=reason)
 
 
 def _probe_device() -> None:
@@ -181,6 +184,9 @@ def _probe_loop() -> None:
         else:
             br.record_success()
             log.info("codec device probe succeeded; device route restored")
+            from noise_ec_tpu.obs.events import event
+
+            event("codec.restore", route="device")
             return
 
 
@@ -381,6 +387,12 @@ class DeviceGate:
                 self._live_streak = 0
             elif self._lane_waiters["background"]:
                 self._live_streak += 1
+                from noise_ec_tpu.obs.events import event
+
+                # Rate-limited by the event log's per-name bucket; the
+                # streak odometer says how starved background is.
+                event("qos.preempt", lane=lane, streak=self._live_streak,
+                      background_waiting=self._lane_waiters["background"])
             else:
                 self._live_streak = 0
 
@@ -590,6 +602,7 @@ def donation_supported() -> bool:
     backend ignores donation and would warn per call)."""
     try:
         return jax.default_backend() in ("tpu", "gpu")
+    # noise-ec: allow(event-on-swallow) — environment probe: no backend means no donation, not an incident
     except Exception:  # noqa: BLE001 — no backend, no donation
         return False
 
@@ -886,6 +899,7 @@ def enable_compile_cache(cache_dir: str) -> bool:
         from jax._src import compilation_cache as _cc
 
         _cc.reset_cache()  # drop the memoized pre-config decision
+    # noise-ec: allow(event-on-swallow) — environment probe: older jax initializes lazily
     except Exception:  # noqa: BLE001 — older jax initializes lazily
         pass
     if not _cache_listener_installed:

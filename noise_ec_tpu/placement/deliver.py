@@ -26,6 +26,7 @@ import logging
 import time
 from typing import Optional
 
+from noise_ec_tpu.obs.events import event
 from noise_ec_tpu.obs.registry import default_registry
 from noise_ec_tpu.obs.trace import current_trace_id, span, trace_key
 
@@ -80,6 +81,30 @@ class TargetedDelivery:
         self._m_hedge_late = reg.counter(
             "noise_ec_hedge_late_total"
         ).labels()
+        # Per-owner completed-fetch latency: the same family the
+        # warm-peer tier feeds (ObjectMetrics.peer_fetch_seconds), so
+        # the slow-peer diagnosis rule and hedge p95 triggers see
+        # gather traffic too. Children cached and capped like the
+        # tenant/peer label sets.
+        self._peer_seconds = reg.histogram("noise_ec_peer_fetch_seconds")
+        self._peer_children: dict[str, object] = {}
+
+    PEER_LABEL_CAP = 64
+
+    def _observe_fetch(self, token: str, seconds: float) -> None:
+        """Observe one COMPLETED owner fetch (ok/empty/late; errors and
+        cancellations stay out — they would poison the p95 the hedge
+        trigger and slow-peer verdict read)."""
+        label = token if (
+            token in self._peer_children
+            or len(self._peer_children) < self.PEER_LABEL_CAP
+        ) else "other"
+        child = self._peer_children.get(label)
+        if child is None:
+            child = self._peer_children[label] = self._peer_seconds.labels(
+                peer=label
+            )
+        child.observe(seconds)
 
     # -------------------------------------------------------------- send
 
@@ -189,6 +214,7 @@ class TargetedDelivery:
             for num, blob in enumerate(local_shards):
                 if blob is not None:
                     collected[num] = blob
+        # noise-ec: allow(event-on-swallow) — a stripe not held locally is the norm, not a failure
         except Exception:  # noqa: BLE001 — not held locally is the norm
             pass
         alive = set(directory)
@@ -231,6 +257,7 @@ class TargetedDelivery:
             # One span per owner fetch: peer id + outcome + bytes, so a
             # straggling owner is visible in the GET's critical path.
             with span("gather_fetch", peer=token) as sp:
+                t0 = time.monotonic()
                 try:
                     got = fetch(directory[token], key)
                 except Exception as exc:  # noqa: BLE001 — a dead owner
@@ -239,6 +266,7 @@ class TargetedDelivery:
                     log.debug("placement fetch from %s failed: %s",
                               token, exc)
                     continue
+                self._observe_fetch(token, time.monotonic() - t0)
                 if not got:
                     sp.set_attr(outcome="empty", bytes=0)
                     continue
@@ -279,6 +307,7 @@ class TargetedDelivery:
                 outcome = "error"
                 nbytes = 0
                 win = False
+                t0 = time.monotonic()
                 try:
                     got = fetch(directory[token], key)
                     outcome = "ok" if got else "empty"
@@ -286,6 +315,7 @@ class TargetedDelivery:
                     # degrades the gather, never breaks the read
                     log.debug("placement fetch from %s failed: %s",
                               token, exc)
+                elapsed = time.monotonic() - t0
                 # Only plain state mutates under the condition —
                 # metrics land after release (lock-order hygiene: the
                 # registry families have their own locks).
@@ -309,10 +339,30 @@ class TargetedDelivery:
                             # fan-out beat a straggling primary owner.
                             win = True
                     cond.notify_all()
+                if outcome != "error":
+                    # Unlike the warm-peer tier (whose cancel closes
+                    # the connection mid-flight), a gather fetch always
+                    # runs to completion — cancel only discards the
+                    # result — so the elapsed time is a real per-owner
+                    # RPC latency either way. Observing it keeps the
+                    # slow owner the hedge outran visible in the
+                    # distribution the p95 trigger and the slow-peer
+                    # verdict read.
+                    self._observe_fetch(token, elapsed)
                 if outcome == "late":
                     self._m_hedge_late.add(1)
+                if outcome == "late" or (
+                    outcome == "cancelled" and got is not None
+                ):
+                    # "A cancelled leg's reply arrived anyway" — the
+                    # wide event that lets the diagnosis engine pin a
+                    # straggler by name.
+                    event("hedge.late", "warn", peer=token,
+                          elapsed_ms=round(elapsed * 1e3, 3))
                 if win:
                     self._m_hedge_wins.add(1)
+                    event("hedge.win", peer=token,
+                          elapsed_ms=round(elapsed * 1e3, 3))
                 sp.set_attr(
                     outcome=outcome, bytes=nbytes,
                     shards=len(got) if got else 0,
@@ -372,6 +422,7 @@ class TargetedDelivery:
                     cancelled += 1
         if cancelled:
             self._m_hedge_cancelled.add(cancelled)
+            event("hedge.cancel", losers=cancelled)
 
     def _decode_gathered(
         self, store, key: str, k: int, n: int, field: str, code: str,

@@ -34,6 +34,7 @@ import time
 from typing import Callable, Optional
 
 from noise_ec_tpu.host.wire import Shard
+from noise_ec_tpu.obs.events import event
 from noise_ec_tpu.obs.registry import default_registry
 from noise_ec_tpu.obs.trace import span
 from noise_ec_tpu.store.convert import derive_stripe_sig, finish_prev_stripes_gc
@@ -88,6 +89,7 @@ def domain_census(ring, holdings) -> dict:
         for key in store.keys():
             try:
                 meta, shards, _ = store.snapshot(key)
+            # noise-ec: allow(event-on-swallow) — stripe evicted mid-walk — expected churn, next cycle reconverges
             except Exception:  # noqa: BLE001 — evicted mid-walk
                 continue
             try:
@@ -264,6 +266,7 @@ class Rebalancer:
             for key in keys:
                 try:
                     meta, shards, _ = self.store.snapshot(key)
+                # noise-ec: allow(event-on-swallow) — stripe evicted mid-walk — expected churn, next cycle reconverges
                 except Exception:  # noqa: BLE001 — evicted mid-walk
                     continue
                 stats["examined"] += 1
@@ -288,6 +291,12 @@ class Rebalancer:
                         if not self.bucket.take(len(blob)):
                             stats["deferred"] += 1
                             self._m_moves["deferred"].add(1)
+                            event(
+                                "rebalance.defer",
+                                examined=stats["examined"],
+                                moved=stats["moved"],
+                                want_bytes=len(blob),
+                            )
                             return stats  # dry: resume next cycle
                         if self.fault_mid_move is not None:
                             self.fault_mid_move()
@@ -319,6 +328,14 @@ class Rebalancer:
                             stats["dropped"] += 1
                             self._m_moves["dropped"].add(1)
             self._cycle += 1
+        if stats["moved"] or stats["dropped"]:
+            event(
+                "rebalance.diff",
+                examined=stats["examined"],
+                moved=stats["moved"],
+                dropped=stats["dropped"],
+                bytes_moved=self.bytes_moved,
+            )
         with self._lock:
             if not stats["deferred"]:
                 self._dirty = False

@@ -32,6 +32,7 @@ import logging
 import threading
 from typing import Optional
 
+from noise_ec_tpu.obs.events import event
 from noise_ec_tpu.obs.registry import default_registry
 from noise_ec_tpu.resilience.breakers import CircuitBreaker
 
@@ -140,6 +141,9 @@ class PeerSupervisor:
             self.breaker(address).record_failure()
         log.info("peer %s lost (%s); supervising re-dial",
                  address, reason or "connection closed")
+        event("peer.down", "warn", peer=address,
+              reason=reason or "connection closed",
+              breaker=self.breaker(address).state())
         self._notify_membership(address, False)
         self._schedule(address)
 
@@ -208,8 +212,9 @@ class PeerSupervisor:
             br.record_success()
             self._reconnect_ok.add(1)
             with self._lock:
-                self._attempts.pop(address, None)
+                attempts = self._attempts.pop(address, 0)
             log.info("re-dial of %s succeeded", address)
+            event("peer.up", peer=address, attempts=attempts)
             self._notify_membership(address, True)
 
     # --------------------------------------------------------------- health

@@ -44,6 +44,7 @@ import weakref
 from collections import OrderedDict
 from typing import Callable, Iterable, Optional
 
+from noise_ec_tpu.obs.events import event
 from noise_ec_tpu.obs.registry import default_registry
 from noise_ec_tpu.obs.trace import current_trace_id, span
 
@@ -285,6 +286,13 @@ class DecodedObjectCache:
             pressured = self._shrink_locked(limit)
         self._metrics.evicted("lru", lru)
         self._metrics.evicted("pressure", pressured)
+        if pressured:
+            # The HBM-watermark shrink, not routine LRU turnover: the
+            # cache yielding RAM to device pressure is a diagnosis
+            # signal (hbm-pressure rule) the eviction counter alone
+            # cannot date.
+            event("cache.shrink", "warn", evicted=pressured,
+                  limit_bytes=limit)
         return True
 
     def evict_address(self, address: str) -> int:
@@ -373,6 +381,7 @@ class DecodedObjectCache:
                 limit = hbm.get("limit_bytes") or 0
                 used = hbm.get("bytes_in_use", hbm.get("live_bytes", 0))
                 pressured = bool(limit) and used >= self.hbm_watermark * limit
+            # noise-ec: allow(event-on-swallow) — telemetry probe — the put proceeds; cache.shrink fires on the eviction path
             except Exception:  # noqa: BLE001 — telemetry must not break puts
                 pressured = False
             with self._lock:

@@ -78,6 +78,7 @@ import weakref
 from typing import Iterable, Iterator, Optional
 
 from noise_ec_tpu.obs.device import hbm_snapshot
+from noise_ec_tpu.obs.events import event
 from noise_ec_tpu.obs.metrics import percentile_from
 from noise_ec_tpu.obs.registry import default_registry
 from noise_ec_tpu.obs.trace import (
@@ -250,6 +251,7 @@ class _ObjectMetrics:
             self._tenant_sheds.labels(
                 tenant=self._tenant_label(tenant), reason=reason
             ).add(1)
+        event("object.shed", "warn", tenant=tenant, reason=reason)
 
     def tenant_bytes(self, tenant: str, value: int) -> None:
         self._tenant_bytes.labels(tenant=tenant).set(value)
@@ -475,6 +477,7 @@ class ObjectStore:
         try:
             tenant = self.tenants.get(tenant_name)
             lane, weight = tenant.lane, tenant.weight
+        # noise-ec: allow(event-on-swallow) — unknown tenant raises from the op body later; pre-count only
         except Exception:  # noqa: BLE001 — unknown tenant raises later
             pass
         return qos_lane(lane, tenant=tenant_name, weight=weight)
@@ -489,6 +492,7 @@ class ObjectStore:
             return "slo"
         try:
             hbm = hbm_snapshot()
+        # noise-ec: allow(event-on-swallow) — telemetry fast-path — the PUT itself proceeds and raises on real faults
         except Exception:  # noqa: BLE001 — telemetry must not refuse PUTs
             return None
         limit = hbm.get("limit_bytes") or 0
@@ -1252,10 +1256,13 @@ class ObjectStore:
                         outcome = "late"
                     else:
                         state["winner"] = (att["rank"], blob)
+                        state["winner_endpoint"] = att["endpoint"]
                         state["decided"] = True
                 cond.notify_all()
             if outcome == "late":
                 self._metrics.hedge_late.add(1)
+                event("hedge.late", "warn", peer=att["endpoint"],
+                      elapsed_ms=round(elapsed * 1e3, 3))
             if outcome in ("ok", "late"):
                 breaker.record_success()
                 self._metrics.peer_fetch_seconds(att["endpoint"], elapsed)
@@ -1404,15 +1411,19 @@ class ObjectStore:
             if resp is not None:
                 try:
                     resp.close()
+                # noise-ec: allow(event-on-swallow) — loser response close race after hedge cancel; hedge.cancel event follows
                 except Exception:  # noqa: BLE001
                     pass
         if losers:
             self._metrics.hedge_cancelled.add(len(losers))
+            event("hedge.cancel", losers=len(losers))
         if winner is None:
             return None
         rank, blob = winner
         if rank > 0:
             self._metrics.hedge_wins.add(1)
+            event("hedge.win", peer=state.get("winner_endpoint"),
+                  rank=rank)
         return blob
 
     def _read_stripe(self, key: str) -> tuple[bytes, bool]:

@@ -68,6 +68,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from noise_ec_tpu.obs.events import event
 from noise_ec_tpu.obs.registry import default_registry
 from noise_ec_tpu.obs.trace import node_attrs, span
 from noise_ec_tpu.store.stripe import StripeStore
@@ -537,6 +538,8 @@ class ConversionEngine:
                 # before this line serves the hot generation, every
                 # read after it the archival one.
                 self.store.put_manifest(address, new_doc)
+                event("convert.swap", address=address[:16],
+                      tier="archive", stripes=len(old_keys))
                 if self.fault_after_swap is not None:
                     self.fault_after_swap()
                 if self.cache is not None:

@@ -43,6 +43,7 @@ from typing import Iterable, Optional
 import numpy as np
 
 from noise_ec_tpu.host.wire import Shard
+from noise_ec_tpu.obs.events import event
 from noise_ec_tpu.obs.registry import default_registry
 from noise_ec_tpu.obs.trace import node_attrs, span
 from noise_ec_tpu.store.stripe import StripeStore, UnknownStripeError
@@ -141,6 +142,7 @@ class RepairEngine:
                 router = mesh_router()
                 if router.enabled:
                     max_batch = min(64 * router.n_pow2, 512)
+            # noise-ec: allow(event-on-swallow) — environment probe: host drain width without jax
             except Exception:  # noqa: BLE001 — no jax, host drain width
                 pass
         self.max_batch = max_batch
@@ -467,6 +469,8 @@ class RepairEngine:
                     continue
                 if corrected:
                     self.metrics.corrupt_shards.add(corrected)
+                    event("scrub.corrupt", "error", key=key[:16],
+                          shards=corrected, source="repair")
                 self.metrics.repairs.add(1)
                 repaired += 1
         return repaired
@@ -562,6 +566,8 @@ class RepairEngine:
                 return 0
             if corrupt:
                 self.metrics.corrupt_shards.add(corrupt)
+                event("scrub.corrupt", "error", key=key[:16],
+                      shards=corrupt, source="restore")
             self.metrics.repairs.add(1)
         return 1
 
@@ -587,6 +593,7 @@ class RepairEngine:
                 ),
                 meta.file_signature,
             )
+        # noise-ec: allow(event-on-swallow) — malformed stored identity — treated as non-origin, nothing to report
         except Exception:  # noqa: BLE001 — malformed stored identity
             return False
 

@@ -31,6 +31,7 @@ from typing import Optional
 
 import numpy as np
 
+from noise_ec_tpu.obs.events import event
 from noise_ec_tpu.obs.registry import default_registry
 from noise_ec_tpu.obs.trace import node_attrs, span
 from noise_ec_tpu.store.stripe import StripeStore, UnknownStripeError
@@ -211,4 +212,5 @@ class Scrubber:
                 self._verify_failures.add(1)
                 stats["flagged_corrupt"] += 1
                 self._seen[key] = ((), False)
+                event("scrub.corrupt", "error", key=key[:16])
             self.engine.enqueue(key, "verify_failed")

@@ -50,11 +50,13 @@ def _isolate_default_observability():
     callback-gauge children, and clears the tracer ring, so each test
     observes only what it recorded. Delta-style tests (before/after
     scrapes) are unaffected — they normalize their own baseline."""
+    from noise_ec_tpu.obs.events import default_event_log
     from noise_ec_tpu.obs.registry import default_registry
     from noise_ec_tpu.obs.trace import default_tracer
 
     default_registry().reset_values()
     default_tracer().clear()
+    default_event_log().clear()
     yield
 
 

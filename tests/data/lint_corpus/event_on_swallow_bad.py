@@ -1,0 +1,48 @@
+"""event-on-swallow corpus: silent broad swallows in an instrumented
+module (one importing ``noise_ec_tpu.obs.events``).
+
+Three findings expected: the bare ``except:``, the broad
+``except Exception`` that only returns a fallback, and the
+``except (ValueError, BaseException)`` tuple (the broad member makes
+the whole handler broad). The narrow ``except KeyError`` is expected
+control flow and must NOT fire.
+"""
+
+from noise_ec_tpu.obs.events import event
+
+
+def swallow_bare(work):
+    try:
+        return work()
+    except:  # a bare except hides everything
+        return None
+
+
+def swallow_broad(work):
+    try:
+        return work()
+    except Exception:
+        return None
+
+
+def swallow_tuple(work):
+    try:
+        return work()
+    except (ValueError, BaseException):
+        pass
+
+
+def narrow_is_fine(table, key):
+    try:
+        return table[key]
+    except KeyError:
+        return None
+
+
+def emit_unrelated(work):
+    # The event fires on success only — the handler itself is silent,
+    # so this still counts as the broad-swallow shape above (covered by
+    # swallow_broad); listed here to document the distinction.
+    out = work()
+    event("corpus.ok")
+    return out

@@ -1,0 +1,62 @@
+"""event-on-swallow clean twin: every broad handler leaves a
+footprint — a wide event, a log call, the error-accounting sink, a
+re-raise — or carries a justified suppression. A module that does not
+import the event API at all is exempt entirely (not shown here; any
+un-instrumented package module demonstrates it)."""
+
+import logging
+
+from noise_ec_tpu.obs.events import event
+
+log = logging.getLogger("corpus")
+
+
+def footprint_event(work):
+    try:
+        return work()
+    except Exception as exc:  # noqa: BLE001
+        event("corpus.fail", "warn", error=str(exc))
+        return None
+
+
+def footprint_log(work):
+    try:
+        return work()
+    except Exception as exc:  # noqa: BLE001
+        log.warning("work failed: %s", exc)
+        return None
+
+
+class Net:
+    def _record_error(self, exc):
+        pass
+
+    def footprint_sink(self, work):
+        try:
+            return work()
+        except Exception as exc:  # noqa: BLE001
+            self._record_error(exc)
+            return None
+
+
+def footprint_reraise(work):
+    try:
+        return work()
+    except Exception as exc:
+        raise RuntimeError("wrapped") from exc
+
+
+def probe_with_allow():
+    try:
+        import jax  # noqa: F401
+    # noise-ec: allow(event-on-swallow) — environment probe, host regime
+    except Exception:  # noqa: BLE001
+        return False
+    return True
+
+
+def narrow_control_flow(table, key):
+    try:
+        return table[key]
+    except KeyError:
+        return None

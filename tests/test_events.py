@@ -1,0 +1,493 @@
+"""Wide-event log + diagnosis engine (PR 20).
+
+Pins the contracts docs/observability.md "Wide events" / "Diagnosis"
+promise: the bounded event vocabulary, record shape (trace + node
+stamping), the ring byte cap under a storm, exact suppressed-count
+accounting under a 16-thread storm, the epoch-keyed ``/events`` cursor
+(including the restart → re-fetch-from-0 collector contract), incident
+bundles embedding the event window plus a verdict, the per-rule
+diagnosis units (slow-peer, noisy-tenant, churn-storm,
+verify-failure-spike), the ``/healthz`` fold, and the tools/diagnose.py
+renderer.
+"""
+
+import ast
+import io
+import json
+import sys
+import threading
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from noise_ec_tpu.obs.diagnose import (
+    DIAGNOSE_DOC_FIELDS,
+    VERDICTS,
+    DiagnosisEngine,
+)
+from noise_ec_tpu.obs.events import (
+    EVENT_FIELDS,
+    EVENT_NAMES,
+    EVENTS_DOC_FIELDS,
+    EventLog,
+    default_event_log,
+    event,
+)
+from noise_ec_tpu.obs.recorder import FlightRecorder
+from noise_ec_tpu.obs.registry import Registry
+from noise_ec_tpu.obs.server import StatsServer
+from noise_ec_tpu.obs.trace import Tracer
+
+PACKAGE = Path(__file__).resolve().parent.parent / "noise_ec_tpu"
+
+
+def _get(url: str) -> tuple[int, bytes]:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+def counter_value(reg: Registry, family: str, **labels) -> float:
+    return reg.counter(family).labels(**labels).value
+
+
+def _isolated() -> tuple[Registry, Tracer, EventLog]:
+    reg = Registry()
+    tracer = Tracer(registry=Registry())
+    return reg, tracer, EventLog(registry=reg, tracer=tracer)
+
+
+# ------------------------------------------------------------ vocabulary
+
+
+def _literal_event_names() -> set[str]:
+    """Every literal first argument of an ``event("...")`` call in the
+    package (obs/events.py itself excluded — it defines the API)."""
+    names: set[str] = set()
+    for path in sorted(PACKAGE.rglob("*.py")):
+        if path.name == "events.py" and path.parent.name == "obs":
+            continue
+        tree = ast.parse(path.read_text(encoding="utf-8"))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            called = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None
+            )
+            if called not in ("event", "emit") or not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(
+                first.value, str
+            ):
+                names.add(first.value)
+    return names
+
+
+def test_event_vocabulary_is_pinned_both_directions():
+    """EVENT_NAMES is the bounded vocabulary: every call-site literal
+    is declared, and every declared name has a live call site (a stale
+    entry is docs drift the same way an unused metric would be)."""
+    used = _literal_event_names()
+    declared = set(EVENT_NAMES)
+    assert used - declared == set(), (
+        f"event() literals missing from EVENT_NAMES: {used - declared}"
+    )
+    assert declared - used == set(), (
+        f"EVENT_NAMES entries with no call site: {declared - used}"
+    )
+    assert len(EVENT_NAMES) == len(declared), "duplicate EVENT_NAMES entry"
+
+
+# ---------------------------------------------------------- record shape
+
+
+def test_record_stamps_trace_node_and_coerces_attrs():
+    reg, tracer, log = _isolated()
+    with tracer.request("get", tenant="t0") as scope:
+        log.emit("hedge.win", tenant="t0", peer="fleet://3",
+                 exotic=object())
+    recs = log.dump()
+    assert len(recs) == 1
+    rec = recs[0]
+    assert tuple(sorted(rec)) == tuple(sorted(EVENT_FIELDS))
+    assert rec["trace_id"] == scope.trace_id
+    assert rec["node"] == tracer.node_label()
+    assert rec["tenant"] == "t0"
+    assert rec["attrs"]["peer"] == "fleet://3"
+    # exotic attr coerced to str so the record survives json.dumps
+    assert isinstance(rec["attrs"]["exotic"], str)
+    json.dumps(rec)
+    assert counter_value(
+        reg, "noise_ec_events_total", name="hedge.win", severity="info"
+    ) == 1
+
+
+def test_emit_outside_request_scope_and_bad_severity_degrade():
+    _, _, log = _isolated()
+    log.emit("peer.down", severity="catastrophic", endpoint="e1")
+    rec = log.dump()[0]
+    assert rec["trace_id"] is None
+    assert rec["severity"] == "info"  # unknown severity normalised
+
+
+def test_disabled_log_is_a_no_op():
+    _, _, log = _isolated()
+    log.enabled = False
+    log.emit("peer.down")
+    assert log.dump() == [] and log.last_seq() == 0
+
+
+# ------------------------------------------------------------- ring cap
+
+
+def test_ring_stays_under_byte_cap_under_storm():
+    reg, _, _ = _isolated()
+    log = EventLog(registry=reg, max_bytes=8192,
+                   rate_per_name=1e9, burst_per_name=1e9)
+    blob = "x" * 200
+    for i in range(500):
+        log.emit("object.shed", tenant=f"t{i % 7}", reason="slo",
+                 detail=blob)
+    assert log.ring_bytes() <= 8192
+    recs = log.dump()
+    assert recs, "cap evicted everything"
+    assert log.last_seq() == 500
+    assert recs[0]["seq"] > 1, "oldest records were not evicted"
+    assert recs[-1]["seq"] == 500, "newest record must survive"
+    gauge = reg.gauge("noise_ec_event_ring_bytes").labels().read()
+    assert gauge == log.ring_bytes()
+
+
+# ------------------------------------------------- suppression accounting
+
+
+def test_suppressed_count_exact_under_sixteen_thread_storm():
+    """Every emit either lands a record or is counted suppressed —
+    under 16 threads hammering one name the books must balance
+    exactly: records + suppressed == emissions, and the per-record
+    ``suppressed`` attrs plus the not-yet-folded pending count equal
+    the suppressed counter."""
+    reg, _, _ = _isolated()
+    log = EventLog(registry=reg, rate_per_name=0.0, burst_per_name=5.0)
+    threads = 16
+    per_thread = 100
+    barrier = threading.Barrier(threads)
+
+    def storm():
+        barrier.wait()
+        for _ in range(per_thread):
+            log.emit("codec.fallback", reason="error")
+
+    workers = [threading.Thread(target=storm) for _ in range(threads)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+
+    records = log.dump()
+    suppressed = counter_value(
+        reg, "noise_ec_events_suppressed_total", name="codec.fallback"
+    )
+    total = threads * per_thread
+    assert len(records) + suppressed == total
+    assert len(records) == 5  # burst depth, zero refill
+    folded = sum(r["attrs"].get("suppressed", 0) for r in records)
+    assert folded + log.suppressed_total("codec.fallback") == suppressed
+    # one more token-less emit folds nothing new into a record but
+    # still keeps the invariant
+    log.emit("codec.fallback", reason="error")
+    assert len(log.dump()) + counter_value(
+        reg, "noise_ec_events_suppressed_total", name="codec.fallback"
+    ) == total + 1
+
+
+def test_suppression_folds_into_next_record():
+    reg, _, _ = _isolated()
+    log = EventLog(registry=reg, rate_per_name=0.0, burst_per_name=2.0)
+    for _ in range(6):
+        log.emit("cache.shrink", watermark=1)
+    assert len(log.dump()) == 2
+    assert log.suppressed_total("cache.shrink") == 4
+    # hand the bucket one token: the next record carries the backlog
+    with log._lock:
+        log._buckets["cache.shrink"][0] = 1.0
+    log.emit("cache.shrink", watermark=2)
+    assert log.dump()[-1]["attrs"]["suppressed"] == 4
+    assert log.suppressed_total("cache.shrink") == 0
+    assert counter_value(
+        reg, "noise_ec_events_suppressed_total", name="cache.shrink"
+    ) == 4
+
+
+# --------------------------------------------------------- /events route
+
+
+def test_events_route_serves_cursored_filtered_doc():
+    reg, tracer, log = _isolated()
+    srv = StatsServer(port=0, registry=reg, tracer=tracer)
+    try:
+        log.attach(srv)
+        log.emit("hedge.win", tenant="alice", peer="p1")
+        log.emit("hedge.late", tenant="bob", peer="p2")
+        log.emit("peer.down", endpoint="e3")
+        _, body = _get(srv.url + "/events")
+        doc = json.loads(body)
+        assert tuple(sorted(doc)) == tuple(sorted(EVENTS_DOC_FIELDS))
+        assert doc["epoch"] == log.epoch
+        assert doc["next_since"] == log.last_seq() == 3
+        assert [e["name"] for e in doc["events"]] == [
+            "hedge.win", "hedge.late", "peer.down",
+        ]
+        # cursor: only records past ``since``
+        _, body = _get(srv.url + "/events?since=2")
+        assert [e["seq"] for e in json.loads(body)["events"]] == [3]
+        # dot-prefix name filter catches the hedge.* family
+        _, body = _get(srv.url + "/events?name=hedge")
+        assert {e["name"] for e in json.loads(body)["events"]} == {
+            "hedge.win", "hedge.late",
+        }
+        # tenant filter
+        _, body = _get(srv.url + "/events?tenant=bob")
+        assert [e["name"] for e in json.loads(body)["events"]] == [
+            "hedge.late",
+        ]
+        # limit keeps the NEWEST records (the lagging-poller contract)
+        _, body = _get(srv.url + "/events?limit=1")
+        assert [e["seq"] for e in json.loads(body)["events"]] == [3]
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(srv.url + "/events?since=banana")
+        assert err.value.code == 400
+    finally:
+        srv.close()
+
+
+def test_events_cursor_survives_restart_via_epoch():
+    """The collector contract: a restarted node resets seq to 0 but
+    publishes a new epoch, so a poller that kept its old cursor sees
+    the epoch change and re-fetches from 0 instead of skipping the
+    restarted node's records forever."""
+    reg, tracer, log = _isolated()
+    srv = StatsServer(port=0, registry=reg, tracer=tracer)
+    try:
+        log.attach(srv)
+        for _ in range(4):
+            log.emit("repair.giveup", stripe="s1")
+        _, body = _get(srv.url + "/events")
+        doc = json.loads(body)
+        cursor, epoch = doc["next_since"], doc["epoch"]
+        assert cursor == 4
+    finally:
+        srv.close()
+
+    # "restart": a fresh log incarnation behind the same endpoint role
+    reg2, tracer2, log2 = _isolated()
+    srv2 = StatsServer(port=0, registry=reg2, tracer=tracer2)
+    try:
+        log2.attach(srv2)
+        log2.emit("repair.giveup", stripe="s2")
+        _, body = _get(srv2.url + f"/events?since={cursor}")
+        doc2 = json.loads(body)
+        assert doc2["epoch"] != epoch
+        # naive cursor reuse would skip the record entirely...
+        assert doc2["events"] == []
+        # ...so the poller detects the epoch change and restarts at 0
+        _, body = _get(srv2.url + "/events?since=0")
+        assert [e["attrs"]["stripe"]
+                for e in json.loads(body)["events"]] == ["s2"]
+    finally:
+        srv2.close()
+
+
+def test_clear_keeps_epoch():
+    _, _, log = _isolated()
+    epoch = log.epoch
+    log.emit("peer.up", endpoint="e1")
+    log.clear()
+    assert log.epoch == epoch  # clear is test isolation, not a restart
+    assert log.dump() == []
+
+
+# ----------------------------------------------------- bundles + verdict
+
+
+def test_bundle_embeds_event_window_and_diagnosis():
+    reg = Registry()
+    tracer = Tracer(registry=Registry())
+    events = EventLog(registry=reg, tracer=tracer)
+    rec = FlightRecorder(registry=reg, tracer=tracer)
+    DiagnosisEngine(registry=reg, events=events, tracer=tracer,
+                    recorder=rec)
+    rec.tick()  # open the timeline window BEFORE the incident's events
+    events.emit("peer.down", severity="warn", endpoint="fleet://1",
+                domain="rack0")
+    events.emit("peer.down", severity="warn", endpoint="fleet://2",
+                domain="rack0")
+    events.emit("peer.drop", endpoint="fleet://1")
+    rec.tick()
+    bundle = rec.capture("request")
+    embedded = bundle.get("events")
+    assert embedded, "bundle must embed the window's wide events"
+    seqs = {e["seq"] for e in embedded}
+    assert {e["name"] for e in embedded} == {"peer.down", "peer.drop"}
+    diag = bundle.get("diagnosis")
+    assert diag and diag["trigger"] == "bundle"
+    names = [v["verdict"] for v in diag["verdicts"]]
+    assert set(names) <= set(VERDICTS)
+    assert "domain-loss" in names
+    loss = next(v for v in diag["verdicts"] if v["verdict"] == "domain-loss")
+    assert loss["culprit"] == {"domain": "rack0"}
+    # evidence pointers resolve against the embedded window itself
+    assert loss["evidence"]["event_ids"]
+    assert set(loss["evidence"]["event_ids"]) <= seqs
+
+
+# ------------------------------------------------------------ rule units
+
+
+def test_slow_peer_rule_names_the_exact_peer():
+    reg = Registry()
+    tracer = Tracer(registry=Registry())
+    events = EventLog(registry=reg, tracer=tracer)
+    engine = DiagnosisEngine(registry=reg, events=events, tracer=tracer)
+    fam = reg.histogram("noise_ec_peer_fetch_seconds")
+    for i in range(4):
+        for _ in range(5):
+            fam.labels(peer=f"fleet://{i}").observe(0.01)
+    for _ in range(5):
+        fam.labels(peer="fleet://9").observe(1.0)
+    events.emit("hedge.late", peer="fleet://9")
+    doc = engine.diagnose("request")
+    assert tuple(sorted(doc)) == tuple(sorted(DIAGNOSE_DOC_FIELDS))
+    assert doc["verdicts"], "slow-peer rule did not fire"
+    top = doc["verdicts"][0]
+    assert top["verdict"] == "slow-peer"
+    assert top["culprit"] == {"peer": "fleet://9"}
+    assert "fleet://9" in top["summary"]
+    assert top["evidence"]["event_ids"], "hedge event evidence missing"
+    base = engine.diagnose("request")
+    # the hedge corroboration boosted the score over metrics alone
+    events.clear()
+    bare = engine.diagnose("request")["verdicts"][0]
+    assert top["score"] > bare["score"]
+    assert base["trigger"] == "request"
+
+
+def test_noisy_tenant_rule_names_the_exact_tenant():
+    reg = Registry()
+    tracer = Tracer(registry=Registry())
+    events = EventLog(registry=reg, tracer=tracer)
+    engine = DiagnosisEngine(registry=reg, events=events, tracer=tracer)
+    fam = reg.histogram("noise_ec_object_op_seconds")
+    for _ in range(9):
+        fam.labels(tenant="noisy", op="get", route="peer").observe(1.0)
+    fam.labels(tenant="quiet", op="get", route="cache").observe(1.0)
+    events.emit("object.shed", tenant="noisy", reason="slo")
+    verdicts = engine.diagnose("request")["verdicts"]
+    assert verdicts and verdicts[0]["verdict"] == "noisy-tenant"
+    assert verdicts[0]["culprit"] == {"tenant": "noisy"}
+    assert verdicts[0]["score"] == pytest.approx(0.95)
+    assert verdicts[0]["evidence"]["event_ids"]
+
+
+def test_churn_storm_and_verify_spike_rules():
+    reg = Registry()
+    tracer = Tracer(registry=Registry())
+    events = EventLog(registry=reg, tracer=tracer)
+    engine = DiagnosisEngine(registry=reg, events=events, tracer=tracer)
+    for i in range(3):
+        events.emit("rebalance.diff", moved=i + 1, examined=10)
+    fam = reg.histogram("noise_ec_e2e_latency_seconds")
+    for _ in range(3):
+        fam.labels(outcome="verify_failed").observe(0.1)
+    fam.labels(outcome="ok").observe(0.1)
+    events.emit("scrub.corrupt", severity="error", shard="s0")
+    names = [v["verdict"] for v in engine.diagnose("request")["verdicts"]]
+    assert "churn-storm" in names
+    assert "verify-failure-spike" in names
+
+
+def test_rules_stay_silent_on_a_quiet_node():
+    reg = Registry()
+    engine = DiagnosisEngine(
+        registry=reg, events=EventLog(registry=reg),
+        tracer=Tracer(registry=Registry()),
+    )
+    doc = engine.diagnose("request")
+    assert doc["verdicts"] == []
+    assert doc["healthy"] is None  # no SLO wired
+
+
+# ----------------------------------------------------- serving + renderer
+
+
+def test_diagnose_route_and_healthz_fold():
+    reg = Registry()
+    tracer = Tracer(registry=Registry())
+    events = EventLog(registry=reg, tracer=tracer)
+    engine = DiagnosisEngine(registry=reg, events=events, tracer=tracer)
+    fam = reg.histogram("noise_ec_object_op_seconds")
+    for _ in range(9):
+        fam.labels(tenant="noisy", op="get", route="peer").observe(1.0)
+    fam.labels(tenant="quiet", op="get", route="cache").observe(1.0)
+    srv = StatsServer(port=0, registry=reg, tracer=tracer,
+                      health_details=lambda: {"base": 1})
+    try:
+        engine.attach(srv)
+        _, body = _get(srv.url + "/diagnose")
+        doc = json.loads(body)
+        assert tuple(sorted(doc)) == tuple(sorted(DIAGNOSE_DOC_FIELDS))
+        assert doc["verdicts"][0]["verdict"] == "noisy-tenant"
+        _, body = _get(srv.url + "/healthz?verbose=1")
+        health = json.loads(body)
+        details = health["details"]
+        assert details["base"] == 1, "chained provider must keep running"
+        fold = details["diagnosis"]
+        assert fold["verdicts"][0]["verdict"] == "noisy-tenant"
+        assert set(fold["verdicts"][0]) == {
+            "verdict", "score", "culprit", "summary",
+        }
+    finally:
+        srv.close()
+
+
+def _diagnose_tool():
+    sys.path.insert(
+        0, str(Path(__file__).resolve().parent.parent / "tools")
+    )
+    try:
+        import diagnose
+    finally:
+        sys.path.pop(0)
+    return diagnose
+
+
+def test_tools_diagnose_renders_verdicts_and_bundles():
+    tool = _diagnose_tool()
+    reg = Registry()
+    tracer = Tracer(registry=Registry())
+    events = EventLog(registry=reg, tracer=tracer)
+    rec = FlightRecorder(registry=reg, tracer=tracer)
+    DiagnosisEngine(registry=reg, events=events, tracer=tracer,
+                    recorder=rec)
+    rec.tick()
+    events.emit("peer.down", severity="warn", endpoint="e1", domain="r0")
+    events.emit("peer.down", severity="warn", endpoint="e2", domain="r0")
+    rec.tick()
+    bundle = rec.capture("request")
+    out = io.StringIO()
+    tool.render_bundle(bundle, out=out)
+    text = out.getvalue()
+    assert "domain-loss" in text
+    assert "peer.down" in text
+    out = io.StringIO()
+    tool.render_verdicts(bundle["diagnosis"], out=out)
+    assert "domain-loss" in out.getvalue()
+
+
+def test_module_level_event_feeds_default_log():
+    event("peer.up", endpoint="e9", attempts=2)
+    recs = default_event_log().dump(name="peer.up")
+    assert recs and recs[-1]["attrs"]["endpoint"] == "e9"

@@ -78,6 +78,8 @@ CORPUS_RULES = {
     "metric-name": ("metric_name_bad.py", "metric_name_clean.py"),
     "span-stage": ("span_stage_bad.py", "span_stage_clean.py"),
     "span-coverage": ("span_coverage_bad.py", "span_coverage_clean.py"),
+    "event-on-swallow": ("event_on_swallow_bad.py",
+                         "event_on_swallow_clean.py"),
 }
 
 # Project rules pinned by the synthetic-drift tests in this module.

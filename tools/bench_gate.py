@@ -174,6 +174,14 @@ PLACEMENT_FANOUT_RATIO_MAX = 1.5
 TRACE_OVERHEAD_PCT_MAX = 3.0
 TRACE_KEEP_RATE_MAX = 0.25
 
+# The ISSUE-20 wide-event bar (event_overhead_check, fresh runs): the
+# hot cached GET mix with the event log armed must run within 1% of
+# the same mix with the log disabled. Events fire only at decision
+# points, so the clean path crosses no emit at all — a measurable gap
+# means an event call site leaked onto the per-request path
+# (docs/observability.md "Wide events").
+EVENT_OVERHEAD_PCT_MAX = 1.0
+
 # ISSUE-19 acceptance bars for the hedged read tier and tenant QoS
 # (docs/object-service.md "Read path"). The hedged-fleet bench runs a
 # 120 ms straggler peer; with the hedge engine racing a spare source the
@@ -426,6 +434,28 @@ def trace_overhead_check(stats: dict) -> list[str]:
             f"trace_keep_rate {rate} above the {TRACE_KEEP_RATE_MAX} "
             "bar — the tail sampler is keeping clean-path traces it "
             "should drop"
+        )
+    return problems
+
+
+def event_overhead_check(stats: dict) -> list[str]:
+    """ISSUE-20 acceptance bar for the wide-event log, fresh runs only
+    (recorded rounds before the event log genuinely lack the key).
+    ``event_log_overhead_pct`` (armed vs disabled hot-GET wall time)
+    must stay <= 1% — the hot cache-hit path crosses no emit, so a
+    real gap means an event call site leaked onto the per-request
+    path."""
+    problems = []
+    try:
+        pct = float(stats["event_log_overhead_pct"])
+    except (KeyError, TypeError, ValueError):
+        return problems
+    if pct > EVENT_OVERHEAD_PCT_MAX:
+        problems.append(
+            f"event_log_overhead_pct {pct} above the "
+            f"{EVENT_OVERHEAD_PCT_MAX:g}% bar — the wide-event log is "
+            "taxing the hot GET path (docs/observability.md "
+            '"Wide events")'
         )
     return problems
 
@@ -763,6 +793,7 @@ def main(argv: list[str] | None = None) -> int:
         problems.extend(panel_rig_check(current))
         problems.extend(placement_rig_check(current))
         problems.extend(trace_overhead_check(current))
+        problems.extend(event_overhead_check(current))
         problems.extend(hedge_rig_check(current))
     if args.json:
         print(json.dumps(

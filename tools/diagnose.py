@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Render a diagnosis — live node or incident bundle — as a human report.
+
+The diagnosis engine (obs/diagnose.py) emits ranked cause verdicts as
+JSON; this tool turns either surface into the report an operator reads
+first:
+
+    python tools/diagnose.py --node http://127.0.0.1:9464
+    python tools/diagnose.py incident-20260807-...-flip.json
+
+``--node`` hits the live ``GET /diagnose`` route (running every rule
+against the node's current registry, event window and kept traces) and
+also pulls ``GET /events`` for the evidence tail. A file argument reads
+a flight-recorder incident bundle and renders its embedded
+``diagnosis`` + ``events`` window (bundles written before the wide-event
+layer render their timeline head instead, with a note).
+
+For each verdict the report prints the score bar, the culprit, the
+one-line summary, and resolvable evidence pointers: event seqs (fetch
+``/events?since=SEQ-1&limit=1``), trace ids (fetch ``/spans?trace=ID``
+or feed tools/trace_report.py), and the metric readings the rule
+compared. See docs/observability.md "Diagnosis".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # direct `python tools/diagnose.py` runs
+    sys.path.insert(0, str(REPO))
+
+
+def fetch_json(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _bar(score: float, width: int = 20) -> str:
+    filled = int(round(max(0.0, min(1.0, score)) * width))
+    return "#" * filled + "." * (width - filled)
+
+
+def render_verdicts(doc: dict, out=sys.stdout) -> None:
+    node = doc.get("node") or "?"
+    trigger = doc.get("trigger") or "?"
+    healthy = doc.get("healthy")
+    state = ("healthy" if healthy else "DEGRADED") \
+        if healthy is not None else "unknown"
+    print(f"diagnosis of {node} (trigger={trigger}, slo={state}, "
+          f"window={doc.get('window_seconds', '?')}s)", file=out)
+    verdicts = doc.get("verdicts") or []
+    if not verdicts:
+        print("  no rule fired: nothing in the window looks like a "
+              "known failure shape", file=out)
+        return
+    for i, v in enumerate(verdicts, start=1):
+        culprit = ", ".join(
+            f"{k}={val}" for k, val in (v.get("culprit") or {}).items()
+        ) or "-"
+        print(f"\n{i}. {v['verdict']:<22} [{_bar(v['score'])}] "
+              f"{v['score']:.2f}  culprit: {culprit}", file=out)
+        print(f"   {v.get('summary', '')}", file=out)
+        ev = v.get("evidence") or {}
+        if ev.get("event_ids"):
+            print(f"   events: seq {ev['event_ids']} "
+                  "(GET /events?since=SEQ-1)", file=out)
+        if ev.get("trace_ids"):
+            print(f"   traces: {ev['trace_ids']} "
+                  "(GET /spans?trace=ID)", file=out)
+        for name, val in (ev.get("metrics") or {}).items():
+            print(f"   metric: {name} = {val:g}", file=out)
+
+
+def render_events(events: list[dict], limit: int = 15,
+                  out=sys.stdout) -> None:
+    if not events:
+        return
+    print(f"\nevent tail ({min(limit, len(events))} of "
+          f"{len(events)}):", file=out)
+    for e in events[-limit:]:
+        attrs = " ".join(
+            f"{k}={v}" for k, v in (e.get("attrs") or {}).items()
+        )
+        tid = e.get("trace_id") or "-"
+        tenant = f" tenant={e['tenant']}" if e.get("tenant") else ""
+        print(f"  #{e['seq']:<6} {e['severity']:<5} {e['name']:<18} "
+              f"trace={tid}{tenant} {attrs}", file=out)
+
+
+def render_bundle(bundle: dict, out=sys.stdout) -> None:
+    print(f"incident bundle: trigger={bundle.get('trigger')} node="
+          f"{bundle.get('node') or '?'} written_at="
+          f"{bundle.get('written_at')}", file=out)
+    diagnosis = bundle.get("diagnosis")
+    if diagnosis:
+        render_verdicts(diagnosis, out=out)
+    else:
+        print("  (bundle predates the diagnosis layer — no embedded "
+              "verdict; timeline head below)", file=out)
+        for entry in (bundle.get("timeline") or [])[:5]:
+            print(f"  t={entry.get('t')} healthy={entry.get('healthy')} "
+                  f"deltas={len(entry.get('deltas') or {})}", file=out)
+    render_events(bundle.get("events") or [], out=out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Render a live /diagnose run or an incident "
+                    "bundle's embedded diagnosis as a human report.",
+    )
+    p.add_argument("bundle", nargs="?",
+                   help="flight-recorder incident bundle JSON")
+    p.add_argument("--node",
+                   help="live node base URL (hits GET /diagnose + "
+                        "GET /events)")
+    p.add_argument("--events", type=int, default=15,
+                   help="event-tail rows to render (default 15)")
+    args = p.parse_args(argv)
+    if bool(args.bundle) == bool(args.node):
+        p.error("give exactly one of BUNDLE or --node")
+    if args.node:
+        base = args.node.rstrip("/")
+        try:
+            doc = fetch_json(f"{base}/diagnose")
+        except OSError as exc:
+            print(f"diagnose: {base} unreachable: {exc}", file=sys.stderr)
+            return 2
+        render_verdicts(doc)
+        try:
+            events_doc = fetch_json(f"{base}/events")
+        except OSError:
+            events_doc = {}
+        render_events(events_doc.get("events") or [], limit=args.events)
+        return 0
+    try:
+        with open(args.bundle, encoding="utf-8") as f:
+            bundle = json.load(f)
+    except (OSError, ValueError) as exc:
+        print(f"diagnose: cannot read {args.bundle}: {exc}",
+              file=sys.stderr)
+        return 2
+    render_bundle(bundle)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

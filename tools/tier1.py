@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Chunked tier-1 runner (ROADMAP.md "Tier-1 verify").
+
+The full tier-1 suite runs ~700s on a 1-core CPU box — past the 600s
+ceiling most CI shells and tool sandboxes put on a single command. This
+runner codifies the chunk map so "run tier-1" is one command again: it
+splits ``tests/test_*.py`` into a handful of chunks (each comfortably
+under the ceiling), runs them sequentially with the exact ROADMAP
+pytest flags, and aggregates the pass-dot count into one
+``DOTS_PASSED=N`` line comparable with the single-command run.
+
+Chunk map (measured 2026-08, CPU, ``JAX_PLATFORMS=cpu``):
+
+- ``panel-parallel`` — test_panel + test_parallel, ~425s of jax
+  compile sweeps; always its own chunk.
+- ``ops-pallas``     — test_ops + test_pallas_pack, ~125s.
+- ``early``          — test_b* .. test_matrix, ~90s.
+- ``mesh-obs``       — test_mesh .. test_obs (incl. the CPU-self-skip
+  test_multihost), ~55s.
+- ``late``           — test_placement .. test_xor_factor, ~60s.
+
+New test files are assigned by filename automatically (lexicographic
+ranges), so the map does not need editing for every new test module —
+only when a chunk outgrows its budget.
+
+Usage::
+
+    python tools/tier1.py              # run everything, chunked
+    python tools/tier1.py --list      # show the chunk map and exit
+    python tools/tier1.py --chunk late
+    python tools/tier1.py --timeout 840
+
+Exit code 0 iff every chunk exits 0. Output ends with
+``DOTS_PASSED=<n>`` (sum over chunks) and ``TIER1=ok|FAIL``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:  # direct `python tools/tier1.py` runs
+    sys.path.insert(0, str(REPO))
+
+PYTEST_FLAGS = [
+    "-q", "-m", "not slow", "--continue-on-collection-errors",
+    "-p", "no:cacheprovider", "-p", "no:xdist", "-p", "no:randomly",
+]
+
+# Pass-dot lines as emitted by `pytest -q` progress output; same regex
+# family as the ROADMAP one-liner so the aggregate count is comparable.
+_DOTS_RE = re.compile(r"^[.FEsx]+( *\[ *[0-9]+%\])?$")
+
+# (chunk name, per-chunk timeout seconds). Budgets are ~1.3x the
+# measured runtime so a slow box does not flap, while every chunk stays
+# under a 600s command ceiling.
+CHUNK_BUDGETS = {
+    "panel-parallel": 560,
+    "ops-pallas": 240,
+    "early": 200,
+    "mesh-obs": 150,
+    "late": 180,
+}
+CHUNK_ORDER = ("early", "mesh-obs", "late", "ops-pallas", "panel-parallel")
+
+
+def assign_chunk(name: str) -> str:
+    """Map one tests/test_*.py filename to its chunk."""
+    if name in ("test_panel.py", "test_parallel.py"):
+        return "panel-parallel"
+    if name in ("test_ops.py", "test_pallas_pack.py"):
+        return "ops-pallas"
+    if name < "test_mesh.py":
+        return "early"
+    if name < "test_ops.py":
+        return "mesh-obs"
+    return "late"
+
+
+def chunk_map() -> dict[str, list[str]]:
+    chunks: dict[str, list[str]] = {name: [] for name in CHUNK_ORDER}
+    for path in sorted((REPO / "tests").glob("test_*.py")):
+        chunks[assign_chunk(path.name)].append(
+            str(path.relative_to(REPO))
+        )
+    return chunks
+
+
+def count_dots(text: str) -> int:
+    return sum(
+        line.count(".")
+        for line in text.splitlines()
+        if _DOTS_RE.match(line.strip())
+    )
+
+
+def run_chunk(name: str, files: list[str], timeout: float) -> tuple[int, int, float]:
+    """Run one chunk; returns (rc, dots, seconds)."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable, "-m", "pytest", *files, *PYTEST_FLAGS]
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            cmd, cwd=REPO, env=env, timeout=timeout,
+            capture_output=True, text=True,
+        )
+        rc, out = proc.returncode, proc.stdout + proc.stderr
+    except subprocess.TimeoutExpired as exc:
+        out = (exc.stdout or "") + (exc.stderr or "")
+        if isinstance(out, bytes):  # pragma: no cover — text=True path
+            out = out.decode("utf-8", "replace")
+        rc = 124
+    dt = time.monotonic() - t0
+    sys.stdout.write(out)
+    sys.stdout.flush()
+    return rc, count_dots(out), dt
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tier1.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "--list", action="store_true",
+        help="print the chunk map (chunk: files) and exit",
+    )
+    parser.add_argument(
+        "--chunk", action="append", metavar="NAME",
+        help="run only the named chunk(s); repeatable",
+    )
+    parser.add_argument(
+        "--timeout", type=float, default=None,
+        help="override every chunk's timeout (seconds)",
+    )
+    args = parser.parse_args(argv)
+
+    chunks = chunk_map()
+    if args.list:
+        for name in CHUNK_ORDER:
+            budget = CHUNK_BUDGETS[name]
+            print(f"{name} (budget {budget}s):")
+            for f in chunks[name]:
+                print(f"  {f}")
+        return 0
+
+    wanted = args.chunk or list(CHUNK_ORDER)
+    unknown = [n for n in wanted if n not in chunks]
+    if unknown:
+        print(f"unknown chunk(s): {', '.join(unknown)}", file=sys.stderr)
+        return 2
+
+    total_dots = 0
+    failures: list[tuple[str, int]] = []
+    for name in wanted:
+        files = chunks[name]
+        if not files:
+            continue
+        timeout = args.timeout or CHUNK_BUDGETS[name]
+        print(f"== tier1 chunk {name}: {len(files)} files, "
+              f"timeout {timeout:.0f}s ==")
+        rc, dots, dt = run_chunk(name, files, timeout)
+        total_dots += dots
+        status = "ok" if rc == 0 else f"rc={rc}"
+        print(f"== tier1 chunk {name}: {status} "
+              f"dots={dots} in {dt:.1f}s ==")
+        if rc != 0:
+            failures.append((name, rc))
+    print(f"DOTS_PASSED={total_dots}")
+    if failures:
+        detail = ", ".join(f"{n} rc={rc}" for n, rc in failures)
+        print(f"TIER1=FAIL ({detail})")
+        return 1
+    print("TIER1=ok")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
